@@ -1,0 +1,15 @@
+"""lazzaro_tpu — TPU-native scalable long-term memory for AI agents.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of Lazzaro
+(thelaycon/lazzaro): episodic short-term buffering, LLM fact extraction,
+a semantically-sharded embedded memory graph, hybrid hierarchical+ANN
+retrieval, five-domain profile evolution, biological decay, and multi-tenant
+partitioning — with the similarity math, decay sweeps, and top-k retrieval
+running as batched XLA programs on an HBM-resident arena instead of Python
+loops over a CPU vector database.
+"""
+
+from lazzaro_tpu.core.memory_system import MemorySystem
+
+__version__ = "0.1.0"
+__all__ = ["MemorySystem"]

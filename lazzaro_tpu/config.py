@@ -1,0 +1,75 @@
+"""Central configuration for the TPU-native memory framework.
+
+The reference configures everything through 18 ``MemorySystem.__init__`` kwargs
+(``memory_system.py:63-84``). We keep those kwargs for API parity but also expose
+them as one dataclass so subsystems (arena, index, consolidation) share a single
+source of truth — and so the embedding dimension is first-class instead of being
+hardcoded to 1536 in the store schema (reference ``vector_store.py:37`` quirk).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+
+@dataclass
+class MemoryConfig:
+    # --- geometry ----------------------------------------------------------
+    embed_dim: int = 768            # first-class (ref hardcodes 1536 in schema)
+    initial_capacity: int = 1024    # arena rows; grows by doubling
+    max_edges: int = 8192           # edge arena rows; grows by doubling
+    dtype: str = "float32"          # arena embedding dtype ("bfloat16" for 1M+)
+
+    # --- behavior flags (parity with memory_system.py:63-84) ---------------
+    enable_sharding: bool = True
+    enable_hierarchy: bool = True
+    enable_caching: bool = True
+    enable_async: bool = True
+
+    # --- scale knobs -------------------------------------------------------
+    max_shard_size: int = 500       # shard split threshold (ref declared, never used)
+    super_node_threshold: int = 20
+    auto_consolidate: bool = True
+    consolidate_every: int = 3
+    auto_prune: bool = True
+    prune_threshold: float = 0.5
+    max_buffer_size: int = 10
+    cache_size: int = 1000
+
+    # --- semantic thresholds (exact parity per SURVEY §7 "hard parts") -----
+    dedup_similarity: float = 0.95      # memory_system.py:719-741
+    super_node_gate: float = 0.4        # hierarchy fast path :472
+    link_gate: float = 0.5              # _link_within_shards :797-836
+    link_weight_scale: float = 0.8      # link weight = sim * 0.8
+    chain_link_weight: float = 0.5      # consecutive new-node chain links
+    salience_floor: float = 0.2         # asymptotic decay floor, memory_shard.py:73-77
+    decay_rate: float = 0.01            # end_conversation :624
+    edge_reinforce: float = 0.1         # add_edge existing-edge bump, memory_shard.py:42
+    access_salience_boost: float = 0.05 # update_access, buffer_graph.py:79
+    neighbor_salience_boost: float = 0.02  # _boost_neighbors :242-260
+    retrieval_cap: int = 5              # merged results cap :488-510
+    ann_limit: int = 10                 # store search limit :484-486
+    hierarchy_children: int = 10        # fast path takes first 10 children
+    history_window: int = 10            # last-N chat history messages :325
+    importance_w_salience: float = 0.5  # _enforce_buffer_limit :544-549
+    importance_w_access: float = 0.3
+    importance_w_recency: float = 0.2
+    merge_similarity: float = 0.95      # _merge_similar_nodes threshold
+    component_min_size: int = 3         # run_consolidation :970-989
+    component_min_avg_weight: float = 0.3
+    cross_link_top_k: int = 3           # _link_to_existing_memories top-3
+    export_top_n: int = 50              # export_observations :1488-1519
+
+    # --- persistence -------------------------------------------------------
+    db_dir: str = "db"
+    user_id: str = "default"
+    load_from_disk: bool = True
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "MemoryConfig":
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})

@@ -1,0 +1,17 @@
+from lazzaro_tpu.core.buffer_graph import BufferGraph
+from lazzaro_tpu.core.index import MemoryIndex
+from lazzaro_tpu.core.memory_shard import MemoryShard
+from lazzaro_tpu.core.memory_system import MemorySystem
+from lazzaro_tpu.core.profile import Profile
+from lazzaro_tpu.core.query_cache import QueryCache
+from lazzaro_tpu.core.store import ArrowStore
+
+__all__ = [
+    "MemorySystem",
+    "MemoryShard",
+    "BufferGraph",
+    "Profile",
+    "QueryCache",
+    "MemoryIndex",
+    "ArrowStore",
+]

@@ -1,0 +1,395 @@
+"""MemoryIndex: the HBM-resident replacement for LanceDB.
+
+The reference delegates ANN search, persistence, and tenant filtering to
+LanceDB (``core/vector_store.py``). Here the index is a device-resident arena
+(``core.state``): search is one masked matvec + ``lax.top_k`` on the MXU,
+tenant isolation is a vectorized mask on the ``tenant_id`` column, and decay /
+pruning / importance sweeps are whole-arena elementwise kernels. Durability is
+a separate concern (``core.store.ArrowStore``).
+
+This class is the host-side bookkeeping wrapper: string id ↔ row maps, free
+lists, capacity growth, and sentinel padding. Everything numeric stays on
+device; host transfers are bulk and infrequent.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lazzaro_tpu.core import state as S
+from lazzaro_tpu.ops import graphops
+
+
+class MemoryIndex:
+    def __init__(self, dim: int, capacity: int = 1024, edge_capacity: int = 8192,
+                 dtype=jnp.float32, epoch: Optional[float] = None):
+        self.dim = dim
+        self.dtype = dtype
+        # Timestamps are stored relative to this epoch so f32 keeps sub-second
+        # precision (raw unix seconds ~1.7e9 would quantize to ~2 minutes).
+        self.epoch = float(epoch if epoch is not None else time.time())
+        self.state = S.init_arena(capacity, dim, dtype)
+        self.edge_state = S.init_edges(edge_capacity)
+        self._free_rows: List[int] = list(range(capacity - 1, -1, -1))
+        self._free_edge_slots: List[int] = list(range(edge_capacity - 1, -1, -1))
+        self.id_to_row: Dict[str, int] = {}
+        self.row_to_id: Dict[int, str] = {}
+        self.edge_slots: Dict[Tuple[str, str], int] = {}
+        self._tenants: Dict[str, int] = {}
+        self._shards: Dict[str, int] = {}
+        self.tenant_nodes: Dict[str, set] = {}
+
+    # ------------------------------------------------------------------ ids
+    def tenant_id(self, name: str) -> int:
+        if name not in self._tenants:
+            self._tenants[name] = len(self._tenants)
+        return self._tenants[name]
+
+    def shard_id(self, name: str) -> int:
+        if name not in self._shards:
+            self._shards[name] = len(self._shards)
+        return self._shards[name]
+
+    @property
+    def capacity(self) -> int:
+        return self.state.capacity
+
+    def __len__(self) -> int:
+        return len(self.id_to_row)
+
+    # ---------------------------------------------------------------- nodes
+    def _alloc_rows(self, n: int) -> List[int]:
+        while len(self._free_rows) < n:
+            old_cap = self.state.capacity
+            self.state = S.grow_arena(self.state, old_cap * 2)
+            self._free_rows = list(range(old_cap * 2 - 1, old_cap - 1, -1)) + self._free_rows
+        return [self._free_rows.pop() for _ in range(n)]
+
+    def add(self, ids: Sequence[str], embeddings: np.ndarray,
+            saliences: Sequence[float], timestamps: Sequence[float],
+            types: Sequence[str], shard_keys: Sequence[str],
+            tenant: str, is_super: Optional[Sequence[bool]] = None) -> List[int]:
+        """Batch insert; returns arena rows. Re-adding an existing id updates
+        its row in place."""
+        n = len(ids)
+        if n == 0:
+            return []
+        if is_super is None:
+            is_super = [False] * n
+        rows: List[int] = []
+        fresh_needed = sum(1 for i in ids if i not in self.id_to_row)
+        fresh = self._alloc_rows(fresh_needed)
+        fi = 0
+        for node_id in ids:
+            if node_id in self.id_to_row:
+                rows.append(self.id_to_row[node_id])
+            else:
+                r = fresh[fi]; fi += 1
+                self.id_to_row[node_id] = r
+                self.row_to_id[r] = node_id
+                rows.append(r)
+
+        cap = self.state.capacity
+        padded = S.pad_rows(np.asarray(rows, np.int32), cap)
+        b = len(padded)
+
+        def pad(vals, fill=0.0, dt=np.float32):
+            out = np.full((b,), fill, dt)
+            out[:n] = vals
+            return out
+
+        emb = np.zeros((b, self.dim), np.float32)
+        emb[:n] = np.asarray(embeddings, np.float32).reshape(n, self.dim)
+        emb[n:, 0] = 1.0  # sentinel rows get a unit vector (normalizable)
+
+        tid = self.tenant_id(tenant)
+        self.tenant_nodes.setdefault(tenant, set()).update(ids)
+        self.state = S.arena_add(
+            self.state,
+            jnp.asarray(padded),
+            jnp.asarray(emb),
+            jnp.asarray(pad([float(s) for s in saliences])),
+            jnp.asarray(pad([float(t) - self.epoch for t in timestamps])),
+            jnp.asarray(pad([S.TYPE_IDS.get(t, 0) for t in types], 0, np.int32)),
+            jnp.asarray(pad([self.shard_id(k or "default") for k in shard_keys], -1, np.int32)),
+            jnp.asarray(pad([tid] * n, -1, np.int32)),
+            jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
+        )
+        return rows
+
+    def delete(self, ids: Iterable[str]) -> None:
+        ids = list(ids)
+        for members in self.tenant_nodes.values():
+            members.difference_update(ids)
+        rows = [self.id_to_row.pop(i) for i in ids if i in self.id_to_row]
+        if not rows:
+            return
+        for r in rows:
+            self.row_to_id.pop(r, None)
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
+        self.state = S.arena_delete(self.state, jnp.asarray(padded))
+        self.edge_state = S.edges_delete_for_nodes(self.edge_state, jnp.asarray(padded))
+        self._free_rows.extend(rows)
+        dead = [k for k, slot in self.edge_slots.items()
+                if k[0] not in self.id_to_row or k[1] not in self.id_to_row]
+        for k in dead:
+            self._free_edge_slots.append(self.edge_slots.pop(k))
+
+    def search(self, query: np.ndarray, tenant: str, k: int = 10,
+               super_filter: int = 0) -> Tuple[List[str], List[float]]:
+        """Masked cosine top-k; returns (ids, scores), dead/padded hits dropped."""
+        if not self.id_to_row:
+            return [], []
+        tid = self._tenants.get(tenant)
+        if tid is None:
+            return [], []
+        k_eff = min(k, self.state.capacity)
+        scores, rows = S.arena_search(
+            self.state, jnp.asarray(np.asarray(query, np.float32)),
+            jnp.int32(tid), k_eff, super_filter)
+        scores = np.asarray(scores)
+        rows = np.asarray(rows)
+        ids, out_scores = [], []
+        for s, r in zip(scores, rows):
+            if s <= S.NEG_INF / 2:
+                continue
+            node_id = self.row_to_id.get(int(r))
+            if node_id is not None:
+                ids.append(node_id)
+                out_scores.append(float(s))
+        return ids, out_scores
+
+    # ------------------------------------------------------- numeric sweeps
+    def update_access(self, ids: Sequence[str], boost: float = 0.05,
+                      now: Optional[float] = None) -> None:
+        rows = [self.id_to_row[i] for i in ids if i in self.id_to_row]
+        if not rows:
+            return
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
+        self.state = S.arena_update_access(
+            self.state, jnp.asarray(padded),
+            jnp.float32((now if now is not None else time.time()) - self.epoch),
+            jnp.float32(boost))
+
+    def boost(self, ids: Sequence[str], boost: float = 0.02,
+              now: Optional[float] = None) -> None:
+        """Neighbor boost: salience bump + freshness, no access increment."""
+        rows = [self.id_to_row[i] for i in ids if i in self.id_to_row]
+        if not rows:
+            return
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
+        self.state = S.arena_boost(
+            self.state, jnp.asarray(padded),
+            jnp.float32((now if now is not None else time.time()) - self.epoch),
+            jnp.float32(boost))
+
+    def merge_touch(self, ids: Sequence[str], candidate_saliences: Sequence[float],
+                    now: Optional[float] = None) -> None:
+        """Dedup-merge: salience=max(old, candidate), access+1, refresh."""
+        rows, sals = [], []
+        for i, s in zip(ids, candidate_saliences):
+            if i in self.id_to_row:
+                rows.append(self.id_to_row[i])
+                sals.append(float(s))
+        if not rows:
+            return
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
+        sal = np.zeros((len(padded),), np.float32)
+        sal[:len(sals)] = sals
+        self.state = S.arena_merge_touch(
+            self.state, jnp.asarray(padded), jnp.asarray(sal),
+            jnp.float32((now if now is not None else time.time()) - self.epoch))
+
+    def decay(self, tenant: str, rate: float, salience_floor: float = 0.2) -> None:
+        tid = self._tenants.get(tenant)
+        if tid is None:
+            return
+        self.state = S.arena_decay(self.state, jnp.int32(tid), jnp.float32(rate),
+                                   jnp.float32(salience_floor))
+        self.edge_state = S.edges_decay(self.edge_state, jnp.int32(tid), jnp.float32(rate))
+
+    def evict_candidates(self, tenant: str, k: int, now: Optional[float] = None,
+                         weights: Tuple[float, float, float] = (0.5, 0.3, 0.2)
+                         ) -> List[Tuple[str, float]]:
+        """k least-important (id, importance) pairs for a tenant."""
+        tid = self._tenants.get(tenant)
+        if tid is None:
+            return []
+        # bucket k to a power of two so jit specializations stay bounded
+        k_bucket = min(self.state.capacity, max(8, 1 << (max(1, k - 1)).bit_length()))
+        imps, rows = S.arena_evict_candidates(
+            self.state, jnp.int32(tid),
+            jnp.float32((now if now is not None else time.time()) - self.epoch),
+            jnp.float32(weights[0]), jnp.float32(weights[1]), jnp.float32(weights[2]),
+            k_bucket)
+        out = []
+        for imp, r in zip(np.asarray(imps), np.asarray(rows)):
+            if not np.isfinite(imp):
+                continue
+            node_id = self.row_to_id.get(int(r))
+            if node_id is not None:
+                out.append((node_id, float(imp)))
+        return out[:k]
+
+    def link_candidates(self, new_ids: Sequence[str], tenant: str, k: int = 3,
+                        shard_mode: int = 0) -> Dict[str, List[Tuple[str, float]]]:
+        """Per new node: top-k (existing_id, cosine) candidates — one matmul."""
+        rows = [self.id_to_row[i] for i in new_ids if i in self.id_to_row]
+        if not rows:
+            return {}
+        tid = self._tenants.get(tenant)
+        if tid is None:
+            return {}
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
+        scores, cand = S.arena_link_candidates(
+            self.state, jnp.asarray(padded), jnp.int32(tid),
+            min(k, self.state.capacity), shard_mode)
+        scores = np.asarray(scores)
+        cand = np.asarray(cand)
+        out: Dict[str, List[Tuple[str, float]]] = {}
+        for bi, node_row in enumerate(rows):
+            node_id = self.row_to_id[node_row]
+            pairs = []
+            for s, c in zip(scores[bi], cand[bi]):
+                if s <= S.NEG_INF / 2:
+                    continue
+                cid = self.row_to_id.get(int(c))
+                if cid is not None:
+                    pairs.append((cid, float(s)))
+            out[node_id] = pairs
+        return out
+
+    def merge_candidates(self, tenant: str, threshold: float = 0.95
+                         ) -> List[Tuple[str, str, float]]:
+        """All-pairs near-duplicates (intended `_merge_similar_nodes` semantics,
+        not the reference's last-node bug): (keep_id, merge_id, sim) triples."""
+        tid = self._tenants.get(tenant)
+        if tid is None:
+            return []
+        mask = self.state.alive & (self.state.tenant_id == jnp.int32(tid)) & ~self.state.is_super
+        top_s, top_j = graphops.pairwise_merge_candidates(
+            self.state.emb.astype(jnp.float32), mask, jnp.float32(threshold), k=4)
+        top_s = np.asarray(top_s)
+        top_j = np.asarray(top_j)
+        out = []
+        for i in range(top_j.shape[0]):
+            a = self.row_to_id.get(i)
+            if a is None:
+                continue
+            for s, j in zip(top_s[i], top_j[i]):
+                if j < 0:
+                    continue
+                b = self.row_to_id.get(int(j))
+                if b is not None:
+                    out.append((a, b, float(s)))
+        return out
+
+    def mean_embedding(self, ids: Sequence[str]) -> np.ndarray:
+        rows = [self.id_to_row[i] for i in ids if i in self.id_to_row]
+        if not rows:
+            return np.zeros((self.dim,), np.float32)
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
+        return np.asarray(S.arena_mean_embedding(self.state, jnp.asarray(padded)))
+
+    def get_embedding(self, node_id: str) -> Optional[np.ndarray]:
+        r = self.id_to_row.get(node_id)
+        if r is None:
+            return None
+        return np.asarray(self.state.emb[r], np.float32)
+
+    def pull_numeric(self) -> Dict[str, np.ndarray]:
+        """One bulk device→host transfer of mutable numeric columns, for
+        syncing host Node objects after decay/boost sweeps."""
+        return {
+            "salience": np.asarray(self.state.salience),
+            "last_accessed": np.asarray(self.state.last_accessed) + self.epoch,
+            "access_count": np.asarray(self.state.access_count),
+        }
+
+    # ---------------------------------------------------------------- edges
+    def _alloc_edge_slots(self, n: int) -> List[int]:
+        while len(self._free_edge_slots) < n:
+            old = self.edge_state.capacity
+            self.edge_state = S.grow_edges(self.edge_state, old * 2)
+            self._free_edge_slots = list(range(old * 2 - 1, old - 1, -1)) + self._free_edge_slots
+        return [self._free_edge_slots.pop() for _ in range(n)]
+
+    def add_edges(self, triples: Sequence[Tuple[str, str, float]], tenant: str,
+                  reinforce: float = 0.1, now: Optional[float] = None) -> None:
+        """(src_id, tgt_id, weight) batch. Existing edges are reinforced
+        (+0.1 capped, co+1); new ones inserted."""
+        now = (now if now is not None else time.time()) - self.epoch
+        new, existing = [], []
+        for src, tgt, w in triples:
+            if src not in self.id_to_row or tgt not in self.id_to_row:
+                continue
+            key = (src, tgt)
+            if key in self.edge_slots:
+                existing.append(self.edge_slots[key])
+            else:
+                new.append((key, w))
+        if existing:
+            padded = S.pad_rows(np.asarray(existing, np.int32), self.edge_state.capacity)
+            self.edge_state = S.edges_reinforce(
+                self.edge_state, jnp.asarray(padded),
+                jnp.float32(reinforce), jnp.float32(now))
+        if new:
+            slots = self._alloc_edge_slots(len(new))
+            for (key, _), slot in zip(new, slots):
+                self.edge_slots[key] = slot
+            cap = self.edge_state.capacity
+            padded = S.pad_rows(np.asarray(slots, np.int32), cap)
+            b = len(padded)
+            src_r = np.full((b,), -1, np.int32)
+            tgt_r = np.full((b,), -1, np.int32)
+            w = np.zeros((b,), np.float32)
+            live = np.zeros((b,), bool)
+            for i, ((s_id, t_id), wt) in enumerate(new):
+                src_r[i] = self.id_to_row[s_id]
+                tgt_r[i] = self.id_to_row[t_id]
+                w[i] = wt
+                live[i] = True
+            self.edge_state = S.edges_add(
+                self.edge_state, jnp.asarray(padded), jnp.asarray(src_r),
+                jnp.asarray(tgt_r), jnp.asarray(w),
+                jnp.ones((b,), jnp.int32), jnp.float32(now),
+                jnp.int32(self.tenant_id(tenant)), jnp.asarray(live))
+
+    def prune_edges(self, tenant: str, threshold: float) -> List[Tuple[str, str]]:
+        tid = self._tenants.get(tenant)
+        if tid is None:
+            return []
+        self.edge_state, pruned = S.edges_prune(self.edge_state, jnp.int32(tid),
+                                                jnp.float32(threshold))
+        pruned = np.asarray(pruned)
+        removed = []
+        for key, slot in list(self.edge_slots.items()):
+            if pruned[slot]:
+                removed.append(key)
+                self._free_edge_slots.append(self.edge_slots.pop(key))
+        return removed
+
+    def edge_weights(self) -> Dict[Tuple[str, str], Tuple[float, int]]:
+        """Bulk pull of (weight, co_occurrence) for host Edge sync."""
+        w = np.asarray(self.edge_state.weight)
+        co = np.asarray(self.edge_state.co)
+        return {k: (float(w[slot]), int(co[slot])) for k, slot in self.edge_slots.items()}
+
+    def components(self) -> List[List[str]]:
+        """Connected components via device label propagation."""
+        n = self.state.capacity + 1
+        labels = graphops.connected_components(
+            self.edge_state.src, self.edge_state.tgt, self.edge_state.alive,
+            self.state.alive, n)
+        labels = np.asarray(labels)
+        groups: Dict[int, List[str]] = {}
+        for row, node_id in self.row_to_id.items():
+            lbl = int(labels[row])
+            if lbl >= 0:
+                groups.setdefault(lbl, []).append(node_id)
+        return list(groups.values())

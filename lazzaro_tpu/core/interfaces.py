@@ -1,0 +1,72 @@
+"""Pluggable provider and storage protocols.
+
+Parity target: reference ``src/lazzaro/core/interfaces.py`` (LLMProvider :16-31,
+EmbeddingProvider :47-52, Store :55-102). The protocols are kept so remote
+providers remain possible, but the defaults in this framework are the in-tree
+TPU implementations (``lazzaro_tpu.core.providers``): an on-device JAX encoder
+and an on-TPU decoder LM instead of HTTP APIs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class LLMProvider(Protocol):
+    """Chat-completion provider."""
+
+    def completion(self, messages: List[Dict[str, str]],
+                   response_format: Optional[Dict] = None) -> str:
+        """Return the assistant message text for a chat transcript."""
+        ...
+
+    def completion_stream(self, messages: List[Dict[str, str]],
+                          response_format: Optional[Dict] = None) -> Iterator[str]:
+        """Yield response chunks. Optional; callers must feature-detect."""
+        ...
+
+
+@runtime_checkable
+class EmbeddingProvider(Protocol):
+    """Text → vector provider. ``dim`` is first-class (the reference hardcoded
+    1536 into its store schema; see SURVEY §2.2 quirks)."""
+
+    dim: int
+
+    def embed(self, text: str) -> List[float]:
+        ...
+
+    def batch_embed(self, texts: List[str]) -> List[List[float]]:
+        ...
+
+
+@runtime_checkable
+class Store(Protocol):
+    """Durable persistence contract (11 methods, parity with reference
+    interfaces.py:55-102). The hot search path does NOT go through the store —
+    it hits the HBM arena; the store is the system of record for restarts and
+    for dashboard-style readers polling ``get_latest_version``."""
+
+    def add_nodes(self, nodes: List[Dict[str, Any]], user_id: str = "default") -> None: ...
+
+    def get_nodes(self, user_id: str = "default") -> List[Dict[str, Any]]: ...
+
+    def search_nodes(self, embedding: List[float], user_id: str = "default",
+                     limit: int = 10) -> List[str]: ...
+
+    def delete_nodes(self, node_ids: List[str], user_id: str = "default") -> None: ...
+
+    def get_latest_version(self) -> int: ...
+
+    def add_edges(self, edges: List[Dict[str, Any]], user_id: str = "default") -> None: ...
+
+    def get_edges(self, user_id: str = "default") -> List[Dict[str, Any]]: ...
+
+    def delete_edges(self, edge_ids: List[str], user_id: str = "default") -> None: ...
+
+    def save_profile(self, profile: Dict[str, Any], user_id: str = "default") -> None: ...
+
+    def load_profile(self, user_id: str = "default") -> Optional[Dict[str, Any]]: ...
+
+    def close(self) -> None: ...

@@ -1,0 +1,72 @@
+"""Per-topic subgraph (host structural view).
+
+Parity target: reference ``core/memory_shard.py`` (88 LoC). In the TPU build
+the shard is a *structural* record — node/edge membership, ids, strings. The
+numeric math that the reference runs in per-node Python loops here
+(``apply_temporal_decay`` :64-77, ``prune_weak_edges`` :79-84, neighbor scans
+:54-62) is executed batched on the device arena by ``MemorySystem``; the
+methods below remain for API parity and for standalone host-only use.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from lazzaro_tpu.models.graph import Edge, Node
+
+
+class MemoryShard:
+    def __init__(self, shard_key: str):
+        self.shard_key = shard_key
+        self.nodes: Dict[str, Node] = {}
+        self.edges: Dict[Tuple[str, str], Edge] = {}
+        self.last_accessed: float = time.time()
+        self.access_count: int = 0
+
+    def add_node(self, node: Node) -> None:
+        node.shard_key = self.shard_key
+        self.nodes[node.id] = node
+        self.last_accessed = time.time()
+
+    def add_edge(self, edge: Edge, reinforce: float = 0.1) -> None:
+        """New edge, or reinforce an existing one: weight += 0.1 (capped 1.0),
+        co_occurrence += 1 (reference memory_shard.py:42-52)."""
+        key = (edge.source, edge.target)
+        existing = self.edges.get(key)
+        if existing is not None:
+            existing.weight = min(1.0, existing.weight + reinforce)
+            existing.co_occurrence += 1
+            existing.last_updated = time.time()
+        else:
+            self.edges[key] = edge
+
+    def get_neighbors(self, node_id: str, min_weight: float = 0.0) -> List[str]:
+        """Bidirectional neighbor ids with weight >= min_weight."""
+        out: List[str] = []
+        for (src, tgt), edge in self.edges.items():
+            if edge.weight < min_weight:
+                continue
+            if src == node_id:
+                out.append(tgt)
+            elif tgt == node_id:
+                out.append(src)
+        return out
+
+    def apply_temporal_decay(self, decay_rate: float = 0.01,
+                             salience_floor: float = 0.2) -> None:
+        """Edge weights ×(1-rate); node salience decays asymptotically toward
+        the floor: s' = floor + (s - floor)(1 - rate)."""
+        for edge in self.edges.values():
+            edge.weight *= 1.0 - decay_rate
+        for node in self.nodes.values():
+            node.salience = salience_floor + (node.salience - salience_floor) * (1.0 - decay_rate)
+
+    def prune_weak_edges(self, threshold: float = 0.5) -> int:
+        weak = [k for k, e in self.edges.items() if e.weight < threshold]
+        for k in weak:
+            del self.edges[k]
+        return len(weak)
+
+    def size(self) -> Tuple[int, int]:
+        return len(self.nodes), len(self.edges)

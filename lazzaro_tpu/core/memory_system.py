@@ -1,0 +1,1308 @@
+"""MemorySystem: the orchestrator (TPU-native rebuild).
+
+Parity target: reference ``core/memory_system.py`` (1550 LoC) — same public
+method names and semantics (SURVEY §2.2), rebuilt on:
+- an HBM-resident SoA index (``core.index.MemoryIndex``) instead of LanceDB +
+  per-node Python similarity loops;
+- on-device providers by default (hashing embedder / heuristic LLM; swap in
+  the flax encoder + decoder LM or remote providers via the same protocols);
+- a single-writer consolidation worker guarded by one mutation lock — the
+  reference runs a ThreadPoolExecutor that mutates shards/counters unlocked
+  (a real data race, SURVEY §5 "design away").
+
+Semantic thresholds replicate the reference exactly (dedup 0.95, super-node
+gate 0.4, link gate 0.5, salience floor 0.2, importance 0.5/0.3/0.2, decay
+0.01, cap-5 retrieval); the known reference bugs are NOT replicated
+(`_merge_similar_nodes` indentation bug, dead `_get_relevant_shards`, broken
+CLI /save path — SURVEY §2.2 quirks).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+import numpy as np
+
+from lazzaro_tpu.config import MemoryConfig
+from lazzaro_tpu.core.buffer_graph import BufferGraph
+from lazzaro_tpu.core.index import MemoryIndex
+from lazzaro_tpu.core.memory_shard import MemoryShard
+from lazzaro_tpu.core.profile import Profile
+from lazzaro_tpu.core.providers import HashingEmbedder, HeuristicLLM, infer_topic
+from lazzaro_tpu.core.query_cache import QueryCache
+from lazzaro_tpu.core.store import ArrowStore
+from lazzaro_tpu.models.graph import Edge, Node
+
+
+class MemorySystem:
+    def __init__(
+        self,
+        enable_sharding: Optional[bool] = None,
+        enable_hierarchy: Optional[bool] = None,
+        enable_caching: Optional[bool] = None,
+        enable_async: Optional[bool] = None,
+        max_shard_size: Optional[int] = None,
+        super_node_threshold: Optional[int] = None,
+        auto_consolidate: Optional[bool] = None,
+        consolidate_every: Optional[int] = None,
+        auto_prune: Optional[bool] = None,
+        prune_threshold: Optional[float] = None,
+        max_buffer_size: Optional[int] = None,
+        load_from_disk: Optional[bool] = None,
+        db_dir: Optional[str] = None,
+        user_id: Optional[str] = None,
+        llm_provider=None,
+        embedding_provider=None,
+        store=None,
+        config: Optional[MemoryConfig] = None,
+        verbose: bool = True,
+    ):
+        # Explicit kwargs win; otherwise values come from the (possibly
+        # caller-supplied) MemoryConfig, whose defaults match the reference
+        # constructor (memory_system.py:63-84).
+        self.config = config or MemoryConfig()
+        cfg = self.config
+
+        def pick(kwarg, field):
+            if kwarg is not None:
+                setattr(cfg, field, kwarg)
+            return getattr(cfg, field)
+
+        self.enable_sharding = pick(enable_sharding, "enable_sharding")
+        self.enable_hierarchy = pick(enable_hierarchy, "enable_hierarchy")
+        self.enable_caching = pick(enable_caching, "enable_caching")
+        self.enable_async = pick(enable_async, "enable_async")
+        self.max_shard_size = pick(max_shard_size, "max_shard_size")
+        self.super_node_threshold = pick(super_node_threshold, "super_node_threshold")
+        self.auto_consolidate = pick(auto_consolidate, "auto_consolidate")
+        self.consolidate_every = pick(consolidate_every, "consolidate_every")
+        self.auto_prune = pick(auto_prune, "auto_prune")
+        self.prune_threshold = pick(prune_threshold, "prune_threshold")
+        self.max_buffer_size = pick(max_buffer_size, "max_buffer_size")
+        db_dir = pick(db_dir, "db_dir")
+        self.user_id = pick(user_id, "user_id")
+        load_from_disk = pick(load_from_disk, "load_from_disk")
+        self.verbose = verbose
+
+        self.llm = llm_provider if llm_provider is not None else HeuristicLLM()
+        self.embedder = (embedding_provider if embedding_provider is not None
+                         else HashingEmbedder(dim=cfg.embed_dim))
+        dim = getattr(self.embedder, "dim", None)
+        if not isinstance(dim, int) or dim <= 0:
+            dim = len(self.embedder.embed("dimension probe"))
+        self.embed_dim = dim
+
+        self.store = store if store is not None else ArrowStore(db_dir)
+        self.vector_store = self.store  # back-compat alias (reference :110)
+
+        self.shards: Dict[str, MemoryShard] = {}
+        self.super_nodes: Dict[str, Node] = {}
+        self.buffer = BufferGraph(self.shards, self.super_nodes)
+        self.profile = Profile()
+        self.index = MemoryIndex(dim, capacity=cfg.initial_capacity,
+                                 edge_capacity=cfg.max_edges)
+
+        self.query_cache = QueryCache(cfg.cache_size) if enable_caching else None
+
+        self.short_term_memory: List[Dict] = []
+        self.conversation_history: List[Dict] = []
+        self.conversation_active = False
+        self.conversation_count = 0
+        self.node_counter = 0
+        self.consolidation_queue: List[Dict] = []
+
+        # Single-writer ingest: one worker thread + one mutation lock.
+        self._mutex = threading.RLock()
+        self.background_executor = (ThreadPoolExecutor(max_workers=1)
+                                    if enable_async else None)
+
+        self.metrics = {
+            "embedding_calls": 0,
+            "llm_calls": 0,
+            "retrieval_times": [],
+            "consolidation_times": [],
+        }
+        self._last_version = -1
+
+        if load_from_disk:
+            self._load_from_persistence()
+
+    # ------------------------------------------------------------------ util
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(msg)
+
+    def _q(self, node_id: str) -> str:
+        """Tenant-qualified index key (node ids like 'node_1' repeat per user)."""
+        return f"{self.user_id}:{node_id}"
+
+    def _generate_node_id(self) -> str:
+        self.node_counter += 1
+        return f"node_{self.node_counter}"
+
+    def _infer_shard_key(self, content: str) -> str:
+        """Keyword topic routing, fallback = current month (parity :152-169)."""
+        if not self.enable_sharding:
+            return "default"
+        topic = infer_topic(content)
+        if topic != "other":
+            return topic
+        return time.strftime("%Y-%m")
+
+    def _get_or_create_shard(self, shard_key: str) -> MemoryShard:
+        if shard_key not in self.shards:
+            self.shards[shard_key] = MemoryShard(shard_key)
+        return self.shards[shard_key]
+
+    def _get_embedding(self, text: str) -> List[float]:
+        self.metrics["embedding_calls"] += 1
+        if self.query_cache:
+            cached = self.query_cache.get_embedding(text)
+            if cached:
+                return cached
+        embedding = self.embedder.embed(text)
+        if self.query_cache:
+            self.query_cache.set_embedding(text, embedding)
+        return embedding
+
+    def _batch_embed(self, texts: List[str]) -> List[List[float]]:
+        if not texts:
+            return []
+        self.metrics["embedding_calls"] += 1
+        return self.embedder.batch_embed(texts)
+
+    def _cosine_similarity(self, v1, v2) -> float:
+        if v1 is None or v2 is None or len(v1) == 0 or len(v2) == 0:
+            return 0.0
+        a, b = np.asarray(v1, np.float32), np.asarray(v2, np.float32)
+        norm = np.linalg.norm(a) * np.linalg.norm(b)
+        return float(np.dot(a, b) / norm) if norm > 0 else 0.0
+
+    def _call_llm(self, messages: List[Dict], response_format: Optional[Dict] = None) -> str:
+        self.metrics["llm_calls"] += 1
+        return self.llm.completion(messages, response_format)
+
+    # -------------------------------------------------------- device ↔ host
+    def _index_add_node(self, node: Node) -> None:
+        self.index.add(
+            [self._q(node.id)],
+            np.asarray(node.embedding, np.float32).reshape(1, -1),
+            [node.salience], [node.timestamp], [node.type],
+            [node.shard_key or "default"], self.user_id,
+            [node.is_super_node])
+
+    def _sync_from_arena(self) -> None:
+        """One bulk device→host pull; refresh mutable numerics on host nodes
+        and edges so the structural record matches the arena."""
+        cols = self.index.pull_numeric()
+        for qid, row in self.index.id_to_row.items():
+            user, _, nid = qid.partition(":")
+            if user != self.user_id:
+                continue
+            node = self.buffer.get_node(nid)
+            if node is None:
+                continue
+            node.salience = float(cols["salience"][row])
+            node.last_accessed = float(cols["last_accessed"][row])
+            node.access_count = int(cols["access_count"][row])
+        for (qsrc, qtgt), (w, co) in self.index.edge_weights().items():
+            user, _, src = qsrc.partition(":")
+            if user != self.user_id:
+                continue
+            tgt = qtgt.partition(":")[2]
+            for shard in self.shards.values():
+                edge = shard.edges.get((src, tgt))
+                if edge is not None:
+                    edge.weight = w
+                    edge.co_occurrence = co
+                    break
+
+    # --------------------------------------------------------------- session
+    def start_conversation(self) -> str:
+        self.conversation_active = True
+        self.short_term_memory = []
+        self.conversation_history = []
+        return "✓ Conversation started"
+
+    def add_to_short_term(self, content: str, memory_type: str = "semantic",
+                          salience: float = 0.5) -> None:
+        if not self.conversation_active:
+            raise RuntimeError("No active conversation")
+        self.short_term_memory.append({
+            "content": content,
+            "type": memory_type,
+            "salience": salience,
+            "timestamp": time.time(),
+        })
+        self._auto_save_if_needed()
+
+    def _auto_save_if_needed(self) -> None:
+        # Saving happens at end/consolidation (parity: no-op stub :238-240).
+        pass
+
+    def end_conversation(self) -> str:
+        if not self.conversation_active:
+            return "⚠ No active conversation to end."
+        self.conversation_active = False
+        if not self.short_term_memory:
+            return "✓ Conversation ended. No memories to consolidate."
+
+        results = []
+        if self.enable_async and self.background_executor:
+            self._log(f"🔄 Queueing consolidation for {len(self.short_term_memory)} exchanges...")
+            with self._mutex:
+                self.consolidation_queue.append({
+                    "memories": self.short_term_memory.copy(),
+                    "timestamp": time.time(),
+                })
+            self.background_executor.submit(self._async_consolidate)
+            results.append("✓ Conversation ended (consolidation queued)")
+        else:
+            self._log(f"🔄 Consolidating {len(self.short_term_memory)} exchanges...")
+            results.append(self._consolidate_to_buffer())
+
+        with self._mutex:
+            self.index.decay(self.user_id, self.config.decay_rate,
+                             self.config.salience_floor)
+            if self.auto_prune:
+                pruned = self._prune_weak_edges(self.prune_threshold)
+                if pruned > 0:
+                    results.append(f"✓ Auto-pruned {pruned} weak edges")
+            self._sync_from_arena()
+        results.append("✓ Applied temporal decay")
+
+        self._enforce_buffer_limit()
+        self.conversation_count += 1
+
+        if self.auto_consolidate and self.conversation_count % self.consolidate_every == 0:
+            self._log(f"🔄 Auto-consolidation triggered (every {self.consolidate_every} conversations)...")
+            results.append(self.run_consolidation())
+
+        self.short_term_memory = []
+        self.conversation_history = []
+        self._save_to_persistence()
+        return "\n".join(results)
+
+    def _prune_weak_edges(self, threshold: float) -> int:
+        """Device prune + host structural cleanup; returns count removed."""
+        removed = self.index.prune_edges(self.user_id, threshold)
+        count = 0
+        for qsrc, qtgt in removed:
+            src = qsrc.partition(":")[2]
+            tgt = qtgt.partition(":")[2]
+            for shard in self.shards.values():
+                if (src, tgt) in shard.edges:
+                    del shard.edges[(src, tgt)]
+                    count += 1
+                    break
+        if self.query_cache:
+            self.query_cache.invalidate_results()
+        return count
+
+    # ------------------------------------------------------------------ chat
+    def chat(self, user_message: str) -> str:
+        if not self.conversation_active:
+            self._log(self.start_conversation())
+
+        start_time = time.time()
+        self.add_to_short_term(user_message, "episodic", salience=0.7)
+        self.conversation_history.append({"role": "user", "content": user_message})
+
+        query_emb = self._get_embedding(user_message)
+        retrieved_ids = self._optimized_retrieval(query_emb, user_message)
+        self._boost_neighbors(retrieved_ids)
+
+        retrieval_time = (time.time() - start_time) * 1000
+        self.metrics["retrieval_times"].append(retrieval_time)
+
+        messages = self._assemble_messages(retrieved_ids)
+        response = self._call_llm(messages)
+        self.add_to_short_term(response, "semantic", salience=0.5)
+        self.conversation_history.append({"role": "assistant", "content": response})
+
+        emoji = "⚡" if retrieval_time < 100 else ("✓" if retrieval_time < 200 else "⏱")
+        self._log(f"[{emoji} Retrieval: {retrieval_time:.0f}ms, Retrieved: {len(retrieved_ids)} nodes]")
+        if retrieved_ids and self.verbose:
+            self._log("   Retrieved Nodes:")
+            for nid in retrieved_ids:
+                node = self.buffer.get_node(nid)
+                if node:
+                    snippet = node.content[:60] + "..." if len(node.content) > 60 else node.content
+                    self._log(f"   • [{nid}] ({node.shard_key}) {snippet}")
+        return response
+
+    def chat_stream(self, user_message: str) -> Iterator[Dict[str, str]]:
+        """Yields {"type": "info"|"token", "content": ...} dicts (parity :353-451)."""
+        if not self.conversation_active:
+            self.start_conversation()
+            yield {"type": "info", "content": "✓ Conversation started"}
+
+        start_time = time.time()
+        self.add_to_short_term(user_message, "episodic", salience=0.7)
+        self.conversation_history.append({"role": "user", "content": user_message})
+
+        query_emb = self._get_embedding(user_message)
+        retrieved_ids = self._optimized_retrieval(query_emb, user_message)
+        self._boost_neighbors(retrieved_ids)
+
+        retrieval_time = (time.time() - start_time) * 1000
+        self.metrics["retrieval_times"].append(retrieval_time)
+        emoji = "⚡" if retrieval_time < 100 else ("✓" if retrieval_time < 200 else "⏱")
+        yield {"type": "info",
+               "content": f"[{emoji} Retrieval: {retrieval_time:.0f}ms, Retrieved: {len(retrieved_ids)} nodes]"}
+
+        messages = self._assemble_messages(retrieved_ids)
+        self.metrics["llm_calls"] += 1
+        chunks: List[str] = []
+        if hasattr(self.llm, "completion_stream"):
+            for chunk in self.llm.completion_stream(messages):
+                chunks.append(chunk)
+                yield {"type": "token", "content": chunk}
+            response = "".join(chunks)
+        else:
+            response = self.llm.completion(messages)
+            yield {"type": "token", "content": response}
+
+        self.add_to_short_term(response, "semantic", salience=0.5)
+        self.conversation_history.append({"role": "assistant", "content": response})
+
+    def _assemble_messages(self, retrieved_ids: List[str]) -> List[Dict[str, str]]:
+        context_parts = []
+        profile_context = self.profile.get_context()
+        if profile_context and profile_context != "No profile data yet.":
+            context_parts.append(f"User Profile:\n{profile_context}\n")
+
+        if retrieved_ids:
+            memory_texts = []
+            access_ids = []
+            for nid in retrieved_ids:
+                node = self.buffer.get_node(nid)
+                if node:
+                    memory_texts.append(f"- {node.content}")
+                    access_ids.append(nid)
+            if access_ids:
+                with self._mutex:
+                    self.index.update_access(
+                        [self._q(n) for n in access_ids],
+                        boost=self.config.access_salience_boost)
+                for nid in access_ids:
+                    self.buffer.update_access(nid, self.config.access_salience_boost)
+            if memory_texts:
+                context_parts.append(
+                    "Relevant Information from Past Conversations (Use if relevant to the query):\n"
+                    + "\n".join(memory_texts) + "\n")
+
+        system_prompt = ("You are a helpful assistant with access to the user's profile "
+                         "and past memories. Use the provided context ONLY if it is relevant "
+                         "to the user's current query. Do not force the information if it "
+                         "doesn't fit naturally.")
+        messages = [{"role": "system", "content": system_prompt}]
+        if context_parts:
+            messages.append({"role": "system", "content": "\n".join(context_parts)})
+        messages.extend(self.conversation_history[-self.config.history_window:])
+        return messages
+
+    # ------------------------------------------------------------- retrieval
+    def _optimized_retrieval(self, query_emb: List[float], query_text: str) -> List[str]:
+        if self.query_cache:
+            cached = self.query_cache.get_results(query_text)
+            if cached:
+                return cached
+
+        q = np.asarray(query_emb, np.float32)
+        retrieved: List[str] = []
+
+        # 1. Hierarchy fast path: one masked top-k over super-node rows
+        #    (replaces the O(#super × d) Python scan, memory_system.py:464-482).
+        if self.enable_hierarchy and self.super_nodes:
+            sids, sscores = self.index.search(q, self.user_id, k=1, super_filter=1)
+            if sids and sscores[0] > self.config.super_node_gate:
+                best = self.super_nodes.get(sids[0].partition(":")[2])
+                if best is not None:
+                    for child_id in best.child_ids[:self.config.hierarchy_children]:
+                        child = self.buffer.get_node(child_id)
+                        if child and not child.is_super_node:
+                            retrieved.append(child_id)
+                    if len(retrieved) >= self.config.retrieval_cap:
+                        result = retrieved[:self.config.retrieval_cap]
+                        if self.query_cache:
+                            self.query_cache.set_results(query_text, result)
+                        return result
+
+        # 2. Arena ANN (replaces LanceDB search_nodes)
+        limit = self.config.ann_limit if not retrieved else self.config.retrieval_cap
+        vec_ids, _ = self.index.search(q, self.user_id, k=limit, super_filter=-1)
+        vector_ids = [v.partition(":")[2] for v in vec_ids]
+
+        seen_ids: Set[str] = set(retrieved)
+        seen_content: Set[str] = set()
+        final: List[str] = []
+        for rid in retrieved:
+            node = self.buffer.get_node(rid)
+            if node:
+                seen_content.add(node.content)
+                final.append(rid)
+        for rid in vector_ids:
+            if rid in seen_ids:
+                continue
+            node = self.buffer.get_node(rid)
+            if node and node.content not in seen_content:
+                seen_content.add(node.content)
+                final.append(rid)
+                seen_ids.add(rid)
+
+        final = final[:self.config.retrieval_cap]
+        if self.query_cache:
+            self.query_cache.set_results(query_text, final)
+        return final
+
+    def _boost_neighbors(self, retrieved_ids: List[str]) -> None:
+        neighbors: Set[str] = set()
+        for nid in retrieved_ids:
+            neighbors.update(self.buffer.get_neighbors(nid))
+        to_boost = [n for n in neighbors if n not in set(retrieved_ids)]
+        if not to_boost:
+            return
+        now = time.time()
+        with self._mutex:
+            self.index.boost([self._q(n) for n in to_boost],
+                             self.config.neighbor_salience_boost, now)
+        count = 0
+        for nid in to_boost:
+            node = self.buffer.get_node(nid)
+            if node:
+                node.last_accessed = now
+                node.salience = min(1.0, node.salience + self.config.neighbor_salience_boost)
+                count += 1
+        if count:
+            self._log(f"   (Graph: Boosted {count} neighbor nodes via association)")
+
+    # ---------------------------------------------------------- consolidation
+    def _consolidate_to_buffer(self) -> str:
+        with self._mutex:
+            self.consolidation_queue.append({
+                "memories": self.short_term_memory.copy(),
+                "timestamp": time.time(),
+            })
+        self._async_consolidate()
+        nodes, edges = self.buffer.size()
+        return f"✓ Consolidation complete. Memory: {nodes} nodes, {edges} edges"
+
+    _EXTRACTION_PROMPT = """Extract distinct, atomic facts from this conversation.
+Categorization Guidelines:
+1. semantic: Stable facts, preferences, or knowledge (e.g., "User likes Python", "User lives in London").
+2. episodic: Specific events, occurrences, or recent activities (e.g., "User started a new job today", "User fixed a bug in the API").
+3. procedural: Processes, workflows, or instructions (e.g., "User follows the git-flow model", "User prefers TDD for testing").
+
+Format Rules:
+- Formulate facts in the THIRD PERSON.
+- Abstract from conversational filler.
+- If no new facts, return empty list.
+
+Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedural", "salience": 0.0-1.0, "topic": "work|personal|learning|health|other"}]}
+"""
+
+    def _async_consolidate(self) -> None:
+        with self._mutex:
+            if not self.consolidation_queue:
+                return
+            all_memories: List[Dict] = []
+            for batch in self.consolidation_queue:
+                all_memories.extend(batch["memories"])
+            self.consolidation_queue.clear()
+
+        start_time = time.time()
+        self._log(f"🔄 Processing {len(all_memories)} memories in background...")
+
+        conv_text = json.dumps(all_memories)
+        response = self._call_llm(
+            [{"role": "system", "content": self._EXTRACTION_PROMPT},
+             {"role": "user", "content": conv_text}],
+            response_format={"type": "json_object"})
+
+        try:
+            if "```json" in response:
+                response = response.split("```json")[1].split("```")[0].strip()
+            data = json.loads(response)
+            if isinstance(data, dict):
+                memories = data.get("memories", [])
+            elif isinstance(data, list):
+                memories = data
+            else:
+                self._log(f"⚠ Unexpected data type: {type(data)}")
+                return
+        except json.JSONDecodeError as e:
+            self._log(f"⚠ Parse error: {e}")
+            return
+
+        memories = [m for m in memories if isinstance(m, dict)]
+        self._log(f"✓ Extracted {len(memories)} memory candidates")
+        contents = [m.get("content", "") for m in memories if m.get("content")]
+        embeddings = self._batch_embed(contents)
+
+        with self._mutex:
+            new_nodes: List[Tuple[str, str]] = []
+            new_nodes_data: List[Dict] = []
+            ei = 0
+            for mem in memories:
+                content = mem.get("content", "")
+                if not content:
+                    continue
+                new_emb = embeddings[ei] if ei < len(embeddings) else []
+                ei += 1
+                if len(content) < 5:
+                    continue
+
+                shard_key = mem.get("topic") or self._infer_shard_key(content)
+                if shard_key == "other":
+                    shard_key = self._infer_shard_key(content)
+                shard = self._get_or_create_shard(shard_key)
+
+                # Dedup probe: nearest neighbor, cosine > 0.95 ⇒ merge
+                existing_node = None
+                if len(new_emb):
+                    ids, scores = self.index.search(
+                        np.asarray(new_emb, np.float32), self.user_id, k=1,
+                        super_filter=-1)
+                    if ids and scores[0] > self.config.dedup_similarity:
+                        existing_node = self.buffer.get_node(ids[0].partition(":")[2])
+
+                if existing_node is not None:
+                    cand_sal = float(mem.get("salience", 0.5))
+                    self.index.merge_touch([self._q(existing_node.id)], [cand_sal])
+                    existing_node.salience = max(existing_node.salience, cand_sal)
+                    existing_node.last_accessed = time.time()
+                    existing_node.access_count += 1
+                    self._log(f"   (Merged semantic duplicate into {existing_node.id})")
+                    continue
+
+                node_id = self._generate_node_id()
+                node = Node(
+                    id=node_id,
+                    content=content,
+                    embedding=new_emb,
+                    type=mem.get("type", "semantic"),
+                    salience=float(mem.get("salience", 0.5)),
+                    shard_key=shard_key,
+                )
+                shard.add_node(node)
+                self._index_add_node(node)
+                new_nodes.append((node_id, shard_key))
+                new_nodes_data.append({
+                    "id": node_id,
+                    "content": content,
+                    "embedding": list(map(float, new_emb)),
+                    "type": node.type,
+                    "salience": node.salience,
+                    "shard_key": node.shard_key,
+                    "timestamp": node.timestamp,
+                })
+
+            if new_nodes_data:
+                self.store.add_nodes(new_nodes_data, user_id=self.user_id)
+
+            self._link_within_shards(new_nodes)
+            self._link_to_existing_memories(new_nodes)
+
+        self._enforce_buffer_limit()
+
+        if self.enable_hierarchy:
+            with self._mutex:
+                for shard_key in {sk for _, sk in new_nodes}:
+                    shard = self.shards.get(shard_key)
+                    if shard and len(shard.nodes) > self.super_node_threshold:
+                        self._create_super_nodes_for_shard(shard_key)
+
+        if self.query_cache:
+            self.query_cache.invalidate_results()
+
+        elapsed = time.time() - start_time
+        self.metrics["consolidation_times"].append(elapsed)
+        self._log(f"✓ Background consolidation complete ({elapsed:.2f}s)")
+        self._save_to_persistence()
+
+    def _add_edge(self, edge: Edge) -> None:
+        """Insert into both the host shard record and the edge arena."""
+        shard = None
+        for s in self.shards.values():
+            if edge.source in s.nodes:
+                shard = s
+                break
+        if shard is None:
+            shard = self._get_or_create_shard("default")
+        shard.add_edge(edge, reinforce=self.config.edge_reinforce)
+        self.index.add_edges([(self._q(edge.source), self._q(edge.target), edge.weight)],
+                             self.user_id, reinforce=self.config.edge_reinforce)
+
+    def _link_within_shards(self, new_nodes: List[Tuple[str, str]]) -> None:
+        """Chain consecutive new nodes (w=0.5) + top-3 same-shard cosine>0.5
+        links (w=sim·0.8). The similarity scan is one batched matmul on the
+        arena (replaces hot loop #2, memory_system.py:797-836)."""
+        by_shard: Dict[str, List[str]] = {}
+        for node_id, shard_key in new_nodes:
+            by_shard.setdefault(shard_key, []).append(node_id)
+
+        for shard_key, node_ids in by_shard.items():
+            if len(node_ids) >= 2:
+                for a, b in zip(node_ids, node_ids[1:]):
+                    self._add_edge(Edge(source=a, target=b,
+                                        weight=self.config.chain_link_weight))
+
+        all_new = [nid for nid, _ in new_nodes]
+        if not all_new:
+            return
+        cands = self.index.link_candidates(
+            [self._q(n) for n in all_new], self.user_id,
+            k=self.config.cross_link_top_k, shard_mode=1)
+        for qid, pairs in cands.items():
+            nid = qid.partition(":")[2]
+            for qcand, sim in pairs:
+                if sim > self.config.link_gate:
+                    self._add_edge(Edge(source=nid,
+                                        target=qcand.partition(":")[2],
+                                        weight=sim * self.config.link_weight_scale))
+
+    def _link_to_existing_memories(self, new_nodes: List[Tuple[str, str]]) -> None:
+        """Top-3 cross-links across ALL existing memories (any shard), gate
+        0.5, weight sim·0.8, dedup both directions (replaces hot loop #3,
+        memory_system.py:838-891)."""
+        if not new_nodes:
+            return
+        cands = self.index.link_candidates(
+            [self._q(n) for n, _ in new_nodes], self.user_id,
+            k=self.config.cross_link_top_k, shard_mode=0)
+        links_created = 0
+        for qid, pairs in cands.items():
+            nid = qid.partition(":")[2]
+            for qcand, sim in pairs:
+                if sim <= self.config.link_gate:
+                    continue
+                cand = qcand.partition(":")[2]
+                exists = any((nid, cand) in s.edges or (cand, nid) in s.edges
+                             for s in self.shards.values())
+                if not exists:
+                    self._add_edge(Edge(source=nid, target=cand,
+                                        weight=sim * self.config.link_weight_scale))
+                    links_created += 1
+        if links_created:
+            self._log(f"✓ Created {links_created} cross-conversation links")
+
+    def _create_super_nodes_for_shard(self, shard_key: str) -> None:
+        shard = self.shards[shard_key]
+        if len(shard.nodes) < self.super_node_threshold:
+            return
+        if any(n.shard_key == shard_key for n in self.super_nodes.values()):
+            return
+
+        self._log(f"  Creating super-node for shard '{shard_key}' ({len(shard.nodes)} nodes)")
+        nodes = list(shard.nodes.values())
+        super_id = f"super_{shard_key}_{int(time.time())}"
+        samples = [n.content for n in nodes[:3]]
+        aggregated = f"Topic: {shard_key}. Contains memories about: " + "; ".join(samples)
+
+        # Centroid on device: mean of child embeddings (memory_system.py:916-917)
+        avg = self.index.mean_embedding([self._q(n.id) for n in nodes])
+
+        super_node = Node(
+            id=super_id,
+            content=aggregated,
+            embedding=avg.tolist(),
+            type="semantic",
+            is_super_node=True,
+            child_ids=[n.id for n in nodes],
+            shard_key=shard_key,
+        )
+        for node in nodes:
+            node.parent_id = super_id
+        self.super_nodes[super_id] = super_node
+        self._index_add_node(super_node)
+        self._log(f"  ✓ Created super-node {super_id} with {len(nodes)} children")
+
+    # -------------------------------------------------------------- forgetting
+    def _enforce_buffer_limit(self) -> None:
+        with self._mutex:
+            nodes, _ = self.buffer.size()
+            if nodes <= self.max_buffer_size:
+                return
+            excess = nodes - self.max_buffer_size
+            cands = self.index.evict_candidates(self.user_id, excess)
+            removed_ids = []
+            for qid, _imp in cands[:excess]:
+                nid = qid.partition(":")[2]
+                node = self.buffer.get_node(nid)
+                if node is None or node.is_super_node:
+                    continue
+                shard = self.shards.get(node.shard_key)
+                if shard and nid in shard.nodes:
+                    del shard.nodes[nid]
+                    # cross-links live in the SOURCE node's shard, so scan all
+                    # shards — not just the evictee's own (the reference only
+                    # cleans the home shard, leaving dangling edges).
+                    for s in self.shards.values():
+                        for key in [k for k in s.edges
+                                    if k[0] == nid or k[1] == nid]:
+                            del s.edges[key]
+                    removed_ids.append(nid)
+            if removed_ids:
+                self.index.delete([self._q(n) for n in removed_ids])
+                self.store.delete_nodes(removed_ids, user_id=self.user_id)
+                if self.query_cache:
+                    self.query_cache.invalidate_results()
+                self._log(f"⚠ Buffer limit reached! Archived {len(removed_ids)} old nodes "
+                          f"(limit: {self.max_buffer_size})")
+
+    # ------------------------------------------------------ deep consolidation
+    def run_consolidation(self, weight_threshold: float = 0.6,
+                          merge_similar: bool = True) -> str:
+        results = []
+        self._log("🔄 Running consolidation...")
+
+        if merge_similar:
+            merged = self._merge_similar_nodes(self.config.merge_similarity)
+            if merged > 0:
+                results.append(f"✓ Merged {merged} similar nodes")
+
+        components = self.buffer.get_connected_components()
+        profile_updates = 0
+        for component in components:
+            if len(component) < self.config.component_min_size:
+                continue
+            component_edges = [e for s in self.shards.values()
+                               for (src, tgt), e in s.edges.items()
+                               if src in component and tgt in component]
+            if not component_edges:
+                continue
+            avg_weight = sum(e.weight for e in component_edges) / len(component_edges)
+            if avg_weight > self.config.component_min_avg_weight:
+                update = self._extract_profile_from_component(component)
+                if "Updated" in update:
+                    profile_updates += 1
+                    results.append(update)
+
+        pruned = self._prune_weak_edges(self.prune_threshold)
+        if pruned > 0:
+            results.append(f"✓ Pruned {pruned} weak edges")
+
+        if profile_updates > 0:
+            results.append(f"✓ Updated {profile_updates} profile domains")
+        else:
+            all_contents = [n.content for n in self.buffer.nodes.values()
+                            if not n.is_super_node]
+            if len(all_contents) >= self.config.component_min_size:
+                update = self._extract_profile_from_contents(all_contents)
+                if "Updated" in update:
+                    results.append(update)
+
+        if not results:
+            results.append("✓ No consolidation actions needed")
+        return "\n".join(results)
+
+    def _extract_profile_from_component(self, component: Set[str]) -> str:
+        contents = []
+        for nid in component:
+            node = self.buffer.get_node(nid)
+            if node and not node.is_super_node:
+                contents.append(node.content)
+        if not contents:
+            return "No content to extract"
+        return self._extract_profile_from_contents(contents)
+
+    _PROFILE_PROMPT = """Analyze these related memories and generate brief, factual personality insights (1-2 sentences each).
+Identify all applicable domains: preferences, personality_traits, knowledge_domains, interaction_style, or key_experiences.
+Return a JSON object where keys are the domain names and values are the specific insights.
+Example: {"preferences": "User prefers Python for data science.", "knowledge_domains": "Exhibits deep expertise in memory systems."}"""
+
+    def _extract_profile_from_contents(self, contents: List[str]) -> str:
+        if not contents:
+            return "No content to extract"
+        prompt = "Related memories:\n" + "\n".join(f"- {c}" for c in contents[:10])
+        response = self._call_llm(
+            [{"role": "system", "content": self._PROFILE_PROMPT},
+             {"role": "user", "content": prompt}],
+            response_format={"type": "json_object"})
+        try:
+            if "```json" in response:
+                response = response.split("```json")[1].split("```")[0].strip()
+            data = json.loads(response)
+            updated_any = False
+            for domain, insight in data.items():
+                if domain in self.profile.data and insight:
+                    current = self.profile.data.get(domain, "")
+                    if current and insight not in current:
+                        updated = f"{current}. {insight}".strip()
+                    else:
+                        updated = insight
+                    self.profile.update_domain(domain, updated)
+                    self._log(f"  ✓ Profile updated: {domain} = {insight[:50]}...")
+                    updated_any = True
+            if updated_any:
+                return "✓ Updated profile domains"
+        except json.JSONDecodeError as e:
+            self._log(f"  ⚠ JSON parse error: {e}")
+        return "Failed to extract profile"
+
+    def _merge_similar_nodes(self, similarity_threshold: float = 0.95) -> int:
+        """All-pairs near-duplicate merge — the *intended* semantics of the
+        reference (its :1073-1077 indentation bug only merges duplicates of
+        the last node; SURVEY §2.2 says build the intended version). Pair
+        discovery is one arena matmul; merging is host bookkeeping."""
+        with self._mutex:
+            if len(self.buffer.nodes) < 2:
+                return 0
+            pairs = self.index.merge_candidates(self.user_id, similarity_threshold)
+            merged_count = 0
+            absorbed: Set[str] = set()
+            for qkeep, qmerge, _sim in pairs:
+                user, _, keep_id = qkeep.partition(":")
+                if user != self.user_id:
+                    continue
+                merge_id = qmerge.partition(":")[2]
+                if keep_id in absorbed or merge_id in absorbed:
+                    continue
+                node1 = self.buffer.get_node(keep_id)
+                node2 = self.buffer.get_node(merge_id)
+                if node1 is None or node2 is None or node1.is_super_node or node2.is_super_node:
+                    continue
+
+                node1.content = f"{node1.content} | {node2.content}"
+                node1.salience = max(node1.salience, node2.salience)
+                node1.access_count += node2.access_count
+
+                # Rewire edges in EVERY shard (cross-links live in the source
+                # node's shard, not necessarily the merged node's).
+                for shard in self.shards.values():
+                    rewires = []
+                    for (src, tgt) in list(shard.edges.keys()):
+                        if src == merge_id:
+                            rewires.append(((src, tgt), (keep_id, tgt)))
+                        elif tgt == merge_id:
+                            rewires.append(((src, tgt), (src, keep_id)))
+                    for old_key, new_key in rewires:
+                        edge = shard.edges.pop(old_key)
+                        edge.source, edge.target = new_key
+                        if new_key[0] != new_key[1]:
+                            shard.edges[new_key] = edge
+                            self.index.add_edges(
+                                [(self._q(new_key[0]), self._q(new_key[1]), edge.weight)],
+                                self.user_id)
+                    if merge_id in shard.nodes:
+                        del shard.nodes[merge_id]
+
+                self.index.merge_touch([qkeep], [node1.salience])
+                self.index.delete([qmerge])
+                absorbed.add(merge_id)
+                merged_count += 1
+
+                self.store.delete_nodes([merge_id], user_id=self.user_id)
+                self.store.add_nodes([{
+                    "id": keep_id,
+                    "content": node1.content,
+                    "embedding": [float(x) for x in (node1.embedding
+                                                     if node1.embedding is not None else [])],
+                    "type": node1.type,
+                    "salience": node1.salience,
+                    "shard_key": node1.shard_key,
+                    "timestamp": node1.timestamp,
+                }], user_id=self.user_id)
+            if merged_count and self.query_cache:
+                self.query_cache.invalidate_results()
+            return merged_count
+
+    # ------------------------------------------------------------ multi-tenant
+    def _drain_background(self) -> None:
+        """Barrier on the single-worker executor: any queued consolidation for
+        the current user completes before we proceed (prevents the queued
+        batch from being ingested under a different user_id)."""
+        if self.background_executor:
+            self.background_executor.submit(lambda: None).result()
+
+    def switch_user(self, new_user_id: str) -> None:
+        if self.conversation_active:
+            self.end_conversation()       # saves after consolidation
+            self._drain_background()
+        else:
+            self._drain_background()
+            self._save_to_persistence()
+        self.user_id = new_user_id
+        self._load_from_persistence()
+        self._log(f"👤 Switched context to user: {new_user_id}")
+
+    def get_all_users(self) -> List[str]:
+        if hasattr(self.store, "get_all_users"):
+            users = self.store.get_all_users()
+            return users if users else [self.user_id]
+        return [self.user_id]
+
+    # ----------------------------------------------------------------- search
+    def search_memories(self, query: str, limit: int = 5) -> List[Node]:
+        query_emb = self._get_embedding(query)
+        ids, _ = self.index.search(np.asarray(query_emb, np.float32),
+                                   self.user_id, k=limit, super_filter=-1)
+        results = []
+        for qid in ids:
+            node = self.buffer.get_node(qid.partition(":")[2])
+            if node:
+                results.append(node)
+        return results
+
+    def get_connected_memories(self, node_id: str) -> List[Node]:
+        connected: Set[str] = set()
+        for shard in self.shards.values():
+            for (src, tgt) in shard.edges:
+                if src == node_id:
+                    connected.add(tgt)
+                elif tgt == node_id:
+                    connected.add(src)
+        return [n for n in (self.buffer.get_node(c) for c in connected) if n]
+
+    # ------------------------------------------------------------ persistence
+    def _save_to_persistence(self) -> None:
+        """Full rewrite of the user's durable rows (parity with
+        memory_system.py:1275-1302: delete-all + re-insert)."""
+        with self._mutex:
+            self._sync_from_arena()
+            nodes_data = []
+            for shard in self.shards.values():
+                for node in shard.nodes.values():
+                    nodes_data.append(self._node_row(node))
+            for node in self.super_nodes.values():
+                nodes_data.append(self._node_row(node))
+            edges_data = []
+            for shard in self.shards.values():
+                for edge in shard.edges.values():
+                    edges_data.append({
+                        "source_id": edge.source,
+                        "target_id": edge.target,
+                        "weight": edge.weight,
+                        "edge_type": edge.edge_type,
+                        "co_occurrence": edge.co_occurrence,
+                        "last_updated": edge.last_updated,
+                    })
+            self.store.delete_nodes([], user_id=self.user_id)
+            if nodes_data:
+                self.store.add_nodes(nodes_data, user_id=self.user_id)
+            self.store.delete_edges([], user_id=self.user_id)
+            if edges_data:
+                self.store.add_edges(edges_data, user_id=self.user_id)
+            self.store.save_profile(self.profile.to_dict(), user_id=self.user_id)
+            self._last_version = self.store.get_latest_version()
+            self._log(f"💾 Saved {len(nodes_data)} nodes, {len(edges_data)} edges")
+
+    @staticmethod
+    def _node_row(node: Node) -> Dict[str, Any]:
+        emb = node.embedding if node.embedding is not None else []
+        return {
+            "id": node.id,
+            "content": node.content,
+            "embedding": [float(x) for x in emb],
+            "type": node.type,
+            "timestamp": node.timestamp,
+            "access_count": node.access_count,
+            "last_accessed": node.last_accessed,
+            "salience": node.salience,
+            "is_super_node": node.is_super_node,
+            "child_ids": list(node.child_ids),
+            "parent_id": node.parent_id,
+            "shard_key": node.shard_key,
+        }
+
+    def _load_from_persistence(self) -> None:
+        with self._mutex:
+            # Drop stale arena rows for this tenant, then rebuild host + arena.
+            stale = list(self.index.tenant_nodes.get(self.user_id, set()))
+            if stale:
+                self.index.delete(stale)
+            self.shards.clear()
+            self.super_nodes.clear()
+
+            rows = self.store.get_nodes(user_id=self.user_id)
+            max_counter = 0
+            batch: List[Node] = []
+            for r in rows:
+                node = Node(
+                    id=r["id"],
+                    content=r.get("content", ""),
+                    embedding=r.get("embedding") or None,
+                    type=r.get("type", "semantic"),
+                    timestamp=r.get("timestamp", time.time()),
+                    access_count=int(r.get("access_count", 0)),
+                    last_accessed=r.get("last_accessed", time.time()),
+                    salience=float(r.get("salience", 0.5)),
+                    is_super_node=bool(r.get("is_super_node", False)),
+                    child_ids=list(r.get("child_ids") or []),
+                    parent_id=r.get("parent_id"),
+                    shard_key=r.get("shard_key") or "default",
+                )
+                if node.is_super_node:
+                    self.super_nodes[node.id] = node
+                else:
+                    self._get_or_create_shard(node.shard_key).add_node(node)
+                if node.embedding is not None and len(node.embedding) == self.embed_dim:
+                    batch.append(node)
+                if node.id.startswith("node_"):
+                    try:
+                        max_counter = max(max_counter, int(node.id[5:]))
+                    except ValueError:
+                        pass
+
+            if batch:
+                self.index.add(
+                    [self._q(n.id) for n in batch],
+                    np.asarray([n.embedding for n in batch], np.float32),
+                    [n.salience for n in batch],
+                    [n.timestamp for n in batch],
+                    [n.type for n in batch],
+                    [n.shard_key or "default" for n in batch],
+                    self.user_id,
+                    [n.is_super_node for n in batch])
+
+            edge_rows = self.store.get_edges(user_id=self.user_id)
+            triples = []
+            for r in edge_rows:
+                edge = Edge(
+                    source=r.get("source_id") or r.get("source"),
+                    target=r.get("target_id") or r.get("target"),
+                    weight=float(r.get("weight", 0.5)),
+                    edge_type=r.get("edge_type", "relates_to"),
+                    co_occurrence=int(r.get("co_occurrence", 1)),
+                    last_updated=r.get("last_updated", time.time()),
+                )
+                owner = None
+                for shard in self.shards.values():
+                    if edge.source in shard.nodes:
+                        owner = shard
+                        break
+                (owner or self._get_or_create_shard("default")).edges[edge.key] = edge
+                triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
+            if triples:
+                self.index.add_edges(triples, self.user_id)
+
+            prof = self.store.load_profile(user_id=self.user_id)
+            self.profile = Profile.from_dict(prof) if prof else Profile()
+
+            self.node_counter = max(self.node_counter, max_counter)
+            self._last_version = self.store.get_latest_version()
+            if self.query_cache:
+                self.query_cache.invalidate_results()
+
+    def check_for_updates(self) -> bool:
+        try:
+            current = self.store.get_latest_version()
+            if current > self._last_version:
+                self._log(f"🔄 Store updated (v{current}), reloading...")
+                self._load_from_persistence()
+                return True
+        except Exception:
+            pass
+        return False
+
+    # ----------------------------------------------------------- JSON snapshot
+    def save_state(self, filename: str = "memory_state.json") -> str:
+        with self._mutex:
+            self._sync_from_arena()
+            state = {
+                "shards": {
+                    k: {
+                        "nodes": [n.to_dict() for n in v.nodes.values()],
+                        "edges": [e.to_dict() for e in v.edges.values()],
+                    }
+                    for k, v in self.shards.items()
+                },
+                "super_nodes": [n.to_dict() for n in self.super_nodes.values()],
+                "profile": self.profile.to_dict(),
+                "node_counter": self.node_counter,
+                "conversation_count": self.conversation_count,
+                "settings": {
+                    "auto_consolidate": self.auto_consolidate,
+                    "consolidate_every": self.consolidate_every,
+                    "auto_prune": self.auto_prune,
+                    "prune_threshold": self.prune_threshold,
+                    "max_buffer_size": self.max_buffer_size,
+                },
+            }
+        with open(filename, "w") as f:
+            json.dump(state, f, indent=2)
+        return f"✓ State saved to {filename}"
+
+    def load_state(self, filename: str = "memory_state.json") -> str:
+        try:
+            with open(filename) as f:
+                state = json.load(f)
+        except FileNotFoundError:
+            return f"⚠ File {filename} not found"
+
+        with self._mutex:
+            stale = list(self.index.tenant_nodes.get(self.user_id, set()))
+            if stale:
+                self.index.delete(stale)
+            self.shards.clear()
+            self.super_nodes.clear()
+
+            batch: List[Node] = []
+            for shard_key, shard_data in state.get("shards", {}).items():
+                shard = self._get_or_create_shard(shard_key)
+                for nd in shard_data.get("nodes", []):
+                    node = Node.from_dict(nd)
+                    shard.add_node(node)
+                    if node.embedding is not None and len(node.embedding) == self.embed_dim:
+                        batch.append(node)
+                for ed in shard_data.get("edges", []):
+                    edge = Edge.from_dict(ed)
+                    shard.edges[edge.key] = edge
+            for nd in state.get("super_nodes", []):
+                node = Node.from_dict(nd)
+                self.super_nodes[node.id] = node
+                if node.embedding is not None and len(node.embedding) == self.embed_dim:
+                    batch.append(node)
+
+            if batch:
+                self.index.add(
+                    [self._q(n.id) for n in batch],
+                    np.asarray([n.embedding for n in batch], np.float32),
+                    [n.salience for n in batch],
+                    [n.timestamp for n in batch],
+                    [n.type for n in batch],
+                    [n.shard_key or "default" for n in batch],
+                    self.user_id,
+                    [n.is_super_node for n in batch])
+            triples = [(self._q(e.source), self._q(e.target), e.weight)
+                       for s in self.shards.values() for e in s.edges.values()]
+            if triples:
+                self.index.add_edges(triples, self.user_id)
+
+            profile_data = state.get("profile", {})
+            self.profile.data = profile_data.get("data", self.profile.data)
+            self.profile.last_updated = profile_data.get("last_updated", time.time())
+            self.node_counter = state.get("node_counter", 0)
+            self.conversation_count = state.get("conversation_count", 0)
+            for key, val in state.get("settings", {}).items():
+                if hasattr(self, key):
+                    setattr(self, key, val)
+        return f"✓ State loaded from {filename}"
+
+    # --------------------------------------------------------- export/insights
+    def export_observations(self, format: str = "markdown") -> str:
+        with self._mutex:
+            self._sync_from_arena()
+            nodes = [n for s in self.shards.values() for n in s.nodes.values()
+                     if not n.is_super_node]
+        nodes.sort(key=lambda n: (n.salience, n.last_accessed), reverse=True)
+        top = nodes[:self.config.export_top_n]
+
+        if format == "json":
+            return json.dumps([n.to_dict() for n in top], indent=2)
+
+        lines = [f"# Memory Observations for {self.user_id}", ""]
+        for n in top:
+            lines.append(f"### {n.type.capitalize()} Memory ({n.shard_key})")
+            lines.append(f"- **Content**: {n.content}")
+            lines.append(f"- **Salience**: {n.salience:.2f}")
+            lines.append(f"- **Last Accessed**: {time.ctime(n.last_accessed)}")
+            lines.append("")
+        return "\n".join(lines)
+
+    def get_insights(self) -> str:
+        observations = self.export_observations(format="json")
+        system_prompt = f"""Analyze these atomic memories for user '{self.user_id}' and provide a comprehensive psychological and knowledge profile.
+Identify long-term patterns, core beliefs, persistent interests, and significant life events reflected in the data.
+
+Structure your response as:
+1. **Personality Traits**: Key characteristics detected.
+2. **Core Interests & Knowledge**: What the user knows and cares about.
+3. **Behavioral Patterns**: How the user typically interacts or works.
+4. **Recent Focus**: Most salient topics from recent memories.
+
+Be clinical yet insightful. Do not include conversational filler."""
+        return self._call_llm([
+            {"role": "system", "content": system_prompt},
+            {"role": "user", "content": f"User Observations:\n{observations}"},
+        ])
+
+    # ----------------------------------------------------------- observability
+    def get_stats(self) -> Dict:
+        nodes, edges = self.buffer.size()
+        rt = self.metrics["retrieval_times"]
+        ct = self.metrics["consolidation_times"]
+        avg_retrieval = float(np.mean(rt)) if rt else 0
+        p95_retrieval = float(np.percentile(rt, 95)) if rt else 0
+        avg_consolidation = float(np.mean(ct)) if ct else 0
+        cache_hit_rate = self.query_cache.get_hit_rate() if self.query_cache else 0.0
+        return {
+            "buffer_nodes": nodes,
+            "buffer_edges": edges,
+            "num_shards": len(self.shards),
+            "num_super_nodes": len(self.super_nodes),
+            "short_term_memories": len(self.short_term_memory),
+            "conversation_active": self.conversation_active,
+            "conversation_count": self.conversation_count,
+            "profile_domains_filled": sum(1 for v in self.profile.data.values() if v),
+            "auto_consolidate": self.auto_consolidate,
+            "vector_store": "HBM Arena + ArrowStore (Active)" if self.store else "None",
+            "performance": {
+                "avg_retrieval_ms": f"{avg_retrieval:.1f}",
+                "p95_retrieval_ms": f"{p95_retrieval:.1f}",
+                "avg_consolidation_s": f"{avg_consolidation:.2f}",
+                "cache_hit_rate": f"{cache_hit_rate:.1%}",
+                "llm_calls": self.metrics["llm_calls"],
+                "embedding_calls": self.metrics["embedding_calls"],
+            },
+        }
+
+    def display_stats(self) -> str:
+        stats = self.get_stats()
+        next_consolidation = self.consolidate_every - (
+            self.conversation_count % self.consolidate_every)
+        return f"""
+📊 SCALABLE MEMORY SYSTEM STATS:
+STORAGE:
+  • Buffer nodes: {stats["buffer_nodes"]} / {self.max_buffer_size} max
+  • Buffer edges: {stats["buffer_edges"]}
+  • Shards: {stats["num_shards"]}
+  • Super-nodes: {stats["num_super_nodes"]}
+  • STM: {stats["short_term_memories"]}
+  • Conversations: {stats["conversation_count"]}
+  • Profile domains: {stats["profile_domains_filled"]}/5
+
+⚡ PERFORMANCE:
+  • Avg retrieval: {stats["performance"]["avg_retrieval_ms"]}ms
+  • P95 retrieval: {stats["performance"]["p95_retrieval_ms"]}ms
+  • Avg consolidation: {stats["performance"]["avg_consolidation_s"]}s
+  • Cache hit rate: {stats["performance"]["cache_hit_rate"]}
+  • LLM calls: {stats["performance"]["llm_calls"]}
+  • Embedding calls: {stats["performance"]["embedding_calls"]}
+
+⚙️ AUTO-MANAGEMENT:
+  • Auto-consolidate: {"ON" if stats["auto_consolidate"] else "OFF"} (every {self.consolidate_every})
+    → Next in: {next_consolidation} conversation(s)
+  • Auto-prune: {"ON" if self.auto_prune else "OFF"} (threshold: {self.prune_threshold})
+  • Max buffer: {self.max_buffer_size} nodes
+  • Sharding: {"ON" if self.enable_sharding else "OFF"}
+  • Hierarchy: {"ON" if self.enable_hierarchy else "OFF"}
+  • Caching: {"ON" if self.enable_caching else "OFF"}
+  • Async: {"ON" if self.enable_async else "OFF"}
+"""
+
+    def display_memories(self, limit: int = 10) -> str:
+        if not self.buffer.nodes:
+            return "No memories stored yet."
+        nodes = self.buffer.get_all_nodes_summary()
+        out = [f"\n💭 Stored Memories (showing {min(limit, len(nodes))} of {len(nodes)}):"]
+        for i, node in enumerate(nodes[:limit], 1):
+            out.append(f"\n{i}. [{node['type']}] 📦 {node['shard']} "
+                       f"(salience: {node['salience']:.2f}, accessed: {node['access_count']}x)")
+            out.append(f"   {node['content']}")
+        return "\n".join(out)
+
+    def display_profile(self) -> str:
+        return f"\n👤 User Profile:\n{self.profile.get_context()}\n"
+
+    # ------------------------------------------------------------------- close
+    def close(self) -> None:
+        if hasattr(self, "store") and self.store is not None:
+            self.store.close()
+        if self.background_executor:
+            self.background_executor.shutdown(wait=True)

@@ -1,0 +1,76 @@
+"""Thread-safe LRU cache for embeddings and retrieval results.
+
+Parity target: reference ``core/query_cache.py`` (59 LoC). Differences by
+design: result entries are LRU-evicted too (the reference's ``set_results``
+never evicts — SURVEY §2.2 quirk list says fix it), and keys use
+blake2b instead of MD5 (same role, faster, no deprecation warnings).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, List, Optional
+
+
+def _key(text: str) -> str:
+    return hashlib.blake2b(text.encode("utf-8"), digest_size=16).hexdigest()
+
+
+class QueryCache:
+    def __init__(self, max_size: int = 1000):
+        self.max_size = max_size
+        self._embeddings: OrderedDict[str, List[float]] = OrderedDict()
+        self._results: OrderedDict[str, List[str]] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self._lock = threading.Lock()
+
+    # -- embeddings ---------------------------------------------------------
+    def get_embedding(self, text: str) -> Optional[List[float]]:
+        k = _key(text)
+        with self._lock:
+            if k in self._embeddings:
+                self._embeddings.move_to_end(k)
+                self.hits += 1
+                return self._embeddings[k]
+            self.misses += 1
+            return None
+
+    def set_embedding(self, text: str, embedding: List[float]) -> None:
+        k = _key(text)
+        with self._lock:
+            self._embeddings[k] = embedding
+            self._embeddings.move_to_end(k)
+            while len(self._embeddings) > self.max_size:
+                self._embeddings.popitem(last=False)
+
+    # -- retrieval results --------------------------------------------------
+    def get_results(self, query: str) -> Optional[List[str]]:
+        k = _key(query)
+        with self._lock:
+            if k in self._results:
+                self._results.move_to_end(k)
+                self.hits += 1
+                return self._results[k]
+            self.misses += 1
+            return None
+
+    def set_results(self, query: str, results: List[str]) -> None:
+        k = _key(query)
+        with self._lock:
+            self._results[k] = results
+            self._results.move_to_end(k)
+            while len(self._results) > self.max_size:
+                self._results.popitem(last=False)
+
+    def invalidate_results(self) -> None:
+        """Drop cached retrievals (called after graph mutations so stale id
+        lists don't outlive the nodes they point to)."""
+        with self._lock:
+            self._results.clear()
+
+    def get_hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0

@@ -1,0 +1,450 @@
+"""Device-resident memory state: structure-of-arrays arena in HBM.
+
+This is the TPU-native replacement for the reference's object graph of Python
+dicts (``memory_shard.py`` node/edge dicts + ``vector_store.py`` LanceDB rows).
+All numeric per-memory fields live in fixed-capacity device arrays so that the
+hot operations — similarity search, decay sweeps, importance scoring, linking —
+are single batched XLA programs instead of O(N) Python loops (reference hot
+loops at ``memory_system.py:464-470``, ``:797-836``, ``:838-891``).
+
+Design notes (SURVEY §7.1):
+- Static shapes: capacity is fixed per-compile; growth doubles capacity on the
+  host (rare, amortized). Batched mutations pad their index vectors to
+  power-of-two buckets so jit caches stay small.
+- A sentinel scratch row at index ``capacity`` absorbs padded writes, so every
+  scatter runs with a full static-size index vector and no masking branches.
+- Embeddings are stored L2-normalized; cosine similarity is a plain dot
+  product and retrieval is one matvec + ``lax.top_k``.
+- ``tenant_id`` is a first-class column: multi-tenant isolation is a vectorized
+  mask, replacing the reference's per-user SQL filters (``vector_store.py:118``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+NEG_INF = -1e30
+
+TYPE_IDS = {"semantic": 0, "episodic": 1, "procedural": 2}
+TYPE_NAMES = {v: k for k, v in TYPE_IDS.items()}
+
+
+@struct.dataclass
+class ArenaState:
+    """Node arena. All arrays have leading dim ``capacity + 1`` (last row is
+    the sentinel scratch row)."""
+
+    emb: jax.Array            # [cap+1, d]  L2-normalized embeddings
+    salience: jax.Array       # [cap+1] f32 in [0, 1]
+    timestamp: jax.Array      # [cap+1] f32 seconds (host-epoch offset)
+    last_accessed: jax.Array  # [cap+1] f32
+    access_count: jax.Array   # [cap+1] i32
+    type_id: jax.Array        # [cap+1] i32 (TYPE_IDS)
+    shard_id: jax.Array       # [cap+1] i32
+    tenant_id: jax.Array      # [cap+1] i32
+    alive: jax.Array          # [cap+1] bool
+    is_super: jax.Array       # [cap+1] bool
+
+    @property
+    def capacity(self) -> int:
+        return self.emb.shape[0] - 1
+
+    @property
+    def dim(self) -> int:
+        return self.emb.shape[1]
+
+
+@struct.dataclass
+class EdgeState:
+    """Edge arena: directed weighted associations, by arena row index."""
+
+    src: jax.Array           # [E+1] i32 arena row of source node
+    tgt: jax.Array           # [E+1] i32
+    weight: jax.Array        # [E+1] f32 in [0, 1]
+    co: jax.Array            # [E+1] i32 co-occurrence count
+    last_updated: jax.Array  # [E+1] f32
+    alive: jax.Array         # [E+1] bool
+    tenant_id: jax.Array     # [E+1] i32 (tenant of the owning graph)
+
+    @property
+    def capacity(self) -> int:
+        return self.src.shape[0] - 1
+
+
+def init_arena(capacity: int, dim: int, dtype=jnp.float32) -> ArenaState:
+    n = capacity + 1
+    return ArenaState(
+        emb=jnp.zeros((n, dim), dtype=dtype),
+        salience=jnp.zeros((n,), jnp.float32),
+        timestamp=jnp.zeros((n,), jnp.float32),
+        last_accessed=jnp.zeros((n,), jnp.float32),
+        access_count=jnp.zeros((n,), jnp.int32),
+        type_id=jnp.zeros((n,), jnp.int32),
+        shard_id=jnp.full((n,), -1, jnp.int32),
+        tenant_id=jnp.full((n,), -1, jnp.int32),
+        alive=jnp.zeros((n,), bool),
+        is_super=jnp.zeros((n,), bool),
+    )
+
+
+def init_edges(capacity: int) -> EdgeState:
+    n = capacity + 1
+    return EdgeState(
+        src=jnp.full((n,), -1, jnp.int32),
+        tgt=jnp.full((n,), -1, jnp.int32),
+        weight=jnp.zeros((n,), jnp.float32),
+        co=jnp.zeros((n,), jnp.int32),
+        last_updated=jnp.zeros((n,), jnp.float32),
+        alive=jnp.zeros((n,), bool),
+        tenant_id=jnp.full((n,), -1, jnp.int32),
+    )
+
+
+def grow_arena(state: ArenaState, new_capacity: int) -> ArenaState:
+    """Host-side reallocation (not jitted; rare, amortized O(1))."""
+    old = state.capacity
+    assert new_capacity > old
+    fresh = init_arena(new_capacity, state.dim, state.emb.dtype)
+
+    def copy(new, cur):
+        return new.at[:old].set(cur[:old])
+
+    return ArenaState(
+        emb=copy(fresh.emb, state.emb),
+        salience=copy(fresh.salience, state.salience),
+        timestamp=copy(fresh.timestamp, state.timestamp),
+        last_accessed=copy(fresh.last_accessed, state.last_accessed),
+        access_count=copy(fresh.access_count, state.access_count),
+        type_id=copy(fresh.type_id, state.type_id),
+        shard_id=copy(fresh.shard_id, state.shard_id),
+        tenant_id=copy(fresh.tenant_id, state.tenant_id),
+        alive=copy(fresh.alive, state.alive),
+        is_super=copy(fresh.is_super, state.is_super),
+    )
+
+
+def grow_edges(state: EdgeState, new_capacity: int) -> EdgeState:
+    old = state.capacity
+    assert new_capacity > old
+    fresh = init_edges(new_capacity)
+
+    def copy(new, cur):
+        return new.at[:old].set(cur[:old])
+
+    return EdgeState(
+        src=copy(fresh.src, state.src),
+        tgt=copy(fresh.tgt, state.tgt),
+        weight=copy(fresh.weight, state.weight),
+        co=copy(fresh.co, state.co),
+        last_updated=copy(fresh.last_updated, state.last_updated),
+        alive=copy(fresh.alive, state.alive),
+        tenant_id=copy(fresh.tenant_id, state.tenant_id),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Jitted mutation kernels. Index vectors are sentinel-padded on the host
+# (see pad_rows) so shapes bucket to powers of two.
+# ---------------------------------------------------------------------------
+
+
+def pad_rows(rows: np.ndarray, sentinel: int, min_bucket: int = 8) -> np.ndarray:
+    """Pad an int row-index vector to the next power-of-two bucket with the
+    sentinel row index, bounding the number of distinct jit specializations."""
+    n = len(rows)
+    bucket = max(min_bucket, 1 << (max(1, n - 1)).bit_length())
+    out = np.full((bucket,), sentinel, np.int32)
+    out[:n] = rows
+    return out
+
+
+@jax.jit
+def normalize(x: jax.Array) -> jax.Array:
+    n = jnp.linalg.norm(x.astype(jnp.float32), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) / jnp.maximum(n, 1e-9)).astype(x.dtype)
+
+
+@jax.jit
+def arena_add(
+    state: ArenaState,
+    rows: jax.Array,        # [B] i32, sentinel-padded
+    emb: jax.Array,         # [B, d] (normalized by caller or here)
+    salience: jax.Array,    # [B] f32
+    timestamp: jax.Array,   # [B] f32
+    type_id: jax.Array,     # [B] i32
+    shard_id: jax.Array,    # [B] i32
+    tenant_id: jax.Array,   # [B] i32
+    is_super: jax.Array,    # [B] bool
+) -> ArenaState:
+    emb = normalize(emb).astype(state.emb.dtype)
+    return state.replace(
+        emb=state.emb.at[rows].set(emb),
+        salience=state.salience.at[rows].set(salience),
+        timestamp=state.timestamp.at[rows].set(timestamp),
+        last_accessed=state.last_accessed.at[rows].set(timestamp),
+        access_count=state.access_count.at[rows].set(0),
+        type_id=state.type_id.at[rows].set(type_id),
+        shard_id=state.shard_id.at[rows].set(shard_id),
+        tenant_id=state.tenant_id.at[rows].set(tenant_id),
+        alive=state.alive.at[rows].set(True),
+        is_super=state.is_super.at[rows].set(is_super),
+    )
+
+
+@jax.jit
+def arena_delete(state: ArenaState, rows: jax.Array) -> ArenaState:
+    return state.replace(
+        alive=state.alive.at[rows].set(False),
+        tenant_id=state.tenant_id.at[rows].set(-1),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cap_salience",))
+def arena_update_access(
+    state: ArenaState,
+    rows: jax.Array,
+    now: jax.Array,
+    boost: jax.Array,
+    cap_salience: float = 1.0,
+) -> ArenaState:
+    """access_count += 1, salience += boost (capped), refresh last_accessed.
+
+    Mirrors ``buffer_graph.py:79-86`` (update_access) and the neighbor boost in
+    ``memory_system.py:242-260`` — one scatter instead of per-node Python."""
+    sal = state.salience.at[rows].add(boost)
+    sal = jnp.minimum(sal, cap_salience)
+    return state.replace(
+        access_count=state.access_count.at[rows].add(1),
+        salience=sal,
+        last_accessed=state.last_accessed.at[rows].set(now),
+    )
+
+
+@jax.jit
+def arena_boost(state: ArenaState, rows: jax.Array, now: jax.Array,
+                boost: jax.Array) -> ArenaState:
+    """Associative neighbor boost: salience += boost (cap 1.0) and freshness
+    inheritance (last_accessed = now) WITHOUT an access_count bump — exact
+    parity with ``_boost_neighbors`` (memory_system.py:242-260)."""
+    sal = jnp.minimum(state.salience.at[rows].add(boost), 1.0)
+    return state.replace(
+        salience=sal,
+        last_accessed=state.last_accessed.at[rows].set(now),
+    )
+
+
+@jax.jit
+def arena_merge_touch(state: ArenaState, rows: jax.Array,
+                      candidate_salience: jax.Array, now: jax.Array) -> ArenaState:
+    """Dedup-merge bookkeeping: salience = max(salience, candidate),
+    access_count += 1, last_accessed = now (memory_system.py:732-741)."""
+    sal = state.salience.at[rows].max(candidate_salience)
+    return state.replace(
+        salience=sal,
+        access_count=state.access_count.at[rows].add(1),
+        last_accessed=state.last_accessed.at[rows].set(now),
+    )
+
+
+@jax.jit
+def arena_set_salience(state: ArenaState, rows: jax.Array, values: jax.Array) -> ArenaState:
+    return state.replace(salience=state.salience.at[rows].set(values))
+
+
+@jax.jit
+def arena_set_parentage(state: ArenaState, rows: jax.Array, is_super: jax.Array) -> ArenaState:
+    return state.replace(is_super=state.is_super.at[rows].set(is_super))
+
+
+@jax.jit
+def arena_decay(state: ArenaState, tenant: jax.Array, rate: jax.Array,
+                floor: jax.Array) -> ArenaState:
+    """Asymptotic salience decay toward ``floor``:  s' = floor + (s-floor)(1-rate).
+
+    Tenant-masked and vectorized over the whole arena (reference loops per
+    node of the current user's graph, ``memory_shard.py:64-77``)."""
+    s = state.salience
+    decayed = floor + (s - floor) * (1.0 - rate)
+    mask = state.alive & (state.tenant_id == tenant)
+    return state.replace(salience=jnp.where(mask, decayed, s))
+
+
+# ---------------------------------------------------------------------------
+# Retrieval / scoring kernels
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("k", "super_filter"))
+def arena_search(
+    state: ArenaState,
+    query: jax.Array,      # [d] or [Q, d]
+    tenant: jax.Array,     # scalar i32
+    k: int,
+    super_filter: int = 0,  # 0: any, 1: only super nodes, -1: exclude super
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked cosine top-k over the whole arena. Replaces
+    ``LanceDBStore.search_nodes`` (vector_store.py:132-140) AND the super-node
+    fast-path scan (memory_system.py:464-470) — same kernel, different mask."""
+    q = normalize(jnp.atleast_2d(query)).astype(state.emb.dtype)
+    scores = (q @ state.emb.T).astype(jnp.float32)  # [Q, cap+1]
+    mask = state.alive & (state.tenant_id == tenant)
+    if super_filter == 1:
+        mask = mask & state.is_super
+    elif super_filter == -1:
+        mask = mask & ~state.is_super
+    scores = jnp.where(mask[None, :], scores, NEG_INF)
+    top_scores, top_rows = jax.lax.top_k(scores, k)
+    if query.ndim == 1:
+        return top_scores[0], top_rows[0]
+    return top_scores, top_rows
+
+
+@functools.partial(jax.jit, static_argnames=("k", "shard_mode"))
+def arena_link_candidates(
+    state: ArenaState,
+    new_rows: jax.Array,   # [B] i32 rows of newly added nodes
+    tenant: jax.Array,
+    k: int,
+    shard_mode: int = 0,   # 0: any shard, 1: same shard only, -1: other shards only
+) -> Tuple[jax.Array, jax.Array]:
+    """For each new node, top-k most similar existing nodes (excluding self and
+    other new rows). One batched matmul replaces reference hot loops #2/#3
+    (``memory_system.py:797-836`` within-shard, ``:838-891`` cross-shard)."""
+    q = state.emb[new_rows]                       # [B, d]
+    scores = (q @ state.emb.T).astype(jnp.float32)  # [B, cap+1]
+    mask = state.alive & (state.tenant_id == tenant) & ~state.is_super
+    # exclude the new rows themselves from candidates
+    excl = jnp.zeros((state.emb.shape[0],), bool).at[new_rows].set(True)
+    mask = mask & ~excl
+    full_mask = mask[None, :]
+    if shard_mode != 0:
+        same = state.shard_id[new_rows][:, None] == state.shard_id[None, :]
+        full_mask = full_mask & (same if shard_mode == 1 else ~same)
+    scores = jnp.where(full_mask, scores, NEG_INF)
+    return jax.lax.top_k(scores, k)
+
+
+@jax.jit
+def arena_importance(state: ArenaState, now: jax.Array,
+                     w_sal: jax.Array, w_acc: jax.Array, w_rec: jax.Array) -> jax.Array:
+    """importance = salience*w1 + min(1, access/10)*w2 + 1/(1+days_old)*w3.
+
+    Parity with ``_enforce_buffer_limit`` scoring (memory_system.py:544-549):
+    days_old counts from last_accessed. Computed for every row in one pass;
+    dead rows get +inf so they never rank as eviction candidates."""
+    days_old = jnp.maximum(now - state.last_accessed, 0.0) / 86400.0
+    imp = (state.salience * w_sal
+           + jnp.minimum(1.0, state.access_count.astype(jnp.float32) / 10.0) * w_acc
+           + 1.0 / (1.0 + days_old) * w_rec)
+    return jnp.where(state.alive, imp, jnp.inf)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def arena_evict_candidates(state: ArenaState, tenant: jax.Array, now: jax.Array,
+                           w_sal: jax.Array, w_acc: jax.Array, w_rec: jax.Array,
+                           k: int) -> Tuple[jax.Array, jax.Array]:
+    """Rows of the k least-important alive, non-super nodes for a tenant."""
+    imp = arena_importance(state, now, w_sal, w_acc, w_rec)
+    mask = state.alive & (state.tenant_id == tenant) & ~state.is_super
+    imp = jnp.where(mask, imp, jnp.inf)
+    neg_scores, rows = jax.lax.top_k(-imp, k)
+    return -neg_scores, rows
+
+
+@jax.jit
+def arena_mean_embedding(state: ArenaState, rows: jax.Array) -> jax.Array:
+    """Mean of child embeddings → super-node centroid (memory_system.py:916-917).
+    Sentinel-padded rows contribute zero weight."""
+    valid = (rows < state.capacity)[:, None].astype(jnp.float32)
+    embs = state.emb[rows].astype(jnp.float32) * valid
+    mean = embs.sum(0) / jnp.maximum(valid.sum(), 1.0)
+    return normalize(mean)
+
+
+# ---------------------------------------------------------------------------
+# Edge kernels
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def edges_add(state: EdgeState, slots: jax.Array, src: jax.Array, tgt: jax.Array,
+              weight: jax.Array, co: jax.Array, now: jax.Array,
+              tenant: jax.Array, live: jax.Array) -> EdgeState:
+    """``live`` is False for sentinel-padded positions so the scratch slot
+    never becomes an alive phantom edge."""
+    return state.replace(
+        src=state.src.at[slots].set(src),
+        tgt=state.tgt.at[slots].set(tgt),
+        weight=state.weight.at[slots].set(jnp.clip(weight, 0.0, 1.0)),
+        co=state.co.at[slots].set(co),
+        last_updated=state.last_updated.at[slots].set(now),
+        alive=state.alive.at[slots].set(live),
+        tenant_id=state.tenant_id.at[slots].set(tenant),
+    )
+
+
+@jax.jit
+def edges_reinforce(state: EdgeState, slots: jax.Array, bump: jax.Array,
+                    now: jax.Array) -> EdgeState:
+    """Existing edge: weight += bump (capped at 1.0), co_occurrence += 1
+    (parity: memory_shard.py:42-52)."""
+    w = jnp.minimum(state.weight.at[slots].add(bump), 1.0)
+    return state.replace(
+        weight=w,
+        co=state.co.at[slots].add(1),
+        last_updated=state.last_updated.at[slots].set(now),
+    )
+
+
+@jax.jit
+def edges_decay(state: EdgeState, tenant: jax.Array, rate: jax.Array) -> EdgeState:
+    """weight *= (1 - rate) for the tenant's alive edges (memory_shard.py:64-71)."""
+    mask = state.alive & (state.tenant_id == tenant)
+    w = jnp.where(mask, state.weight * (1.0 - rate), state.weight)
+    return state.replace(weight=w)
+
+
+@jax.jit
+def edges_prune(state: EdgeState, tenant: jax.Array,
+                threshold: jax.Array) -> Tuple[EdgeState, jax.Array]:
+    """Kill the tenant's edges with weight < threshold; returns (state, pruned_mask)."""
+    pruned = state.alive & (state.tenant_id == tenant) & (state.weight < threshold)
+    return state.replace(alive=state.alive & ~pruned), pruned
+
+
+@jax.jit
+def edges_delete_for_nodes(state: EdgeState, node_rows: jax.Array) -> EdgeState:
+    """Remove all edges touching any of ``node_rows`` (eviction cleanup,
+    memory_system.py:560-570). node_rows is a small sentinel-padded batch, so
+    a broadcast membership test [E, B] is one fused VPU pass."""
+    touched_src = (state.src[:, None] == node_rows[None, :]).any(axis=1)
+    touched_tgt = (state.tgt[:, None] == node_rows[None, :]).any(axis=1)
+    return state.replace(alive=state.alive & ~(touched_src | touched_tgt))
+
+
+@functools.partial(jax.jit, static_argnames=("max_neighbors",))
+def edges_neighbors(state: EdgeState, rows: jax.Array, min_weight: jax.Array,
+                    max_neighbors: int = 32) -> Tuple[jax.Array, jax.Array]:
+    """Bidirectional neighbor lookup for a batch of node rows.
+
+    Returns (neighbor_rows [B, max_neighbors] sentinel=-1, weights). Replaces
+    the O(E) per-node scan in ``memory_shard.py:54-62``."""
+    src, tgt = state.src, state.tgt
+    live = state.alive & (state.weight >= min_weight)
+
+    def one(row):
+        out_mask = live & (src == row)
+        in_mask = live & (tgt == row)
+        cand = jnp.where(out_mask, tgt, jnp.where(in_mask, src, -1))
+        w = jnp.where(out_mask | in_mask, state.weight, NEG_INF)
+        top_w, idx = jax.lax.top_k(w, max_neighbors)
+        neigh = jnp.where(top_w > NEG_INF / 2, cand[idx], -1)
+        return neigh, jnp.where(top_w > NEG_INF / 2, top_w, 0.0)
+
+    return jax.vmap(one)(rows)

@@ -1,0 +1,260 @@
+"""Durable host-side store: Arrow/Parquet tables + atomic version counter.
+
+Replaces the reference's ``LanceDBStore`` (``core/vector_store.py``, 244 LoC).
+Same Store protocol (11 methods), same role split:
+- The HOT path (ANN search) does not live here — it runs on the HBM arena
+  (``core.index.MemoryIndex``). ``search_nodes`` is still implemented (numpy
+  brute force) for protocol parity and store-only consumers.
+- The store is the system of record across restarts AND the multi-process
+  sync channel: every write bumps a version counter persisted via atomic
+  rename, so dashboard-style readers can poll ``get_latest_version`` exactly
+  like the reference polls LanceDB table versions (vector_store.py:150-156).
+
+Schema notes vs the reference: embedding dimension is free per row (the
+reference hardcodes 1536, vector_store.py:37 — breaking 768-dim providers);
+edge ids include the edge_type so typed parallel edges can't collide
+(reference id = "src_tgt", vector_store.py:170, collides across types);
+user_id never passes through string-interpolated SQL (injection quirk at
+vector_store.py:118,137,145).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+_NODE_FIELDS = [
+    "id", "user_id", "content", "embedding", "type", "timestamp",
+    "access_count", "last_accessed", "salience", "is_super_node",
+    "child_ids", "parent_id", "shard_key", "metadata",
+]
+_EDGE_FIELDS = [
+    "id", "user_id", "source_id", "target_id", "weight", "edge_type",
+    "co_occurrence", "last_updated", "metadata",
+]
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+class ArrowStore:
+    """Per-table parquet files under ``db_dir``; one file per (table, user)."""
+
+    def __init__(self, db_dir: str = "db"):
+        self.db_dir = db_dir
+        os.makedirs(db_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------------- plumbing
+    @staticmethod
+    def _encode_user(user_id: str) -> str:
+        """Reversible, collision-free filename encoding (percent-encoding);
+        a lossy sanitizer would alias distinct tenants onto one file."""
+        from urllib.parse import quote
+        return quote(user_id, safe="")
+
+    @staticmethod
+    def _decode_user(encoded: str) -> str:
+        from urllib.parse import unquote
+        return unquote(encoded)
+
+    def _path(self, table: str, user_id: str) -> str:
+        return os.path.join(self.db_dir, f"{table}__{self._encode_user(user_id)}.parquet")
+
+    def _version_path(self) -> str:
+        return os.path.join(self.db_dir, "VERSION")
+
+    def _bump_version(self) -> None:
+        v = self.get_latest_version() + 1
+        _atomic_write(self._version_path(), str(v).encode())
+
+    def get_latest_version(self) -> int:
+        try:
+            with open(self._version_path()) as f:
+                return int(f.read().strip())
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def _read_rows(self, table: str, user_id: str) -> List[Dict[str, Any]]:
+        path = self._path(table, user_id)
+        if not os.path.exists(path):
+            return []
+        return pq.read_table(path).to_pylist()
+
+    def _write_rows(self, table: str, user_id: str, rows: List[Dict[str, Any]],
+                    fields: List[str]) -> None:
+        path = self._path(table, user_id)
+        if not rows:
+            if os.path.exists(path):
+                os.unlink(path)
+        else:
+            norm = [{k: r.get(k) for k in fields} for r in rows]
+            buf = pa.BufferOutputStream()
+            pq.write_table(pa.Table.from_pylist(norm), buf)
+            _atomic_write(path, buf.getvalue().to_pybytes())
+        self._bump_version()
+
+    # ----------------------------------------------------------------- nodes
+    def add_nodes(self, nodes: List[Dict[str, Any]], user_id: str = "default") -> None:
+        if not nodes:
+            return
+        with self._lock:
+            rows = {r["id"]: r for r in self._read_rows("nodes", user_id)}
+            now = time.time()
+            for n in nodes:
+                emb = n.get("embedding") or n.get("vector") or []
+                rows[n["id"]] = {
+                    "id": n["id"],
+                    "user_id": user_id,
+                    "content": n.get("content", ""),
+                    "embedding": [float(x) for x in emb],
+                    "type": n.get("type", "semantic"),
+                    "timestamp": float(n.get("timestamp", now)),
+                    "access_count": int(n.get("access_count", 0)),
+                    "last_accessed": float(n.get("last_accessed", now)),
+                    "salience": float(n.get("salience", 0.5)),
+                    "is_super_node": bool(n.get("is_super_node", False)),
+                    "child_ids": json.dumps(n.get("child_ids", [])),
+                    "parent_id": n.get("parent_id") or "",
+                    "shard_key": n.get("shard_key") or "",
+                    "metadata": json.dumps(n.get("metadata", {})),
+                }
+            self._write_rows("nodes", user_id, list(rows.values()), _NODE_FIELDS)
+
+    def get_nodes(self, user_id: str = "default") -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._read_rows("nodes", user_id)
+        for r in rows:
+            r["child_ids"] = json.loads(r.get("child_ids") or "[]")
+            r["metadata"] = json.loads(r.get("metadata") or "{}")
+            r["parent_id"] = r.get("parent_id") or None
+        return rows
+
+    def search_nodes(self, embedding: List[float], user_id: str = "default",
+                     limit: int = 10) -> List[str]:
+        """Protocol-parity brute-force cosine over durable rows. The serving
+        path uses the HBM arena instead."""
+        with self._lock:
+            rows = self._read_rows("nodes", user_id)
+        if not rows or not embedding:
+            return []
+        q = np.asarray(embedding, np.float32)
+        qn = np.linalg.norm(q)
+        if qn == 0:
+            return []
+        scored = []
+        for r in rows:
+            e = np.asarray(r["embedding"], np.float32)
+            if e.size != q.size:
+                continue
+            en = np.linalg.norm(e)
+            if en == 0:
+                continue
+            scored.append((float(np.dot(q, e) / (qn * en)), r["id"]))
+        scored.sort(reverse=True)
+        return [nid for _, nid in scored[:limit]]
+
+    def delete_nodes(self, node_ids: List[str], user_id: str = "default") -> None:
+        with self._lock:
+            rows = self._read_rows("nodes", user_id)
+            if not node_ids:
+                # Parity: empty list deletes ALL the user's rows
+                # (reference vector_store.py:143-145).
+                remaining: List[Dict[str, Any]] = []
+            else:
+                drop = set(node_ids)
+                remaining = [r for r in rows if r["id"] not in drop]
+            self._write_rows("nodes", user_id, remaining, _NODE_FIELDS)
+
+    # ----------------------------------------------------------------- edges
+    @staticmethod
+    def _edge_id(e: Dict[str, Any]) -> str:
+        src = e.get("source_id") or e.get("source")
+        tgt = e.get("target_id") or e.get("target")
+        et = e.get("edge_type", "relates_to")
+        return e.get("id") or f"{src}|{tgt}|{et}"
+
+    def add_edges(self, edges: List[Dict[str, Any]], user_id: str = "default") -> None:
+        if not edges:
+            return
+        with self._lock:
+            rows = {r["id"]: r for r in self._read_rows("edges", user_id)}
+            now = time.time()
+            for e in edges:
+                eid = self._edge_id(e)
+                rows[eid] = {
+                    "id": eid,
+                    "user_id": user_id,
+                    "source_id": e.get("source_id") or e.get("source"),
+                    "target_id": e.get("target_id") or e.get("target"),
+                    "weight": float(e.get("weight", 0.5)),
+                    "edge_type": e.get("edge_type") or e.get("type", "relates_to"),
+                    "co_occurrence": int(e.get("co_occurrence", 1)),
+                    "last_updated": float(e.get("last_updated", now)),
+                    "metadata": json.dumps(e.get("metadata", {})),
+                }
+            self._write_rows("edges", user_id, list(rows.values()), _EDGE_FIELDS)
+
+    def get_edges(self, user_id: str = "default") -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._read_rows("edges", user_id)
+        for r in rows:
+            r["metadata"] = json.loads(r.get("metadata") or "{}")
+        return rows
+
+    def delete_edges(self, edge_ids: List[str], user_id: str = "default") -> None:
+        with self._lock:
+            rows = self._read_rows("edges", user_id)
+            if not edge_ids:
+                remaining: List[Dict[str, Any]] = []
+            else:
+                drop = set(edge_ids)
+                remaining = [r for r in rows if r["id"] not in drop]
+            self._write_rows("edges", user_id, remaining, _EDGE_FIELDS)
+
+    # --------------------------------------------------------------- profile
+    def save_profile(self, profile: Dict[str, Any], user_id: str = "default") -> None:
+        with self._lock:
+            payload = json.dumps({"user_id": user_id, "data": profile,
+                                  "updated_at": time.time()}).encode()
+            _atomic_write(self._path("profiles", user_id).replace(".parquet", ".json"),
+                          payload)
+            self._bump_version()
+
+    def load_profile(self, user_id: str = "default") -> Optional[Dict[str, Any]]:
+        path = self._path("profiles", user_id).replace(".parquet", ".json")
+        try:
+            with open(path) as f:
+                return json.load(f).get("data")
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    # ------------------------------------------------------------------ misc
+    def get_all_users(self) -> List[str]:
+        users = set()
+        for fname in os.listdir(self.db_dir):
+            if fname.startswith("nodes__") and fname.endswith(".parquet"):
+                users.add(self._decode_user(fname[len("nodes__"):-len(".parquet")]))
+        return sorted(users)
+
+    def close(self) -> None:
+        self._closed = True

@@ -1,0 +1,3 @@
+from lazzaro_tpu.models.graph import Edge, Node
+
+__all__ = ["Node", "Edge"]

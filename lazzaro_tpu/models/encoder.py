@@ -1,0 +1,127 @@
+"""On-device text encoder (bge-base-en-class) in Flax.
+
+Replaces the reference's remote embedding providers (``core/providers.py``
+OpenAIEmbedder :36-57, GeminiEmbedder :101-128, TogetherEmbedder :170-196) with
+an in-tree JAX forward pass: BERT-style pre-LN transformer, mean pooling over
+the attention mask, L2-normalized output — batched onto the MXU in bfloat16.
+
+Weights are deterministic random by default (no egress to fetch checkpoints);
+``load_params`` restores an Orbax checkpoint for real deployments. Batch data
+parallelism over a mesh 'data' axis is a one-line sharding constraint because
+the forward pass is purely functional.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from lazzaro_tpu.models.tokenizer import HashTokenizer, PAD_ID
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 32768
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 128
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def tiny() -> "EncoderConfig":
+        return EncoderConfig(vocab_size=1024, hidden=64, layers=2, heads=2,
+                             mlp_dim=128, max_len=32, dtype="float32")
+
+    @staticmethod
+    def base() -> "EncoderConfig":
+        return EncoderConfig()
+
+
+class EncoderBlock(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        dt = jnp.dtype(self.cfg.dtype)
+        h = nn.LayerNorm(dtype=dt)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.cfg.heads, dtype=dt, qkv_features=self.cfg.hidden,
+        )(h, h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm(dtype=dt)(x)
+        h = nn.Dense(self.cfg.mlp_dim, dtype=dt)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.cfg.hidden, dtype=dt)(h)
+        return x + h
+
+
+class Encoder(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, token_ids):
+        """token_ids [B, L] int32 → [B, hidden] f32, L2-normalized."""
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        pad_mask = token_ids != PAD_ID                        # [B, L]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=dt)(token_ids)
+        pos = nn.Embed(cfg.max_len, cfg.hidden, dtype=dt)(
+            jnp.arange(token_ids.shape[1])[None, :])
+        x = x + pos
+        attn_mask = pad_mask[:, None, None, :] & pad_mask[:, None, :, None]
+        for _ in range(cfg.layers):
+            x = EncoderBlock(cfg)(x, attn_mask)
+        x = nn.LayerNorm(dtype=dt)(x)
+        # masked mean pooling
+        m = pad_mask[..., None].astype(jnp.float32)
+        pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        return pooled / jnp.maximum(norm, 1e-9)
+
+
+class TextEncoder:
+    """Host-facing wrapper: tokenizer + jitted batched forward with
+    power-of-two batch bucketing (static shapes, bounded compile cache)."""
+
+    def __init__(self, cfg: Optional[EncoderConfig] = None, seed: int = 0,
+                 tokenizer: Optional[HashTokenizer] = None):
+        self.cfg = cfg or EncoderConfig.base()
+        self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size, self.cfg.max_len)
+        self.model = Encoder(self.cfg)
+        dummy = jnp.zeros((1, self.cfg.max_len), jnp.int32)
+        self.params = self.model.init(jax.random.PRNGKey(seed), dummy)
+        self._apply = jax.jit(self.model.apply)
+
+    @property
+    def dim(self) -> int:
+        return self.cfg.hidden
+
+    def load_params(self, ckpt_dir: str) -> None:
+        import orbax.checkpoint as ocp
+        self.params = ocp.StandardCheckpointer().restore(ckpt_dir, self.params)
+
+    def save_params(self, ckpt_dir: str) -> None:
+        import orbax.checkpoint as ocp
+        ocp.StandardCheckpointer().save(ckpt_dir, self.params)
+
+    def encode_batch(self, texts) -> np.ndarray:
+        if not texts:
+            return np.zeros((0, self.dim), np.float32)
+        ids = np.asarray(self.tokenizer.batch_encode(list(texts)), np.int32)
+        n = ids.shape[0]
+        bucket = 1 << (max(1, n - 1)).bit_length()
+        if bucket > n:
+            ids = np.concatenate([ids, np.zeros((bucket - n, ids.shape[1]), np.int32)])
+        out = self._apply(self.params, jnp.asarray(ids))
+        return np.asarray(out[:n], np.float32)
+
+    def encode(self, text: str) -> np.ndarray:
+        return self.encode_batch([text])[0]

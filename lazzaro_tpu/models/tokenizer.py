@@ -1,0 +1,53 @@
+"""Deterministic hash-bucket tokenizer.
+
+The reference never tokenizes — embeddings come from remote APIs
+(``core/providers.py``). For the in-tree TPU encoder we need a tokenizer with
+zero external assets (no downloaded vocab files; this environment has no
+egress). Tokens hash into a fixed-size bucket space, which composes with both
+the feature-hashing embedder and the learned encoder's embedding table. Users
+with real checkpoints can swap in their own tokenizer via the
+``EmbeddingProvider`` protocol.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import List
+
+PAD_ID = 0
+CLS_ID = 1
+SEP_ID = 2
+UNK_ID = 3
+RESERVED = 4
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+def _bucket(token: str, space: int) -> int:
+    h = hashlib.blake2b(token.encode("utf-8"), digest_size=8).digest()
+    return int.from_bytes(h, "little") % space
+
+
+class HashTokenizer:
+    def __init__(self, vocab_size: int = 32768, max_len: int = 128):
+        assert vocab_size > RESERVED
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+
+    def tokenize(self, text: str) -> List[str]:
+        return _TOKEN_RE.findall(text.lower())
+
+    def encode(self, text: str, max_len: int | None = None) -> List[int]:
+        """[CLS] tok... [SEP], truncated/padded to max_len with PAD."""
+        max_len = max_len or self.max_len
+        space = self.vocab_size - RESERVED
+        ids = [CLS_ID]
+        for tok in self.tokenize(text)[: max_len - 2]:
+            ids.append(RESERVED + _bucket(tok, space))
+        ids.append(SEP_ID)
+        ids += [PAD_ID] * (max_len - len(ids))
+        return ids[:max_len]
+
+    def batch_encode(self, texts: List[str], max_len: int | None = None) -> List[List[int]]:
+        return [self.encode(t, max_len) for t in texts]

@@ -1,0 +1,10 @@
+from lazzaro_tpu.ops.topk import masked_topk, make_sharded_topk
+from lazzaro_tpu.ops.graphops import connected_components, component_stats, pairwise_merge_candidates
+
+__all__ = [
+    "masked_topk",
+    "make_sharded_topk",
+    "connected_components",
+    "component_stats",
+    "pairwise_merge_candidates",
+]

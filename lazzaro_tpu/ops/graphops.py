@@ -1,0 +1,98 @@
+"""Vectorized graph algorithms over the edge arena.
+
+Replaces the reference's recursive-DFS connected components
+(``buffer_graph.py:99-120``) with iterative label propagation (pointer
+jumping) — XLA-friendly, no Python recursion, O(E · diameter) work fully on
+device via ``lax.while_loop``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes",))
+def connected_components(
+    src: jax.Array,        # [E] i32 (dead edges may hold -1)
+    tgt: jax.Array,        # [E] i32
+    edge_alive: jax.Array,  # [E] bool
+    node_alive: jax.Array,  # [num_nodes] bool
+    num_nodes: int,
+    min_weight: jax.Array = 0.0,
+    weight: jax.Array | None = None,
+) -> jax.Array:
+    """Label propagation: every alive node ends with the minimum row index of
+    its component as its label; dead nodes get -1."""
+    if weight is None:
+        weight = jnp.ones_like(edge_alive, jnp.float32)
+    live_e = edge_alive & (weight >= min_weight)
+    s = jnp.where(live_e, src, 0)
+    t = jnp.where(live_e, tgt, 0)
+
+    labels0 = jnp.where(node_alive, jnp.arange(num_nodes, dtype=jnp.int32), jnp.int32(num_nodes))
+
+    def body(carry):
+        labels, _ = carry
+        ls, lt = labels[s], labels[t]
+        m = jnp.minimum(ls, lt)
+        big = jnp.int32(num_nodes)
+        m_s = jnp.where(live_e, m, big)
+        new = labels
+        new = new.at[s].min(m_s)
+        new = new.at[t].min(m_s)
+        # pointer jumping: label <- label[label] accelerates convergence
+        new = jnp.minimum(new, new[jnp.clip(new, 0, num_nodes - 1)])
+        changed = jnp.any(new != labels)
+        return new, changed
+
+    def cond(carry):
+        return carry[1]
+
+    labels, _ = jax.lax.while_loop(cond, body, (labels0, jnp.bool_(True)))
+    return jnp.where(node_alive, labels, -1)
+
+
+@jax.jit
+def component_stats(labels: jax.Array, src: jax.Array, tgt: jax.Array,
+                    edge_alive: jax.Array, weight: jax.Array,
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-component (keyed by label == component root row) node counts, edge
+    counts, and summed edge weight. Used by the deep-consolidation pass
+    (reference ``run_consolidation`` memory_system.py:967-989) to find
+    components with >= 3 nodes and avg edge weight > 0.3 without a Python DFS."""
+    n = labels.shape[0]
+    alive_nodes = labels >= 0
+    node_counts = jnp.zeros((n,), jnp.int32).at[jnp.clip(labels, 0)].add(
+        alive_nodes.astype(jnp.int32))
+    edge_lbl = jnp.where(edge_alive, labels[jnp.clip(src, 0)], 0)
+    edge_counts = jnp.zeros((n,), jnp.int32).at[jnp.clip(edge_lbl, 0)].add(
+        edge_alive.astype(jnp.int32))
+    weight_sums = jnp.zeros((n,), jnp.float32).at[jnp.clip(edge_lbl, 0)].add(
+        jnp.where(edge_alive, weight, 0.0))
+    return node_counts, edge_counts, weight_sums
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def pairwise_merge_candidates(emb: jax.Array, mask: jax.Array,
+                              threshold: jax.Array, k: int = 4,
+                              ) -> Tuple[jax.Array, jax.Array]:
+    """All-pairs near-duplicate detection as one matmul + top-k.
+
+    This implements the *intended* semantics of ``_merge_similar_nodes``
+    (reference memory_system.py:1065-1120 has an indentation bug that only
+    ever merges duplicates of the last node — SURVEY §2.2 says build the
+    intended all-pairs version). For each row i, returns up to k rows j > i
+    with cosine(i, j) > threshold; sentinel -1 elsewhere."""
+    n = emb.shape[0]
+    scores = (emb @ emb.T).astype(jnp.float32)
+    idx = jnp.arange(n)
+    upper = idx[None, :] > idx[:, None]          # only j > i, no self-pairs
+    valid = mask[:, None] & mask[None, :] & upper
+    scores = jnp.where(valid, scores, -jnp.inf)
+    top_s, top_j = jax.lax.top_k(scores, k)
+    top_j = jnp.where(top_s > threshold, top_j, -1)
+    return top_s, top_j

@@ -1,0 +1,90 @@
+"""Retrieval kernels: masked cosine top-k, single-chip and mesh-sharded.
+
+The mesh-sharded path is the TPU-native replacement for LanceDB ANN search
+(reference ``vector_store.py:132-140``): the embedding matrix is row-sharded
+across the mesh ('data' axis) so each chip scores its local rows on the MXU,
+takes a local top-k, and the k·n_chips candidates are combined with one
+``all_gather`` over ICI followed by a final top-k. For 1M×768 bf16 the whole
+index is ~1.5 GB — resident in HBM across a v5e-8 with room to spare.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def masked_topk(emb: jax.Array, mask: jax.Array, query: jax.Array, k: int
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Single-device masked cosine top-k. emb rows must be L2-normalized."""
+    q = jnp.atleast_2d(query).astype(emb.dtype)
+    scores = (q @ emb.T).astype(jnp.float32)
+    scores = jnp.where(mask[None, :], scores, NEG_INF)
+    top_s, top_i = jax.lax.top_k(scores, k)
+    if query.ndim == 1:
+        return top_s[0], top_i[0]
+    return top_s, top_i
+
+
+def make_sharded_topk(mesh: Mesh, axis: str = "data", k: int = 10):
+    """Build a pjit-compiled distributed top-k over ``mesh``.
+
+    Returns ``search(emb, mask, query) -> (scores [Q,k], global_rows [Q,k])``
+    where ``emb [N, d]`` and ``mask [N]`` are sharded along ``axis`` and the
+    query is replicated. Local top-k per chip → all_gather(k·chips) → global
+    top-k; collectives ride ICI.
+    """
+    n_shards = mesh.shape[axis]
+
+    def local_search(emb_l, mask_l, query):
+        # emb_l: [N/n, d], mask_l: [N/n], query: [Q, d] (replicated)
+        shard_idx = jax.lax.axis_index(axis)
+        local_n = emb_l.shape[0]
+        scores = (query.astype(emb_l.dtype) @ emb_l.T).astype(jnp.float32)
+        scores = jnp.where(mask_l[None, :], scores, NEG_INF)
+        top_s, top_i = jax.lax.top_k(scores, min(k, local_n))   # [Q, k]
+        top_i = top_i + shard_idx * local_n                     # globalize rows
+        # Gather candidates from every chip: [n_shards, Q, k]
+        all_s = jax.lax.all_gather(top_s, axis)
+        all_i = jax.lax.all_gather(top_i, axis)
+        all_s = jnp.moveaxis(all_s, 0, 1).reshape(top_s.shape[0], -1)  # [Q, n*k]
+        all_i = jnp.moveaxis(all_i, 0, 1).reshape(top_s.shape[0], -1)
+        fin_s, fin_pos = jax.lax.top_k(all_s, k)
+        fin_i = jnp.take_along_axis(all_i, fin_pos, axis=1)
+        return fin_s, fin_i
+
+    mapped = shard_map(
+        local_search,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def search(emb, mask, query):
+        q = jnp.atleast_2d(query)
+        return mapped(emb, mask, q)
+
+    return search
+
+
+def shard_rows(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Row-sharding spec for [N, ...] index arrays."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_matrix(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,3 @@
+from lazzaro_tpu.parallel.mesh import make_mesh, single_device_mesh, spec
+
+__all__ = ["make_mesh", "single_device_mesh", "spec"]

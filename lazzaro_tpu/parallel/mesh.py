@@ -1,0 +1,45 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference has no distributed backend (SURVEY §2.3 — its only cross-process
+channel is LanceDB version polling). Here the mesh IS the backend: user
+partitions and index rows map onto mesh axes, and XLA collectives over ICI/DCN
+replace anything NCCL-shaped.
+
+Axis conventions:
+- ``data``  — index rows / batch data parallelism (DP; index "TP analog")
+- ``model`` — tensor parallelism for the in-tree encoder/LLM (TP)
+Multi-host: call ``jax.distributed.initialize()`` before ``make_mesh`` and the
+same code spans slices over DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axis_names: Sequence[str] = ("data",),
+              axis_sizes: Optional[Sequence[int]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_sizes is None:
+        sizes = [1] * len(axis_names)
+        sizes[0] = n
+        axis_sizes = sizes
+    total = int(np.prod(axis_sizes))
+    if total != n:
+        raise ValueError(f"mesh {tuple(axis_sizes)} needs {total} devices, have {n}")
+    dev_array = np.array(devices).reshape(axis_sizes)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(("data",), (1,), devices=jax.devices()[:1])
+
+
+def spec(mesh: Mesh, *axes: Optional[str]) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))

@@ -1,0 +1,26 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+The environment pins JAX_PLATFORMS=axon (one real TPU chip through a tunnel);
+unit tests run on a deterministic 8-way CPU topology instead — the TPU analog
+of the reference's "two instances on one LanceDB dir" cross-process tests
+(SURVEY §4(e)).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+# The axon sitecustomize pins the TPU backend via env at interpreter start;
+# config.update after import is the reliable override in this image.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_db(tmp_path):
+    return str(tmp_path / "db")

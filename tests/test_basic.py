@@ -1,0 +1,66 @@
+"""Node/Edge defaults and MemorySystem init flags.
+
+Mirrors reference tests/test_basic.py (SURVEY §4): dataclass defaults and
+constructor flag plumbing, but against the TPU-native implementation and
+with offline providers instead of patched openai modules.
+"""
+
+import time
+
+from lazzaro_tpu.models.graph import Edge, Node
+
+
+def test_node_defaults():
+    node = Node(id="n1", content="hello")
+    assert node.type == "semantic"
+    assert node.salience == 0.5
+    assert node.access_count == 0
+    assert not node.is_super_node
+    assert node.child_ids == []
+    assert node.parent_id is None
+    assert abs(node.timestamp - time.time()) < 5
+
+
+def test_edge_defaults():
+    edge = Edge(source="a", target="b")
+    assert edge.weight == 0.5
+    assert edge.edge_type == "relates_to"
+    assert edge.co_occurrence == 1
+
+
+def test_node_round_trip_filters_unknown_keys():
+    d = Node(id="n1", content="x", salience=0.7).to_dict()
+    d["unknown_future_field"] = 123
+    node = Node.from_dict(d)
+    assert node.id == "n1"
+    assert node.salience == 0.7
+
+
+def test_edge_round_trip():
+    e = Edge(source="a", target="b", weight=0.9, edge_type="causes")
+    e2 = Edge.from_dict({**e.to_dict(), "bogus": 1})
+    assert e2.key == ("a", "b")
+    assert e2.weight == 0.9
+    assert e2.edge_type == "causes"
+
+
+def test_memory_system_init_flags(tmp_db):
+    from lazzaro_tpu import MemorySystem
+
+    ms = MemorySystem(
+        enable_sharding=False,
+        enable_hierarchy=False,
+        enable_caching=False,
+        enable_async=False,
+        max_buffer_size=7,
+        db_dir=tmp_db,
+        load_from_disk=False,
+        verbose=False,
+    )
+    assert ms.enable_sharding is False
+    assert ms.enable_hierarchy is False
+    assert ms.query_cache is None
+    assert ms.background_executor is None
+    assert ms.max_buffer_size == 7
+    assert ms.vector_store is ms.store  # back-compat alias
+    ms.close()

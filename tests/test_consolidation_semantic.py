@@ -1,0 +1,75 @@
+"""Full ingest path: STM → end_conversation → fact extraction → node creation;
+second conversation merges a >0.95-similar fact into the same node.
+
+Mirrors reference tests/test_consolidation_semantic.py (SURVEY §4): asserts
+node count stays 1 across the duplicate and the exact decayed salience
+0.2 + (0.9 - 0.2) * 0.99 = 0.893 after one decay pass."""
+
+import pytest
+
+from lazzaro_tpu import MemorySystem
+
+from tests.fakes import MockEmbedder, MockLLM, extraction_response
+
+FACT = {"content": "User loves the Python programming language",
+        "type": "semantic", "salience": 0.9, "topic": "learning"}
+
+
+@pytest.fixture()
+def ms(tmp_db):
+    llm = MockLLM(sniffers={
+        "Extract distinct, atomic facts": extraction_response([FACT]),
+    }, response="chat reply")
+    system = MemorySystem(
+        enable_async=False,       # force synchronous consolidation (SURVEY §4(c))
+        auto_consolidate=False,
+        load_from_disk=False,
+        db_dir=tmp_db,
+        llm_provider=llm,
+        embedding_provider=MockEmbedder(),
+        verbose=False,
+    )
+    yield system
+    system.close()
+
+
+def test_fact_extraction_creates_node(ms):
+    ms.start_conversation()
+    ms.add_to_short_term("I really love Python!", "episodic", 0.7)
+    ms.end_conversation()
+
+    nodes, _ = ms.buffer.size()
+    assert nodes == 1
+    node = ms.buffer.get_node("node_1")
+    assert node is not None
+    assert node.content == FACT["content"]
+    assert node.shard_key == "learning"
+    # one decay pass: 0.2 + (0.9 - 0.2) * 0.99
+    assert node.salience == pytest.approx(0.893, abs=1e-5)
+
+
+def test_duplicate_fact_merges_not_duplicates(ms):
+    ms.start_conversation()
+    ms.add_to_short_term("I really love Python!", "episodic", 0.7)
+    ms.end_conversation()
+
+    ms.start_conversation()
+    ms.add_to_short_term("Did I mention I love Python?", "episodic", 0.7)
+    ms.end_conversation()
+
+    nodes, _ = ms.buffer.size()
+    assert nodes == 1  # merged, not duplicated
+    node = ms.buffer.get_node("node_1")
+    assert node.access_count == 1  # merge bumps access
+    # merge restored salience to max(0.893, 0.9)=0.9, then decay → 0.893
+    assert node.salience == pytest.approx(0.893, abs=1e-5)
+
+
+def test_search_memories_finds_consolidated_fact(ms):
+    ms.start_conversation()
+    ms.add_to_short_term("I really love Python!", "episodic", 0.7)
+    ms.end_conversation()
+
+    results = ms.search_memories("User loves the Python programming language")
+    assert len(results) == 1
+    assert results[0].id == "node_1"

@@ -1,0 +1,163 @@
+"""Device arena / MemoryIndex unit tests: add/search/delete, tenant isolation,
+decay parity math, eviction ranking, linking, merge candidates, components,
+and the 8-device sharded top-k collective."""
+
+import numpy as np
+import pytest
+
+from lazzaro_tpu.core.index import MemoryIndex
+
+
+def basis(dim, i):
+    v = np.zeros(dim, np.float32)
+    v[i] = 1.0
+    return v
+
+
+@pytest.fixture()
+def idx():
+    return MemoryIndex(dim=8, capacity=16, edge_capacity=32, epoch=1000.0)
+
+
+def fill(idx, n=3, tenant="u1", t0=1000.0):
+    ids = [f"n{i}" for i in range(n)]
+    embs = np.stack([basis(8, i) for i in range(n)])
+    idx.add(ids, embs, [0.5] * n, [t0] * n, ["semantic"] * n,
+            ["default"] * n, tenant)
+    return ids
+
+
+def test_add_search_exact(idx):
+    fill(idx, 3)
+    ids, scores = idx.search(basis(8, 1), "u1", k=2)
+    assert ids[0] == "n1"
+    assert scores[0] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_tenant_isolation(idx):
+    fill(idx, 2, tenant="u1")
+    idx.add(["m0"], basis(8, 5).reshape(1, -1), [0.5], [1000.0],
+            ["semantic"], ["default"], "u2")
+    ids, _ = idx.search(basis(8, 5), "u1", k=3)
+    assert "m0" not in ids
+    ids2, _ = idx.search(basis(8, 5), "u2", k=3)
+    assert ids2 == ["m0"]
+
+
+def test_delete_removes_from_search(idx):
+    fill(idx, 3)
+    idx.delete(["n1"])
+    ids, _ = idx.search(basis(8, 1), "u1", k=3)
+    assert "n1" not in ids
+    assert len(idx) == 2
+
+
+def test_decay_parity_math(idx):
+    ids = ["a"]
+    idx.add(ids, basis(8, 0).reshape(1, -1), [0.9], [1000.0],
+            ["semantic"], ["default"], "u1")
+    idx.decay("u1", rate=0.01, salience_floor=0.2)
+    sal = idx.pull_numeric()["salience"][idx.id_to_row["a"]]
+    assert sal == pytest.approx(0.2 + (0.9 - 0.2) * 0.99, abs=1e-6)
+
+
+def test_decay_is_tenant_scoped(idx):
+    idx.add(["a"], basis(8, 0).reshape(1, -1), [0.9], [1000.0],
+            ["semantic"], ["default"], "u1")
+    idx.add(["b"], basis(8, 1).reshape(1, -1), [0.9], [1000.0],
+            ["semantic"], ["default"], "u2")
+    idx.decay("u1", rate=0.01)
+    cols = idx.pull_numeric()
+    assert cols["salience"][idx.id_to_row["a"]] == pytest.approx(0.893, abs=1e-5)
+    assert cols["salience"][idx.id_to_row["b"]] == pytest.approx(0.9, abs=1e-6)
+
+
+def test_capacity_growth(idx):
+    n = 40  # > initial capacity 16
+    ids = [f"g{i}" for i in range(n)]
+    embs = np.random.RandomState(0).randn(n, 8).astype(np.float32)
+    idx.add(ids, embs, [0.5] * n, [1000.0] * n, ["semantic"] * n,
+            ["default"] * n, "u1")
+    assert idx.capacity >= n
+    got, _ = idx.search(embs[37], "u1", k=1)
+    assert got == ["g37"]
+
+
+def test_evict_candidates_ranking(idx):
+    now = 1000.0
+    idx.add(["low", "high"], np.stack([basis(8, 0), basis(8, 1)]),
+            [0.1, 0.9], [now, now], ["semantic"] * 2, ["default"] * 2, "u1")
+    idx.update_access(["high"], boost=0.0, now=now)
+    cands = idx.evict_candidates("u1", 1, now=now)
+    assert cands[0][0] == "low"
+
+
+def test_edges_add_reinforce_prune(idx):
+    fill(idx, 3)
+    idx.add_edges([("n0", "n1", 0.6)], "u1", now=1000.0)
+    idx.add_edges([("n0", "n1", 0.6)], "u1", now=1000.0)  # reinforce +0.1
+    w, co = idx.edge_weights()[("n0", "n1")]
+    assert w == pytest.approx(0.7, abs=1e-6)
+    assert co == 2
+    idx.add_edges([("n1", "n2", 0.3)], "u1", now=1000.0)
+    removed = idx.prune_edges("u1", 0.5)
+    assert removed == [("n1", "n2")]
+    assert ("n0", "n1") in idx.edge_slots
+
+
+def test_link_candidates_same_shard(idx):
+    embs = np.stack([basis(8, 0),
+                     (basis(8, 0) * 0.9 + basis(8, 1) * 0.435),
+                     basis(8, 2)])
+    idx.add(["a", "b", "c"], embs, [0.5] * 3, [1000.0] * 3,
+            ["semantic"] * 3, ["work", "work", "play"], "u1")
+    cands = idx.link_candidates(["a"], "u1", k=2, shard_mode=1)
+    got = cands["a"]
+    assert got and got[0][0] == "b"
+    assert got[0][1] > 0.85
+    assert all(c != "c" for c, _ in got)
+
+
+def test_merge_candidates_all_pairs(idx):
+    # three mutually >0.95 duplicates plus one distinct — the intended
+    # all-pairs semantics (NOT the reference's last-node-only bug)
+    dup = basis(8, 3)
+    embs = np.stack([dup, dup, dup, basis(8, 6)])
+    idx.add(["d1", "d2", "d3", "x"], embs, [0.5] * 4, [1000.0] * 4,
+            ["semantic"] * 4, ["default"] * 4, "u1")
+    pairs = idx.merge_candidates("u1", threshold=0.95)
+    merge_ids = {(a, b) for a, b, _ in pairs}
+    assert ("d1", "d2") in merge_ids or ("d2", "d1") in merge_ids
+    assert all("x" not in p[:2] for p in pairs)
+
+
+def test_components(idx):
+    fill(idx, 4)
+    idx.add_edges([("n0", "n1", 0.8), ("n2", "n3", 0.8)], "u1")
+    comps = sorted([sorted(c) for c in idx.components()])
+    assert ["n0", "n1"] in comps
+    assert ["n2", "n3"] in comps
+
+
+def test_sharded_topk_matches_reference():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from lazzaro_tpu.ops import make_sharded_topk
+    from lazzaro_tpu.parallel import make_mesh
+
+    mesh = make_mesh(("data",), (8,))
+    N, d, k = 2048, 32, 7
+    rng = np.random.RandomState(42)
+    emb = rng.randn(N, d).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    mask = rng.rand(N) > 0.3
+    q = emb[123]
+
+    search = make_sharded_topk(mesh, "data", k=k)
+    emb_s = jax.device_put(emb, NamedSharding(mesh, P("data", None)))
+    mask_s = jax.device_put(mask, NamedSharding(mesh, P("data")))
+    scores, rows = search(emb_s, mask_s, q)
+
+    ref = np.where(mask, emb @ q, -1e30)
+    expect = set(np.argsort(-ref)[:k].tolist())
+    assert set(np.asarray(rows)[0].tolist()) == expect

@@ -1,0 +1,101 @@
+"""Deep consolidation: seeded graph → run_consolidation → profile domains
+updated via prompt-sniffing fake LLM (reference test_profile_update.py
+pattern, SURVEY §4)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from lazzaro_tpu import MemorySystem
+from lazzaro_tpu.models.graph import Edge, Node
+
+from tests.fakes import MockEmbedder, MockLLM
+
+INSIGHTS = {
+    "preferences": "User prefers Python for data work.",
+    "personality_traits": "User is methodical.",
+    "knowledge_domains": "Strong grasp of memory systems.",
+    "interaction_style": "Concise and technical.",
+}
+
+
+@pytest.fixture()
+def ms(tmp_db):
+    llm = MockLLM(sniffers={
+        "Analyze these related memories": json.dumps(INSIGHTS),
+    })
+    system = MemorySystem(enable_async=False, auto_consolidate=False,
+                          load_from_disk=False, db_dir=tmp_db,
+                          llm_provider=llm, embedding_provider=MockEmbedder(),
+                          verbose=False)
+    yield system
+    system.close()
+
+
+def seed_component(ms, n=3, weight=0.8):
+    """n nodes chained with strong edges in one shard."""
+    shard = ms._get_or_create_shard("work")
+    for i in range(n):
+        emb = np.zeros(8, np.float32)
+        emb[i % 8] = 1.0
+        node = Node(id=f"node_{i}", content=f"Memory about project phase {i}",
+                    embedding=emb.tolist(), shard_key="work")
+        shard.add_node(node)
+        ms._index_add_node(node)
+    for i in range(n - 1):
+        ms._add_edge(Edge(source=f"node_{i}", target=f"node_{i+1}", weight=weight))
+
+
+def test_component_profile_extraction(ms):
+    seed_component(ms, n=3, weight=0.8)
+    result = ms.run_consolidation(merge_similar=False)
+    assert "Updated" in result
+    for domain, insight in INSIGHTS.items():
+        assert ms.profile.data[domain] == insight
+
+
+def test_small_components_fall_back_to_whole_graph(ms):
+    seed_component(ms, n=2, weight=0.8)  # below component_min_size
+    seed_component_extra = Node(id="node_x", content="Isolated fact",
+                                embedding=[0, 0, 0, 0, 0, 0, 0, 1.0])
+    ms._get_or_create_shard("personal").add_node(seed_component_extra)
+    ms._index_add_node(seed_component_extra)
+    result = ms.run_consolidation(merge_similar=False)
+    # fallback whole-graph extraction fires (≥3 total contents)
+    assert "Updated" in result
+
+
+def test_weak_components_skip_profile(ms):
+    seed_component(ms, n=3, weight=0.2)  # below avg-weight gate 0.3
+    ms.run_consolidation(merge_similar=False)
+    # component skipped, but whole-graph fallback still updates
+    assert ms.profile.data["preferences"] == INSIGHTS["preferences"]
+
+
+def test_merge_similar_nodes_all_pairs(ms):
+    shard = ms._get_or_create_shard("work")
+    dup = [1.0, 0, 0, 0, 0, 0, 0, 0]
+    for i, nid in enumerate(["node_1", "node_2", "node_3"]):
+        node = Node(id=nid, content=f"dup {i}", embedding=list(dup),
+                    shard_key="work")
+        shard.add_node(node)
+        ms._index_add_node(node)
+    distinct = Node(id="node_9", content="distinct",
+                    embedding=[0, 1.0, 0, 0, 0, 0, 0, 0], shard_key="work")
+    shard.add_node(distinct)
+    ms._index_add_node(distinct)
+
+    merged = ms._merge_similar_nodes(0.95)
+    assert merged == 2  # node_2 and node_3 absorbed into node_1
+    nodes, _ = ms.buffer.size()
+    assert nodes == 2
+    keeper = ms.buffer.get_node("node_1")
+    assert "dup 1" in keeper.content and "dup 2" in keeper.content
+
+
+def test_profile_context_rendering(ms):
+    ms.profile.update_domain("preferences", "Tea over coffee")
+    ctx = ms.profile.get_context()
+    assert "Preferences: Tea over coffee" in ctx
+    assert ms.profile.update_domain("not_a_domain", "x") is False

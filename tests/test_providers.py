@@ -1,0 +1,84 @@
+"""Provider injection end-to-end through chat (reference test_providers.py
+pattern) + the in-tree offline providers."""
+
+import json
+
+from lazzaro_tpu import MemorySystem
+from lazzaro_tpu.core.providers import HashingEmbedder, HeuristicLLM
+
+from tests.fakes import MockEmbedder, MockLLM
+
+
+def make_ms(tmp_db, **kw):
+    defaults = dict(
+        enable_async=False,
+        load_from_disk=False,
+        db_dir=tmp_db,
+        verbose=False,
+    )
+    defaults.update(kw)
+    return MemorySystem(**defaults)
+
+
+def test_injected_providers_drive_chat(tmp_db):
+    llm = MockLLM(response="Hello from mock!")
+    ms = make_ms(tmp_db, llm_provider=llm, embedding_provider=MockEmbedder())
+    ms.start_conversation()
+    out = ms.chat("Hi there")
+    assert out == "Hello from mock!"
+    assert len(llm.calls) == 1
+    roles = [m["role"] for m in llm.calls[0]]
+    assert roles[0] == "system"
+    assert {"role": "user", "content": "Hi there"} in llm.calls[0]
+    ms.close()
+
+
+def test_default_providers_are_offline(tmp_db):
+    ms = make_ms(tmp_db)
+    assert isinstance(ms.llm, HeuristicLLM)
+    assert isinstance(ms.embedder, HashingEmbedder)
+    ms.close()
+
+
+def test_hashing_embedder_similarity_properties():
+    e = HashingEmbedder(dim=128)
+    a = e.embed("the user loves python programming")
+    b = e.embed("the user loves python programming")
+    c = e.embed("completely unrelated gardening topic here")
+    import numpy as np
+    assert np.allclose(a, b)
+    sim_dup = float(np.dot(a, b))
+    sim_diff = float(np.dot(a, c))
+    assert sim_dup > 0.99
+    assert sim_diff < 0.5
+
+
+def test_heuristic_llm_fact_extraction():
+    llm = HeuristicLLM()
+    payload = json.dumps([
+        {"content": "I work on a big project. I love hiking with family.",
+         "type": "episodic", "salience": 0.7},
+    ])
+    out = llm.completion([
+        {"role": "system", "content": "Extract distinct, atomic facts from this conversation."},
+        {"role": "user", "content": payload},
+    ])
+    data = json.loads(out)
+    contents = [m["content"] for m in data["memories"]]
+    assert any("project" in c for c in contents)
+    topics = {m["topic"] for m in data["memories"]}
+    assert "work" in topics
+    assert "personal" in topics
+
+
+def test_chat_stream_yields_info_then_tokens(tmp_db):
+    ms = make_ms(tmp_db, llm_provider=MockLLM(response="streamed response"),
+                 embedding_provider=MockEmbedder())
+    ms.start_conversation()
+    events = list(ms.chat_stream("tell me something"))
+    kinds = [e["type"] for e in events]
+    assert "info" in kinds
+    assert "token" in kinds
+    text = "".join(e["content"] for e in events if e["type"] == "token")
+    assert text == "streamed response"
+    ms.close()

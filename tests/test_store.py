@@ -1,0 +1,88 @@
+"""ArrowStore: round-trips, empty-list delete-all parity, versioning."""
+
+import pytest
+
+from lazzaro_tpu.core.store import ArrowStore
+
+
+@pytest.fixture()
+def store(tmp_db):
+    s = ArrowStore(tmp_db)
+    yield s
+    s.close()
+
+
+def make_node(i, dim=4):
+    emb = [0.0] * dim
+    emb[i % dim] = 1.0
+    return {"id": f"node_{i}", "content": f"content {i}", "embedding": emb,
+            "type": "semantic", "salience": 0.5, "shard_key": "default",
+            "child_ids": [], "metadata": {"k": i}}
+
+
+def test_node_round_trip(store):
+    store.add_nodes([make_node(1), make_node(2)], user_id="u1")
+    rows = store.get_nodes(user_id="u1")
+    assert {r["id"] for r in rows} == {"node_1", "node_2"}
+    r1 = next(r for r in rows if r["id"] == "node_1")
+    assert r1["content"] == "content 1"
+    assert r1["metadata"] == {"k": 1}
+    assert r1["child_ids"] == []
+
+
+def test_add_nodes_upserts(store):
+    store.add_nodes([make_node(1)], user_id="u1")
+    updated = make_node(1)
+    updated["content"] = "updated"
+    store.add_nodes([updated], user_id="u1")
+    rows = store.get_nodes(user_id="u1")
+    assert len(rows) == 1
+    assert rows[0]["content"] == "updated"
+
+
+def test_user_isolation(store):
+    store.add_nodes([make_node(1)], user_id="u1")
+    store.add_nodes([make_node(2)], user_id="u2")
+    assert {r["id"] for r in store.get_nodes(user_id="u1")} == {"node_1"}
+    assert {r["id"] for r in store.get_nodes(user_id="u2")} == {"node_2"}
+    assert store.get_all_users() == ["u1", "u2"]
+
+
+def test_search_nodes_brute_force(store):
+    store.add_nodes([make_node(0), make_node(1)], user_id="u1")
+    ids = store.search_nodes([1.0, 0.0, 0.0, 0.0], user_id="u1", limit=1)
+    assert ids == ["node_0"]
+
+
+def test_delete_empty_list_deletes_all(store):
+    # parity quirk: empty id list ⇒ delete ALL user rows (vector_store.py:143-145)
+    store.add_nodes([make_node(1), make_node(2)], user_id="u1")
+    store.add_nodes([make_node(3)], user_id="u2")
+    store.delete_nodes([], user_id="u1")
+    assert store.get_nodes(user_id="u1") == []
+    assert len(store.get_nodes(user_id="u2")) == 1
+
+
+def test_edges_round_trip_typed_ids(store):
+    store.add_edges([
+        {"source": "a", "target": "b", "weight": 0.7, "edge_type": "relates_to"},
+        {"source": "a", "target": "b", "weight": 0.4, "edge_type": "causes"},
+    ], user_id="u1")
+    rows = store.get_edges(user_id="u1")
+    # typed parallel edges must not collide (reference id='src_tgt' collides)
+    assert len(rows) == 2
+
+
+def test_profile_round_trip(store):
+    store.save_profile({"data": {"preferences": "tea"}}, user_id="u1")
+    assert store.load_profile(user_id="u1") == {"data": {"preferences": "tea"}}
+    assert store.load_profile(user_id="nobody") is None
+
+
+def test_version_bumps_on_every_write(store):
+    v0 = store.get_latest_version()
+    store.add_nodes([make_node(1)], user_id="u1")
+    v1 = store.get_latest_version()
+    store.save_profile({"x": 1}, user_id="u1")
+    v2 = store.get_latest_version()
+    assert v0 < v1 < v2
