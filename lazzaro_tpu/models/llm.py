@@ -1,0 +1,361 @@
+"""In-tree decoder LM (Gemma-class) for on-TPU consolidation and chat.
+
+The reference delegates every completion to remote HTTP APIs
+(``core/providers.py`` OpenAILLM :5-34, GeminiLLM :59-99, TogetherLLM
+:130-168). Here the LLM is a first-class TPU model: RoPE + grouped-query
+attention + RMSNorm + GeGLU, tied embeddings, byte-level tokenizer (lossless,
+zero assets), KV-cache greedy/temperature decoding under ``lax.while_loop``,
+and an optax train step.
+
+Parallelism: ``param_specs`` maps every parameter to a PartitionSpec over a
+('data', 'model') mesh — embeddings sharded on vocab, attention on heads, MLP
+on the hidden axis — so the same model runs single-chip or pjit-sharded
+across a pod. Long sequences can route attention through
+``lazzaro_tpu.parallel.ring_attention`` (sequence parallelism over ppermute).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from lazzaro_tpu.models.tokenizer import ByteTokenizer
+
+
+@dataclass(frozen=True)
+class LMConfig:
+    # Byte tokenizer needs 259 ids; padded to 512 so the embedding table
+    # shards cleanly over the tensor-parallel mesh axis.
+    vocab_size: int = 512
+    hidden: int = 2048
+    layers: int = 18
+    heads: int = 8
+    kv_heads: int = 2
+    head_dim: int = 256
+    mlp_dim: int = 8192
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def tiny() -> "LMConfig":
+        return LMConfig(hidden=64, layers=2, heads=4, kv_heads=2, head_dim=16,
+                        mlp_dim=128, max_seq=128, dtype="float32")
+
+    @staticmethod
+    def small() -> "LMConfig":
+        return LMConfig(hidden=512, layers=6, heads=8, kv_heads=2, head_dim=64,
+                        mlp_dim=2048, max_seq=1024)
+
+    @staticmethod
+    def base2b() -> "LMConfig":
+        """Gemma-2-2B-class geometry (byte vocab)."""
+        return LMConfig(hidden=2304, layers=26, heads=8, kv_heads=4,
+                        head_dim=256, mlp_dim=9216, max_seq=4096)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        y = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, T, H, D]; positions: [B, T]."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freq  # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache: Optional[Dict] = None):
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        B, T, _ = x.shape
+        q = nn.DenseGeneral((cfg.heads, cfg.head_dim), axis=-1, use_bias=False,
+                            dtype=dt, name="q")(x)
+        k = nn.DenseGeneral((cfg.kv_heads, cfg.head_dim), axis=-1, use_bias=False,
+                            dtype=dt, name="k")(x)
+        v = nn.DenseGeneral((cfg.kv_heads, cfg.head_dim), axis=-1, use_bias=False,
+                            dtype=dt, name="v")(x)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+
+        if cache is not None:
+            # Prefill/decode: scatter this call's K/V rows into the cache at
+            # their positions, then attend over the whole cache with a
+            # causal-vs-position mask.
+            batch_idx = jnp.arange(B)[:, None]                 # [B, 1]
+            ck = cache["k"].at[batch_idx, positions].set(k.astype(dt))
+            cv = cache["v"].at[batch_idx, positions].set(v.astype(dt))
+            k_all, v_all = ck, cv
+            new_cache = {"k": ck, "v": cv}
+            kv_len = ck.shape[1]
+            kv_pos = jnp.arange(kv_len)[None, None, :]          # [1, 1, S]
+            attn_mask = kv_pos <= positions[:, :, None]         # [B, T, S]
+        else:
+            k_all, v_all = k, v
+            new_cache = None
+            attn_mask = jnp.broadcast_to(
+                jnp.tril(jnp.ones((T, T), bool))[None], (B, T, T))
+
+        # GQA: repeat kv heads
+        rep = cfg.heads // cfg.kv_heads
+        k_all = jnp.repeat(k_all, rep, axis=2)
+        v_all = jnp.repeat(v_all, rep, axis=2)
+
+        scores = jnp.einsum("bthd,bshd->bhts", q, k_all).astype(jnp.float32)
+        scores = scores / np.sqrt(cfg.head_dim)
+        scores = jnp.where(attn_mask[:, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v_all)
+        out = nn.DenseGeneral(cfg.hidden, axis=(-2, -1), use_bias=False,
+                              dtype=dt, name="o")(out)
+        return out, new_cache
+
+
+class MLP(nn.Module):
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        dt = jnp.dtype(self.cfg.dtype)
+        gate = nn.Dense(self.cfg.mlp_dim, use_bias=False, dtype=dt, name="gate")(x)
+        up = nn.Dense(self.cfg.mlp_dim, use_bias=False, dtype=dt, name="up")(x)
+        h = nn.gelu(gate) * up
+        return nn.Dense(self.cfg.hidden, use_bias=False, dtype=dt, name="down")(h)
+
+
+class Block(nn.Module):
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache=None):
+        h, new_cache = Attention(self.cfg, name="attn")(
+            RMSNorm(name="ln1")(x), positions, cache)
+        x = x + h
+        x = x + MLP(self.cfg, name="mlp")(RMSNorm(name="ln2")(x))
+        return x, new_cache
+
+
+class Decoder(nn.Module):
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions, caches=None):
+        """tokens [B, T] → logits [B, T, vocab]; caches: per-layer KV dicts."""
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        emb = self.param("embed", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.hidden))
+        x = emb[tokens].astype(dt) * np.sqrt(cfg.hidden)
+        new_caches = []
+        for i in range(cfg.layers):
+            cache_i = caches[i] if caches is not None else None
+            x, nc = Block(cfg, name=f"block_{i}")(x, positions, cache_i)
+            new_caches.append(nc)
+        x = RMSNorm(name="ln_f")(x)
+        logits = (x.astype(jnp.float32) @ emb.T.astype(jnp.float32))
+        return logits, (new_caches if caches is not None else None)
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules: ('data', 'model') mesh
+# ---------------------------------------------------------------------------
+
+
+def param_specs(params: Dict, mesh: Optional[Mesh] = None) -> Dict:
+    """PartitionSpec tree for pjit: embed sharded on vocab, attention on
+    heads, MLP on the expanded axis; norms replicated. Dimensions not
+    divisible by the mesh's 'model' axis fall back to replication (e.g. GQA
+    kv_heads smaller than the tensor-parallel degree)."""
+    model_size = mesh.shape["model"] if mesh is not None and "model" in mesh.axis_names else 1
+
+    def fit(leaf, spec: P) -> P:
+        """Drop the 'model' axis from the spec if that dim isn't divisible."""
+        shape = getattr(leaf, "shape", ())
+        for i, ax in enumerate(spec):
+            if ax == "model" and (i >= len(shape) or shape[i] % max(model_size, 1)):
+                return P()
+        return spec
+
+    def spec_for(path: Tuple[str, ...], leaf) -> P:
+        name = "/".join(path)
+        nd = getattr(leaf, "ndim", 0)
+        if "embed" in name:
+            return fit(leaf, P("model", None))
+        if "attn" in name and any(k in name for k in ("q/", "k/", "v/")):
+            return fit(leaf, P(None, "model", None) if nd == 3 else P(None, "model"))
+        if "attn" in name and "o/" in name:
+            return fit(leaf, P("model", None, None) if nd == 3 else P("model", None))
+        if "mlp" in name and ("gate" in name or "up" in name):
+            return fit(leaf, P(None, "model"))
+        if "mlp" in name and "down" in name:
+            return fit(leaf, P("model", None))
+        return P()
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(kp):
+        return tuple(getattr(k, "key", str(k)) for k in kp)
+
+    specs = {path_str(kp): spec_for(path_str(kp), leaf) for kp, leaf in flat}
+
+    def rebuild(kp, leaf):
+        return specs[path_str(kp)]
+
+    return jax.tree_util.tree_map_with_path(rebuild, params)
+
+
+def shard_params(params: Dict, mesh: Mesh) -> Dict:
+    specs = param_specs(params, mesh)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(cfg: LMConfig, optimizer, mesh: Optional[Mesh] = None):
+    """Next-token CE train step. With a mesh: batch over 'data', params over
+    'model' (call ``shard_params`` on params and optimizer state first)."""
+    model = Decoder(cfg)
+
+    def loss_fn(params, tokens, mask):
+        B, T = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        logits, _ = model.apply({"params": params}, tokens, positions)
+        targets = tokens[:, 1:]
+        logits = logits[:, :-1]
+        mask = mask[:, 1:].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    def train_step(params, opt_state, tokens, mask):
+        if mesh is not None:
+            tokens = jax.lax.with_sharding_constraint(
+                tokens, NamedSharding(mesh, P("data", None)))
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# Host wrapper: init / generate / checkpoint
+# ---------------------------------------------------------------------------
+
+
+class LanguageModel:
+    def __init__(self, cfg: Optional[LMConfig] = None, seed: int = 0,
+                 mesh: Optional[Mesh] = None):
+        self.cfg = cfg or LMConfig.small()
+        self.tokenizer = ByteTokenizer()
+        self.model = Decoder(self.cfg)
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        pos = jnp.zeros((1, 8), jnp.int32)
+        variables = self.model.init(jax.random.PRNGKey(seed), dummy, pos)
+        self.params = variables["params"]
+        if mesh is not None:
+            self.params = shard_params(self.params, mesh)
+        self.mesh = mesh
+        self._prefill = jax.jit(self._prefill_impl)
+        self._decode_one = jax.jit(self._decode_impl)
+
+    # -- checkpointing ------------------------------------------------------
+    def save_params(self, ckpt_dir: str) -> None:
+        import orbax.checkpoint as ocp
+        ocp.StandardCheckpointer().save(ckpt_dir, self.params)
+
+    def load_params(self, ckpt_dir: str) -> None:
+        import orbax.checkpoint as ocp
+        self.params = ocp.StandardCheckpointer().restore(ckpt_dir, self.params)
+
+    # -- inference ----------------------------------------------------------
+    def _empty_cache(self, batch: int):
+        dt = jnp.dtype(self.cfg.dtype)
+        return [{"k": jnp.zeros((batch, self.cfg.max_seq, self.cfg.kv_heads,
+                                 self.cfg.head_dim), dt),
+                 "v": jnp.zeros((batch, self.cfg.max_seq, self.cfg.kv_heads,
+                                 self.cfg.head_dim), dt)}
+                for _ in range(self.cfg.layers)]
+
+    def _prefill_impl(self, params, tokens, positions, caches):
+        logits, caches = self.model.apply({"params": params}, tokens, positions,
+                                          caches)
+        return logits[:, -1], caches
+
+    def _decode_impl(self, params, token, position, caches):
+        logits, caches = self.model.apply(
+            {"params": params}, token[:, None], position[:, None], caches)
+        return logits[:, -1], caches
+
+    def generate(self, prompt: str, max_new_tokens: int = 64,
+                 temperature: float = 0.0, seed: int = 0) -> str:
+        cfg = self.cfg
+        # Leave at least one prompt token: clamp the generation budget, then
+        # keep only the prompt tail that fits (a naive negative slice turns
+        # into [-0:] when the budget hits zero and silently keeps everything).
+        max_new_tokens = min(max_new_tokens, cfg.max_seq - 2)
+        prompt_budget = cfg.max_seq - 1 - max_new_tokens
+        ids = self.tokenizer.encode(prompt)
+        if len(ids) > prompt_budget:
+            ids = ids[len(ids) - prompt_budget:]
+        tokens = jnp.asarray([ids], jnp.int32)
+        positions = jnp.arange(len(ids))[None, :]
+        caches = self._empty_cache(1)
+        logits, caches = self._prefill(self.params, tokens, positions, caches)
+
+        key = jax.random.PRNGKey(seed)
+        out_ids = []
+        pos = len(ids)
+        token = None
+        for _ in range(max_new_tokens):
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                token = jax.random.categorical(sub, logits / temperature, axis=-1)
+            else:
+                token = jnp.argmax(logits, axis=-1)
+            tid = int(token[0])
+            if tid == ByteTokenizer.EOS or pos >= cfg.max_seq - 1:
+                break
+            out_ids.append(tid)
+            logits, caches = self._decode_one(
+                self.params, token.astype(jnp.int32),
+                jnp.asarray([pos], jnp.int32), caches)
+            pos += 1
+        return self.tokenizer.decode(out_ids)
+
+    def logits_for(self, text: str) -> np.ndarray:
+        """Full-sequence forward (no cache) — training/eval path."""
+        ids = self.tokenizer.encode(text)
+        tokens = jnp.asarray([ids], jnp.int32)
+        positions = jnp.arange(len(ids))[None, :]
+        logits, _ = self.model.apply({"params": self.params}, tokens, positions)
+        return np.asarray(logits[0])

@@ -51,3 +51,29 @@ class HashTokenizer:
 
     def batch_encode(self, texts: List[str], max_len: int | None = None) -> List[List[int]]:
         return [self.encode(t, max_len) for t in texts]
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer for the decoder LM.
+
+    vocab = 256 raw bytes + {PAD=256, BOS=257, EOS=258}. Fully offline and
+    lossless, so on-TPU generation can be detokenized back to text without
+    any downloaded vocabulary."""
+
+    PAD = 256
+    BOS = 257
+    EOS = 258
+    vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = True,
+               add_eos: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_bos:
+            ids = [self.BOS] + ids
+        if add_eos:
+            ids = ids + [self.EOS]
+        return ids
+
+    def decode(self, ids) -> str:
+        data = bytes(int(i) for i in ids if 0 <= int(i) < 256)
+        return data.decode("utf-8", errors="replace")

@@ -1,0 +1,71 @@
+"""Flax text encoder: determinism, normalization, batching, graft hooks."""
+
+import numpy as np
+import pytest
+
+from lazzaro_tpu.models.encoder import EncoderConfig, TextEncoder
+from lazzaro_tpu.models.tokenizer import HashTokenizer
+
+
+@pytest.fixture(scope="module")
+def enc():
+    return TextEncoder(EncoderConfig.tiny(), seed=0)
+
+
+def test_hash_tokenizer_deterministic():
+    tok = HashTokenizer(vocab_size=1024, max_len=16)
+    a = tok.encode("The quick brown fox")
+    b = tok.encode("The quick brown fox")
+    assert a == b
+    assert len(a) == 16
+    assert a[0] == 1  # CLS
+
+
+def test_encoder_outputs_normalized(enc):
+    v = enc.encode("hello world")
+    assert v.shape == (enc.dim,)
+    assert np.linalg.norm(v) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_encoder_deterministic_across_instances():
+    a = TextEncoder(EncoderConfig.tiny(), seed=0).encode("same text")
+    b = TextEncoder(EncoderConfig.tiny(), seed=0).encode("same text")
+    assert np.allclose(a, b, atol=1e-6)
+
+
+def test_batch_matches_single(enc):
+    texts = ["alpha beta", "gamma delta", "epsilon"]
+    batch = enc.encode_batch(texts)
+    for i, t in enumerate(texts):
+        assert np.allclose(batch[i], enc.encode(t), atol=1e-5)
+
+
+def test_encoder_embedder_provider(enc):
+    from lazzaro_tpu.core.providers import EncoderEmbedder
+    p = EncoderEmbedder(enc)
+    assert p.dim == enc.dim
+    v = p.embed("test")
+    assert len(v) == enc.dim
+    assert len(p.batch_embed(["a", "b"])) == 2
+
+
+def _load_graft():
+    import importlib.util
+    from pathlib import Path
+    path = Path(__file__).resolve().parents[1] / "__graft_entry__.py"
+    spec = importlib.util.spec_from_file_location("graft_entry", str(path))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def test_graft_entry_compiles():
+    import jax
+    m = _load_graft()
+    fn, args = m.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[-1] >= 259  # vocab logits
+
+
+def test_dryrun_multichip_8():
+    _load_graft().dryrun_multichip(8)
