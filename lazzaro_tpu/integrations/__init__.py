@@ -1,0 +1,27 @@
+"""Guarded re-exports (parity: reference integrations/__init__.py:1-15)."""
+
+__all__ = []
+
+try:
+    from lazzaro_tpu.integrations.langchain_integration import LazzaroLangChainMemory
+    __all__.append("LazzaroLangChainMemory")
+except ImportError:
+    pass
+
+try:
+    from lazzaro_tpu.integrations.langgraph_integration import LazzaroLangGraph
+    __all__.append("LazzaroLangGraph")
+except ImportError:
+    pass
+
+try:
+    from lazzaro_tpu.integrations.autogen_integration import LazzaroAutogenAgent
+    __all__.append("LazzaroAutogenAgent")
+except ImportError:
+    pass
+
+try:
+    from lazzaro_tpu.integrations.adk_integration import LazzaroADKPlugin
+    __all__.append("LazzaroADKPlugin")
+except ImportError:
+    pass

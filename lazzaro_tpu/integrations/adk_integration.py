@@ -1,0 +1,36 @@
+"""Google ADK plugin (parity: reference adk_integration.py): memory retrieval
+as a JSON-schema tool + an observe() hook for recording turns."""
+
+from __future__ import annotations
+
+from lazzaro_tpu.integrations.common import record_turn, retrieval_context
+
+
+class LazzaroADKPlugin:
+    def __init__(self, memory_system):
+        self.memory_system = memory_system
+
+    def as_tool(self) -> dict:
+        return {
+            "name": "lazzaro_memory_retrieval",
+            "description": "Retrieve relevant past memories and user profile information.",
+            "parameters": {
+                "type": "object",
+                "properties": {
+                    "query": {
+                        "type": "string",
+                        "description": "The current user query to find relevant memories for.",
+                    }
+                },
+                "required": ["query"],
+            },
+            "func": self.retrieve,
+        }
+
+    def retrieve(self, query: str) -> str:
+        context = retrieval_context(self.memory_system, query,
+                                    "Relevant Memories:")
+        return context if context else "No relevant memories found."
+
+    def observe(self, user_input: str, agent_output: str) -> None:
+        record_turn(self.memory_system, user_input, agent_output)

@@ -1,0 +1,63 @@
+"""Autogen ConversableAgent hook (parity: reference autogen_integration.py).
+
+Registers a position-0 reply hook that injects/refreshes a
+``[LAZZARO MEMORY CONTEXT]`` block in the agent's system message, records the
+user turn, and returns None so the default reply generation proceeds.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Union
+
+from lazzaro_tpu.integrations.common import record_turn, retrieval_context
+
+CONTEXT_MARKER = "[LAZZARO MEMORY CONTEXT]"
+
+
+class LazzaroAutogenAgent:
+    def __init__(self, agent: Any, memory_system):
+        self.agent = agent
+        self.memory_system = memory_system
+        self._setup_hooks()
+
+    def _setup_hooks(self) -> None:
+        try:
+            from autogen import Agent, ConversableAgent
+        except ImportError:
+            print("⚠ Autogen not installed. Integration may not work.")
+            return
+        if isinstance(self.agent, ConversableAgent):
+            self.agent.register_reply(
+                [Agent, None],
+                reply_func=self._generate_memory_aware_reply,
+                position=0,
+            )
+
+    def _generate_memory_aware_reply(
+        self,
+        recipient: Any,
+        messages: Optional[List[Dict]] = None,
+        sender: Optional[Any] = None,
+        config: Optional[Any] = None,
+    ) -> Union[str, Dict, None]:
+        if not messages:
+            return None
+        last_message = messages[-1].get("content", "")
+        if not last_message:
+            return None
+
+        context = retrieval_context(self.memory_system, last_message,
+                                    "Relevant Context:")
+        if context:
+            block = f"\n\n{CONTEXT_MARKER}\n{context}"
+            system_msg = self.agent.system_message
+            if CONTEXT_MARKER not in system_msg:
+                self.agent.update_system_message(system_msg + block)
+            else:
+                self.agent.update_system_message(re.sub(
+                    re.escape(CONTEXT_MARKER) + r".*$", block.strip(),
+                    system_msg, flags=re.DOTALL))
+
+        record_turn(self.memory_system, last_message)
+        return None  # defer to the default reply pipeline

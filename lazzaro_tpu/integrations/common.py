@@ -1,0 +1,49 @@
+"""Shared retrieval-context assembly for framework integrations.
+
+Every reference integration re-implements the same block — embed the query,
+run `_optimized_retrieval`, render profile + memory bullets (e.g.
+``integrations/langchain_integration.py:23-53``). Here it's one function.
+Retrieval-only: none of these call chat(), so no LLM is invoked.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def retrieval_context(memory_system, query: str,
+                      memories_header: str = "Relevant Past Memories:") -> str:
+    if not query:
+        return ""
+    query_emb = memory_system._get_embedding(query)
+    retrieved_ids = memory_system._optimized_retrieval(query_emb, query)
+
+    parts: List[str] = []
+    profile_context = memory_system.profile.get_context()
+    if profile_context and profile_context != "No profile data yet.":
+        parts.append(f"User Profile: {profile_context}")
+
+    texts = []
+    for nid in retrieved_ids:
+        node = memory_system.buffer.get_node(nid)
+        if node:
+            texts.append(node.content)
+    if texts:
+        parts.append(memories_header + "\n" + "\n".join(texts))
+    return "\n\n".join(parts)
+
+
+def record_turn(memory_system, user_input: str, ai_output: str = "") -> None:
+    """Record a user/assistant pair into the short-term buffer (user 0.7
+    episodic, assistant 0.5 semantic — the convention used across the
+    reference integrations)."""
+    if not memory_system.conversation_active:
+        memory_system.start_conversation()
+    if user_input:
+        memory_system.add_to_short_term(user_input, "episodic", salience=0.7)
+        memory_system.conversation_history.append(
+            {"role": "user", "content": user_input})
+    if ai_output:
+        memory_system.add_to_short_term(ai_output, "semantic", salience=0.5)
+        memory_system.conversation_history.append(
+            {"role": "assistant", "content": ai_output})

@@ -1,0 +1,64 @@
+"""LangChain drop-in memory.
+
+Parity: reference ``integrations/langchain_integration.py`` —
+``load_memory_variables`` is retrieval-only (never calls the LLM),
+``save_context`` records both turns, ``clear`` ends the conversation.
+Works without langchain installed (duck-typed); subclasses BaseMemory when
+langchain-core is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from lazzaro_tpu.integrations.common import record_turn, retrieval_context
+
+try:
+    from langchain_core.memory import BaseMemory
+    from langchain_core.messages import AIMessage
+    _HAS_LANGCHAIN = True
+except ImportError:
+    BaseMemory = object
+    AIMessage = None
+    _HAS_LANGCHAIN = False
+
+
+class LazzaroLangChainMemory(BaseMemory):
+    """LangChain ``BaseMemory`` backed by the TPU memory system."""
+
+    memory_system: Any = None
+    memory_key: str = "history"
+    input_key: Optional[str] = None
+    output_key: Optional[str] = None
+    return_messages: bool = False
+
+    def __init__(self, memory_system, **kwargs):
+        if _HAS_LANGCHAIN:
+            super().__init__(memory_system=memory_system, **kwargs)
+        else:
+            self.memory_system = memory_system
+            for k, v in kwargs.items():
+                setattr(self, k, v)
+
+    @property
+    def memory_variables(self) -> List[str]:
+        return [self.memory_key]
+
+    def load_memory_variables(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+        user_message = inputs.get(self.input_key) or inputs.get("input") or ""
+        if not user_message:
+            return {self.memory_key: [] if self.return_messages else ""}
+        context = retrieval_context(self.memory_system, user_message)
+        if self.return_messages:
+            if AIMessage is None:
+                return {self.memory_key: [context] if context else []}
+            return {self.memory_key: [AIMessage(content=context)] if context else []}
+        return {self.memory_key: context}
+
+    def save_context(self, inputs: Dict[str, Any], outputs: Dict[str, str]) -> None:
+        user_input = inputs.get(self.input_key) or inputs.get("input") or ""
+        ai_output = outputs.get(self.output_key) or outputs.get("output") or ""
+        record_turn(self.memory_system, user_input, ai_output)
+
+    def clear(self) -> None:
+        self.memory_system.end_conversation()

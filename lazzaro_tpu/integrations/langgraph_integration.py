@@ -1,0 +1,46 @@
+"""LangGraph workflow nodes (parity: reference langgraph_integration.py).
+
+No langgraph import needed — the nodes are plain callables over state dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from lazzaro_tpu.integrations.common import record_turn, retrieval_context
+
+
+def _msg_text(msg) -> str:
+    return msg.content if hasattr(msg, "content") else str(msg)
+
+
+class LazzaroLangGraph:
+    def __init__(self, memory_system):
+        self.memory_system = memory_system
+
+    def get_memory_node(self):
+        """Node that injects retrieved context as ``lazzaro_context``."""
+
+        def memory_node(state: Dict[str, Any]):
+            messages = state.get("messages", [])
+            user_msg = (_msg_text(messages[-1]) if messages
+                        else state.get("input", ""))
+            if not user_msg:
+                return {"lazzaro_context": ""}
+            return {"lazzaro_context": retrieval_context(
+                self.memory_system, user_msg, "Past Memories:")}
+
+        return memory_node
+
+    def get_record_node(self):
+        """Node that records the last user/assistant pair."""
+
+        def record_node(state: Dict[str, Any]):
+            messages = state.get("messages", [])
+            if len(messages) < 2:
+                return {}
+            record_turn(self.memory_system,
+                        _msg_text(messages[-2]), _msg_text(messages[-1]))
+            return {}
+
+        return record_node
