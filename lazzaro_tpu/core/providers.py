@@ -269,3 +269,109 @@ class OpenAIEmbedder:
             return [d.embedding for d in resp.data]
         except Exception:
             return [[0.0] * self.dim for _ in texts]
+
+
+class GeminiLLM:
+    """Remote shim (parity: reference providers.py:59-99 — flattens chat
+    messages into a User:/Assistant: prompt; no response_format support)."""
+
+    def __init__(self, api_key: str, model: str = "gemini-2.0-flash"):
+        import google.generativeai as genai  # optional dep
+        genai.configure(api_key=api_key)
+        self.model = genai.GenerativeModel(model)
+
+    @staticmethod
+    def _flatten(messages: List[Dict[str, str]]) -> str:
+        parts = []
+        for m in messages:
+            role = {"user": "User", "assistant": "Assistant"}.get(m["role"], "System")
+            parts.append(f"{role}: {m['content']}")
+        return "\n".join(parts)
+
+    def completion(self, messages, response_format=None) -> str:
+        try:
+            return self.model.generate_content(self._flatten(messages)).text or ""
+        except Exception:
+            return ""
+
+    def completion_stream(self, messages, response_format=None):
+        try:
+            for chunk in self.model.generate_content(self._flatten(messages),
+                                                     stream=True):
+                if chunk.text:
+                    yield chunk.text
+        except Exception:
+            return
+
+
+class GeminiEmbedder:
+    dim = 768
+
+    def __init__(self, api_key: str, model: str = "models/embedding-001"):
+        import google.generativeai as genai
+        genai.configure(api_key=api_key)
+        self._genai = genai
+        self.model = model
+
+    def embed(self, text: str) -> List[float]:
+        try:
+            return self._genai.embed_content(model=self.model,
+                                             content=text)["embedding"]
+        except Exception:
+            return [0.0] * self.dim
+
+    def batch_embed(self, texts: List[str]) -> List[List[float]]:
+        return [self.embed(t) for t in texts]
+
+
+class TogetherLLM:
+    def __init__(self, api_key: str,
+                 model: str = "meta-llama/Llama-3.3-70B-Instruct-Turbo"):
+        import together  # optional dep
+        self.client = together.Together(api_key=api_key)
+        self.model = model
+
+    def completion(self, messages, response_format=None) -> str:
+        try:
+            kwargs = {"model": self.model, "messages": messages, "temperature": 0.7}
+            if response_format:
+                kwargs["response_format"] = response_format
+            resp = self.client.chat.completions.create(**kwargs)
+            return resp.choices[0].message.content or ""
+        except Exception:
+            return ""
+
+    def completion_stream(self, messages, response_format=None):
+        try:
+            stream = self.client.chat.completions.create(
+                model=self.model, messages=messages, temperature=0.7, stream=True)
+            for chunk in stream:
+                delta = chunk.choices[0].delta.content
+                if delta:
+                    yield delta
+        except Exception:
+            return
+
+
+class TogetherEmbedder:
+    dim = 768
+
+    def __init__(self, api_key: str,
+                 model: str = "togethercomputer/m2-bert-80M-8k-retrieval"):
+        import together
+        self.client = together.Together(api_key=api_key)
+        self.model = model
+
+    def embed(self, text: str) -> List[float]:
+        try:
+            resp = self.client.embeddings.create(model=self.model, input=[text])
+            return resp.data[0].embedding
+        except Exception:
+            return [0.0] * self.dim
+
+    def batch_embed(self, texts: List[str]) -> List[List[float]]:
+        try:
+            resp = self.client.embeddings.create(model=self.model, input=texts)
+            return [d.embedding for d in resp.data]
+        except Exception:
+            return [[0.0] * self.dim for _ in texts]

@@ -1,0 +1,186 @@
+"""ShardedMemoryIndex: the memory index spread across a device mesh.
+
+This is the pod-scale variant of ``core.index.MemoryIndex`` (SURVEY §2.3's
+"index model-parallelism" + "tenant partitioning = mesh sharding"): the
+embedding matrix, masks, and numeric columns are row-sharded over the mesh
+'data' axis (HBM-resident on every chip), queries are replicated, and search
+is local-top-k → all_gather → global-top-k over ICI.
+
+Tenant partitioning (the EP analog): with ``tenant_affinity`` on, every
+tenant's rows are allocated inside one mesh partition (hash(tenant) % n),
+so per-tenant sweeps (decay, eviction scoring) touch one chip's rows and
+multi-tenant fleets spread across the pod — replacing the reference's
+row-level `user_id` BTREE filter (vector_store.py:55) with physical placement.
+Multi-host works unchanged: build the mesh after ``jax.distributed.initialize``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from lazzaro_tpu.ops.topk import make_sharded_topk
+
+NEG_INF = -1e30
+
+
+class ShardedMemoryIndex:
+    def __init__(self, mesh: Mesh, dim: int, capacity: int = 1 << 20,
+                 axis: str = "data", dtype=jnp.bfloat16,
+                 tenant_affinity: bool = True, k: int = 10):
+        self.mesh = mesh
+        self.axis = axis
+        self.dim = dim
+        self.n_parts = mesh.shape[axis]
+        assert capacity % self.n_parts == 0, "capacity must divide the mesh axis"
+        self.capacity = capacity
+        self.part_rows = capacity // self.n_parts
+        self.tenant_affinity = tenant_affinity
+
+        self._row_sh = NamedSharding(mesh, P(axis))
+        self._mat_sh = NamedSharding(mesh, P(axis, None))
+        self._rep = NamedSharding(mesh, P())
+
+        self.emb = jax.device_put(jnp.zeros((capacity, dim), dtype), self._mat_sh)
+        self.alive = jax.device_put(jnp.zeros((capacity,), bool), self._row_sh)
+        self.tenant = jax.device_put(jnp.full((capacity,), -1, jnp.int32), self._row_sh)
+        self.salience = jax.device_put(jnp.zeros((capacity,), jnp.float32), self._row_sh)
+
+        # host bookkeeping: per-partition free lists, global id maps
+        self._free: List[List[int]] = [
+            list(range((p + 1) * self.part_rows - 1, p * self.part_rows - 1, -1))
+            for p in range(self.n_parts)]
+        self.id_to_row: Dict[str, int] = {}
+        self.row_to_id: Dict[int, str] = {}
+        self._tenants: Dict[str, int] = {}
+
+        self._search = make_sharded_topk(mesh, axis, k=k)
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1, 2, 3))
+        self._decay = jax.jit(self._decay_impl, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ util
+    def tenant_id(self, name: str) -> int:
+        if name not in self._tenants:
+            self._tenants[name] = len(self._tenants)
+        return self._tenants[name]
+
+    def _partition_for(self, tenant: str) -> int:
+        if not self.tenant_affinity:
+            return int(np.random.default_rng(abs(hash(tenant)) % 2**32).integers(self.n_parts))
+        return abs(hash(tenant)) % self.n_parts
+
+    def _alloc(self, tenant: str, n: int) -> List[int]:
+        """Allocate rows, preferring the tenant's home partition, spilling
+        round-robin to others when full."""
+        home = self._partition_for(tenant)
+        order = [home] + [p for p in range(self.n_parts) if p != home]
+        rows: List[int] = []
+        for p in order:
+            while self._free[p] and len(rows) < n:
+                rows.append(self._free[p].pop())
+            if len(rows) == n:
+                break
+        if len(rows) < n:
+            raise RuntimeError("ShardedMemoryIndex capacity exhausted")
+        return rows
+
+    @staticmethod
+    def _update_impl(emb, alive, tenant, salience, rows, new_emb, new_tenant,
+                     new_salience, live):
+        emb = emb.at[rows].set(new_emb)
+        alive = alive.at[rows].set(live)
+        tenant = tenant.at[rows].set(new_tenant)
+        salience = salience.at[rows].set(new_salience)
+        return emb, alive, tenant, salience
+
+    @staticmethod
+    def _decay_impl(salience, alive, tenant, tid, rate, floor):
+        mask = alive & (tenant == tid)
+        return jnp.where(mask, floor + (salience - floor) * (1.0 - rate), salience)
+
+    # ------------------------------------------------------------------- api
+    def add(self, ids: Sequence[str], embeddings: np.ndarray, tenant: str,
+            saliences: Optional[Sequence[float]] = None) -> List[int]:
+        n = len(ids)
+        if n == 0:
+            return []
+        if saliences is None:
+            saliences = [0.5] * n
+        rows = []
+        fresh = self._alloc(tenant, sum(1 for i in ids if i not in self.id_to_row))
+        fi = 0
+        for node_id in ids:
+            if node_id in self.id_to_row:
+                rows.append(self.id_to_row[node_id])
+            else:
+                r = fresh[fi]; fi += 1
+                self.id_to_row[node_id] = r
+                self.row_to_id[r] = node_id
+                rows.append(r)
+
+        emb = np.asarray(embeddings, np.float32).reshape(n, self.dim)
+        emb = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-9)
+        tid = self.tenant_id(tenant)
+        self.emb, self.alive, self.tenant, self.salience = self._update(
+            self.emb, self.alive, self.tenant, self.salience,
+            jnp.asarray(np.asarray(rows, np.int32)),
+            jnp.asarray(emb.astype(np.float32)).astype(self.emb.dtype),
+            jnp.full((n,), tid, jnp.int32),
+            jnp.asarray(np.asarray(saliences, np.float32)),
+            jnp.ones((n,), bool))
+        return rows
+
+    def delete(self, ids: Sequence[str]) -> None:
+        rows = [self.id_to_row.pop(i) for i in ids if i in self.id_to_row]
+        if not rows:
+            return
+        n = len(rows)
+        for r in rows:
+            self.row_to_id.pop(r, None)
+            self._free[r // self.part_rows].append(r)
+        self.emb, self.alive, self.tenant, self.salience = self._update(
+            self.emb, self.alive, self.tenant, self.salience,
+            jnp.asarray(np.asarray(rows, np.int32)),
+            jnp.zeros((n, self.dim), self.emb.dtype),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), bool))
+
+    def search(self, query: np.ndarray, tenant: str
+               ) -> Tuple[List[str], List[float]]:
+        """Distributed masked top-k: local per-chip → all_gather → global."""
+        tid = self._tenants.get(tenant)
+        if tid is None:
+            return [], []
+        q = np.asarray(query, np.float32)
+        q = q / max(np.linalg.norm(q), 1e-9)
+        mask = self.alive & (self.tenant == tid)
+        scores, rows = self._search(self.emb, mask, jnp.asarray(q))
+        scores = np.asarray(scores)[0]
+        rows = np.asarray(rows)[0]
+        ids, out = [], []
+        for s, r in zip(scores, rows):
+            if s <= NEG_INF / 2:
+                continue
+            nid = self.row_to_id.get(int(r))
+            if nid is not None:
+                ids.append(nid)
+                out.append(float(s))
+        return ids, out
+
+    def decay(self, tenant: str, rate: float, floor: float = 0.2) -> None:
+        tid = self._tenants.get(tenant)
+        if tid is None:
+            return
+        self.salience = self._decay(self.salience, self.alive, self.tenant,
+                                    jnp.int32(tid), jnp.float32(rate),
+                                    jnp.float32(floor))
+
+    def partition_of(self, node_id: str) -> Optional[int]:
+        row = self.id_to_row.get(node_id)
+        return None if row is None else row // self.part_rows

@@ -1,0 +1,3 @@
+from lazzaro_tpu.utils.telemetry import Telemetry, timed
+
+__all__ = ["Telemetry", "timed"]

@@ -1,0 +1,69 @@
+"""Lightweight timing/metrics helpers.
+
+The reference tracks metrics in an ad-hoc dict on MemorySystem with inline
+emoji prints (SURVEY §5: retrieval_times[], consolidation_times[], tiered
+⚡/✓/⏱ latency prints, no structured logging). This module centralizes that:
+named ring-buffered timers with percentile summaries, usable standalone.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict, deque
+from contextlib import contextmanager
+from typing import Deque, Dict
+
+import numpy as np
+
+
+class Telemetry:
+    def __init__(self, window: int = 10_000):
+        self.window = window
+        self.timers: Dict[str, Deque[float]] = defaultdict(
+            lambda: deque(maxlen=window))
+        self.counters: Dict[str, int] = defaultdict(int)
+
+    def record(self, name: str, value_ms: float) -> None:
+        self.timers[name].append(value_ms)
+
+    def bump(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    @contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, (time.perf_counter() - t0) * 1e3)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for name, values in self.timers.items():
+            arr = np.asarray(values)
+            if arr.size:
+                out[name] = {
+                    "count": int(arr.size),
+                    "avg_ms": float(arr.mean()),
+                    "p50_ms": float(np.percentile(arr, 50)),
+                    "p95_ms": float(np.percentile(arr, 95)),
+                }
+        for name, count in self.counters.items():
+            out[name] = {"count": count}
+        return out
+
+    @staticmethod
+    def tier(latency_ms: float) -> str:
+        """The reference's emoji latency tiers (memory_system.py:332-337)."""
+        return "⚡" if latency_ms < 100 else ("✓" if latency_ms < 200 else "⏱")
+
+
+@contextmanager
+def timed(label: str, sink=None):
+    t0 = time.perf_counter()
+    yield
+    ms = (time.perf_counter() - t0) * 1e3
+    if sink is not None:
+        sink.record(label, ms)
+    else:
+        print(f"[{Telemetry.tier(ms)} {label}: {ms:.1f}ms]")
