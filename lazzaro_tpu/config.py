@@ -37,6 +37,16 @@ class MemoryConfig:
     max_buffer_size: int = 10
     cache_size: int = 1000
 
+    # --- durability --------------------------------------------------------
+    # The reference persists only at conversation end (memory_system.py:648);
+    # a crash mid-conversation loses every buffered turn (SURVEY §5 "failure
+    # detection: none"). With journaling on, each short-term turn is appended
+    # to a CRC-framed WAL (native/) and replayed on restart. journal_fsync
+    # additionally fsyncs per append (survives power loss, not just process
+    # crash) at ~1ms/turn cost.
+    journal: bool = True
+    journal_fsync: bool = False
+
     # --- semantic thresholds (exact parity per SURVEY §7 "hard parts") -----
     dedup_similarity: float = 0.95      # memory_system.py:719-741
     super_node_gate: float = 0.4        # hierarchy fast path :472

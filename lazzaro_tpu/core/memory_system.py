@@ -114,6 +114,7 @@ class MemorySystem:
         self.conversation_count = 0
         self.node_counter = 0
         self.consolidation_queue: List[Dict] = []
+        self._inflight_batches: List[Dict] = []   # popped but not yet durable
 
         # Single-writer ingest: one worker thread + one mutation lock.
         self._mutex = threading.RLock()
@@ -130,6 +131,83 @@ class MemorySystem:
 
         if load_from_disk:
             self._load_from_persistence()
+        self._journal = None
+        self._recovered_turns = False
+        self._setup_journal(replay=bool(load_from_disk))
+
+    # --------------------------------------------------------------- journal
+    #
+    # Invariant: the WAL always holds exactly the turns that are NOT yet
+    # durable in the store — queued-but-unconsolidated batches plus the
+    # current short-term buffer. It is rewritten (not blindly truncated) at
+    # every lifecycle transition, so a background consolidation finishing
+    # after a new conversation has started can never wipe fresh turns.
+
+    def _setup_journal(self, replay: bool = True) -> None:
+        """Open this user's turn journal; optionally recover crashed turns.
+
+        Journaling activates only when the store exposes a ``db_dir`` (the
+        injected fake stores in tests don't, matching their in-memory
+        semantics). Recovered turns land back in short-term memory with the
+        conversation re-opened, so the next ``end_conversation`` — or a
+        ``start_conversation``, which consolidates recovered turns before
+        opening a fresh buffer — persists them. The reference simply loses
+        them (persists only at conversation end, memory_system.py:648).
+        ``replay=False`` (a ``load_from_disk=False`` construction) requests a
+        clean session: the journal is opened for writing but prior-process
+        state is not injected.
+        """
+        self._journal = None
+        self._recovered_turns = False
+        journal_dir = getattr(self.store, "db_dir", None)
+        if not self.config.journal or not journal_dir:
+            return
+        from urllib.parse import quote
+
+        from lazzaro_tpu.native import WriteAheadLog
+
+        path = f"{journal_dir}/journal__{quote(self.user_id, safe='')}.wal"
+        self._journal = WriteAheadLog(path, fsync=self.config.journal_fsync)
+        if not replay:
+            return
+        recovered = []
+        for payload in self._journal.replay():
+            try:
+                turn = json.loads(payload.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                continue
+            if isinstance(turn, dict) and turn.get("content"):
+                recovered.append(turn)
+        if recovered:
+            self.short_term_memory = recovered
+            self.conversation_active = True
+            self._recovered_turns = True
+            self._log(f"🛟 Recovered {len(recovered)} unconsolidated turn(s) "
+                      "from the journal")
+
+    def _journal_turn(self, turn: Dict) -> None:
+        if self._journal is not None:
+            try:
+                self._journal.append(json.dumps(turn).encode("utf-8"))
+            except OSError as e:
+                self._log(f"⚠ Journal append failed: {e}")
+
+    def _journal_sync(self) -> None:
+        """Rewrite the WAL to the current not-yet-durable turn set. Callers
+        hold ``self._mutex`` so the snapshot is consistent."""
+        if self._journal is None:
+            return
+        turns: List[Dict] = []
+        for batch in self._inflight_batches + self.consolidation_queue:
+            turns.extend(batch.get("memories", []))
+        if self.conversation_active:
+            turns.extend(self.short_term_memory)
+        try:
+            self._journal.reset()
+            for t in turns:
+                self._journal.append(json.dumps(t).encode("utf-8"))
+        except OSError:
+            pass
 
     # ------------------------------------------------------------------ util
     def _log(self, msg: str) -> None:
@@ -223,21 +301,35 @@ class MemorySystem:
 
     # --------------------------------------------------------------- session
     def start_conversation(self) -> str:
+        if self._recovered_turns and self.conversation_active and self.short_term_memory:
+            # Crash-recovered turns must not be discarded by the normal
+            # "/start clears the buffer" flow — consolidate them first.
+            self._log("🛟 Consolidating recovered turns before new conversation...")
+            self.end_conversation()
+        self._recovered_turns = False
         self.conversation_active = True
         self.short_term_memory = []
         self.conversation_history = []
+        with self._mutex:
+            self._journal_sync()       # drops abandoned-conversation turns
         return "✓ Conversation started"
 
     def add_to_short_term(self, content: str, memory_type: str = "semantic",
                           salience: float = 0.5) -> None:
         if not self.conversation_active:
             raise RuntimeError("No active conversation")
-        self.short_term_memory.append({
+        turn = {
             "content": content,
             "type": memory_type,
             "salience": salience,
             "timestamp": time.time(),
-        })
+        }
+        with self._mutex:
+            # Mutex covers both the buffer append and the WAL append so a
+            # concurrent _journal_sync rewrite can't interleave and duplicate
+            # this turn in the journal.
+            self.short_term_memory.append(turn)
+            self._journal_turn(turn)
         self._auto_save_if_needed()
 
     def _auto_save_if_needed(self) -> None:
@@ -247,23 +339,34 @@ class MemorySystem:
     def end_conversation(self) -> str:
         if not self.conversation_active:
             return "⚠ No active conversation to end."
-        self.conversation_active = False
         if not self.short_term_memory:
+            self.conversation_active = False
+            self._recovered_turns = False
             return "✓ Conversation ended. No memories to consolidate."
 
         results = []
+        n_turns = len(self.short_term_memory)
+        with self._mutex:
+            # One atomic transition: buffer → queue and conversation closed.
+            # A background _journal_sync observing intermediate state would
+            # otherwise see the turns in neither place and wipe them from
+            # the WAL.
+            self.consolidation_queue.append({
+                "memories": self.short_term_memory.copy(),
+                "timestamp": time.time(),
+            })
+            self.conversation_active = False
+            self._recovered_turns = False
+            self.short_term_memory = []
         if self.enable_async and self.background_executor:
-            self._log(f"🔄 Queueing consolidation for {len(self.short_term_memory)} exchanges...")
-            with self._mutex:
-                self.consolidation_queue.append({
-                    "memories": self.short_term_memory.copy(),
-                    "timestamp": time.time(),
-                })
+            self._log(f"🔄 Queueing consolidation for {n_turns} exchanges...")
             self.background_executor.submit(self._async_consolidate)
             results.append("✓ Conversation ended (consolidation queued)")
         else:
-            self._log(f"🔄 Consolidating {len(self.short_term_memory)} exchanges...")
-            results.append(self._consolidate_to_buffer())
+            self._log(f"🔄 Consolidating {n_turns} exchanges...")
+            self._async_consolidate()
+            nodes, edges = self.buffer.size()
+            results.append(f"✓ Consolidation complete. Memory: {nodes} nodes, {edges} edges")
 
         with self._mutex:
             self.index.decay(self.user_id, self.config.decay_rate,
@@ -482,16 +585,6 @@ class MemorySystem:
             self._log(f"   (Graph: Boosted {count} neighbor nodes via association)")
 
     # ---------------------------------------------------------- consolidation
-    def _consolidate_to_buffer(self) -> str:
-        with self._mutex:
-            self.consolidation_queue.append({
-                "memories": self.short_term_memory.copy(),
-                "timestamp": time.time(),
-            })
-        self._async_consolidate()
-        nodes, edges = self.buffer.size()
-        return f"✓ Consolidation complete. Memory: {nodes} nodes, {edges} edges"
-
     _EXTRACTION_PROMPT = """Extract distinct, atomic facts from this conversation.
 Categorization Guidelines:
 1. semantic: Stable facts, preferences, or knowledge (e.g., "User likes Python", "User lives in London").
@@ -513,6 +606,11 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             all_memories: List[Dict] = []
             for batch in self.consolidation_queue:
                 all_memories.extend(batch["memories"])
+            # Move (don't drop) the batches to the in-flight list: they stay
+            # visible to _journal_sync until durable, so a concurrent
+            # start_conversation can't compute an empty turn set and wipe
+            # the WAL while the LLM call below is still running.
+            self._inflight_batches.extend(self.consolidation_queue)
             self.consolidation_queue.clear()
 
         start_time = time.time()
@@ -534,9 +632,11 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 memories = data
             else:
                 self._log(f"⚠ Unexpected data type: {type(data)}")
+                self._requeue_inflight()
                 return
         except json.JSONDecodeError as e:
             self._log(f"⚠ Parse error: {e}")
+            self._requeue_inflight()
             return
 
         memories = [m for m in memories if isinstance(m, dict)]
@@ -624,6 +724,21 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         self.metrics["consolidation_times"].append(elapsed)
         self._log(f"✓ Background consolidation complete ({elapsed:.2f}s)")
         self._save_to_persistence()
+        with self._mutex:
+            # The consolidated batches are durable; the WAL shrinks to
+            # whatever is still pending (e.g. a conversation started while
+            # the LLM call ran).
+            self._inflight_batches.clear()
+            self._journal_sync()
+
+    def _requeue_inflight(self) -> None:
+        """A consolidation attempt failed (LLM parse error): put its batches
+        back on the queue so the next consolidation retries them, keeping
+        them journaled meanwhile. The reference silently drops the turns
+        (memory_system.py:697-699)."""
+        with self._mutex:
+            self.consolidation_queue = self._inflight_batches + self.consolidation_queue
+            self._inflight_batches = []
 
     def _add_edge(self, edge: Edge) -> None:
         """Insert into both the host shard record and the edge arena."""
@@ -929,6 +1044,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             self._save_to_persistence()
         self.user_id = new_user_id
         self._load_from_persistence()
+        self._setup_journal()          # per-user journal; replays crashed turns
         self._log(f"👤 Switched context to user: {new_user_id}")
 
     def get_all_users(self) -> List[str]:

@@ -151,27 +151,30 @@ class ArrowStore:
 
     def search_nodes(self, embedding: List[float], user_id: str = "default",
                      limit: int = 10) -> List[str]:
-        """Protocol-parity brute-force cosine over durable rows. The serving
-        path uses the HBM arena instead."""
+        """Protocol-parity exact cosine top-k over durable rows (the serving
+        path uses the HBM arena instead). Runs through the native
+        multithreaded kernel when built, else vectorized numpy — both replace
+        the reference's per-row LanceDB round trip for store-only consumers."""
         with self._lock:
             rows = self._read_rows("nodes", user_id)
         if not rows or not embedding:
             return []
         q = np.asarray(embedding, np.float32)
-        qn = np.linalg.norm(q)
-        if qn == 0:
+        if np.linalg.norm(q) == 0:
             return []
-        scored = []
+        ids = []
+        embs = []
         for r in rows:
-            e = np.asarray(r["embedding"], np.float32)
-            if e.size != q.size:
-                continue
-            en = np.linalg.norm(e)
-            if en == 0:
-                continue
-            scored.append((float(np.dot(q, e) / (qn * en)), r["id"]))
-        scored.sort(reverse=True)
-        return [nid for _, nid in scored[:limit]]
+            e = r["embedding"]
+            if len(e) == q.size:
+                ids.append(r["id"])
+                embs.append(e)
+        if not ids:
+            return []
+        from lazzaro_tpu import native
+        _, top_rows = native.masked_topk(
+            np.asarray(embs, np.float32), None, q, min(limit, len(ids)))
+        return [ids[i] for i in top_rows if i >= 0]
 
     def delete_nodes(self, node_ids: List[str], user_id: str = "default") -> None:
         with self._lock:

@@ -50,6 +50,12 @@ class HashTokenizer:
         return ids[:max_len]
 
     def batch_encode(self, texts: List[str], max_len: int | None = None) -> List[List[int]]:
+        """Batch path routes through the native C++ encoder when built
+        (bit-identical for ASCII; non-ASCII rows fall back per-row here)."""
+        max_len = max_len or self.max_len
+        from lazzaro_tpu import native
+        if native.available():
+            return native.encode_batch(texts, self.vocab_size, max_len).tolist()
         return [self.encode(t, max_len) for t in texts]
 
 

@@ -1,0 +1,119 @@
+"""Lazy native build: compile csrc/lazzaro_native.cc into a cached .so.
+
+The reference ships no native code of its own — it rides LanceDB/pyarrow
+wheels (SURVEY.md §2). Here the native host library is in-tree, so the build
+has to be self-contained: one ``g++ -O3 -shared`` invocation, cached by source
+hash, with a CMakeLists.txt alongside for formal builds. Import never fails —
+callers check ``load() is not None`` and fall back to pure Python/numpy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csrc", "lazzaro_native.cc")
+
+_CXX_FLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+              "-fvisibility=default", "-Wall"]
+
+
+def _cache_dir() -> str:
+    override = os.environ.get("LAZZARO_NATIVE_CACHE")
+    if override:
+        return override
+    return os.path.join(_HERE, "_build")
+
+
+def _source_tag() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.blake2b(f.read(), digest_size=8).hexdigest()
+
+
+def so_path() -> str:
+    return os.path.join(_cache_dir(), f"liblazzaro_native-{_source_tag()}.so")
+
+
+def build(verbose: bool = False) -> Optional[str]:
+    """Compile if needed; returns the .so path or None when no toolchain."""
+    path = so_path()
+    if os.path.exists(path):
+        return path
+    cxx = os.environ.get("CXX", "g++")
+    os.makedirs(_cache_dir(), exist_ok=True)
+    # Build to a temp name then atomic-rename so concurrent importers never
+    # dlopen a half-written object.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_cache_dir())
+    os.close(fd)
+    cmd = [cxx, *_CXX_FLAGS, _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        os.unlink(tmp)
+        return None
+    if proc.returncode != 0:
+        if verbose:
+            print(proc.stderr)
+        os.unlink(tmp)
+        return None
+    os.replace(tmp, path)
+    return path
+
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """dlopen the native library (building it on first use); None if
+    unavailable. Set LAZZARO_DISABLE_NATIVE=1 to force the Python paths."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("LAZZARO_DISABLE_NATIVE"):
+        return None
+    path = build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+
+    lib.lz_abi_version.restype = ctypes.c_int32
+    lib.lz_blake2b8.restype = ctypes.c_uint64
+    lib.lz_blake2b8.argtypes = [u8p, ctypes.c_int64]
+    lib.lz_encode_batch.restype = None
+    lib.lz_encode_batch.argtypes = [u8p, i64p, ctypes.c_int64, ctypes.c_int32,
+                                    ctypes.c_int32, i32p]
+    lib.lz_masked_topk_f32.restype = None
+    lib.lz_masked_topk_f32.argtypes = [f32p, u8p, f32p, ctypes.c_int64,
+                                       ctypes.c_int64, ctypes.c_int32,
+                                       ctypes.c_int32, f32p, i64p]
+    lib.lz_crc32.restype = ctypes.c_uint32
+    lib.lz_crc32.argtypes = [u8p, ctypes.c_int64]
+    lib.lz_wal_append.restype = ctypes.c_int64
+    lib.lz_wal_append.argtypes = [ctypes.c_char_p, u8p, ctypes.c_int64,
+                                  ctypes.c_int32]
+    lib.lz_wal_load.restype = ctypes.c_void_p  # malloc'd; freed via lz_free
+    lib.lz_wal_load.argtypes = [ctypes.c_char_p, i64p]
+    lib.lz_free.restype = None
+    lib.lz_free.argtypes = [ctypes.c_void_p]
+    lib.lz_wal_reset.restype = ctypes.c_int64
+    lib.lz_wal_reset.argtypes = [ctypes.c_char_p]
+
+    if lib.lz_abi_version() != 1:
+        return None
+    _LIB = lib
+    return _LIB

@@ -1,0 +1,425 @@
+// lazzaro_tpu native host runtime.
+//
+// The reference (thelaycon/lazzaro) delegates all native-performance work to
+// external wheels: LanceDB (Rust) for ANN + durability, pyarrow (C++) for
+// columnar IO, numpy (C) for similarity math (SURVEY.md §2). This library is
+// the in-tree equivalent for the HOST side of the TPU framework — the device
+// side is JAX/XLA/Pallas; everything here backs the host paths:
+//
+//   1. lz_masked_topk_f32  — multithreaded masked cosine top-k over row-major
+//      f32 embeddings. Backs ArrowStore.search_nodes (protocol-parity search
+//      for store-only consumers, reference vector_store.py:132-140) on hosts
+//      without an accelerator.
+//   2. lz_encode_batch     — batch hash-bucket tokenization (blake2b-8, RFC
+//      7693), bit-identical to models/tokenizer.py::HashTokenizer for ASCII
+//      text. Removes the per-token hashlib round-trips from the encoder's
+//      host preprocessing.
+//   3. lz_wal_*            — a CRC-32-framed append-only write-ahead log with
+//      explicit fsync. The reference persists only at conversation end
+//      (memory_system.py:648) and has no crash story (SURVEY §5 "failure
+//      detection: none"); the WAL journals short-term turns so an agent
+//      process crash loses nothing.
+//
+// Plain C ABI (extern "C") consumed via ctypes — no pybind11 in this image.
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// blake2b (RFC 7693), unkeyed, 8-byte digest — matches hashlib.blake2b(
+// token, digest_size=8) so native and Python tokenizers agree bucket-for-
+// bucket (models/tokenizer.py::_bucket).
+// ---------------------------------------------------------------------------
+
+static const uint64_t B2B_IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+};
+
+static const uint8_t B2B_SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+static inline uint64_t load64le(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);  // little-endian hosts only (x86-64 / aarch64)
+  return v;
+}
+
+static void b2b_compress(uint64_t h[8], const uint8_t block[128], uint64_t t,
+                         bool last) {
+  uint64_t v[16], m[16];
+  for (int i = 0; i < 8; i++) v[i] = h[i];
+  for (int i = 0; i < 8; i++) v[i + 8] = B2B_IV[i];
+  v[12] ^= t;  // low word of the offset counter; messages here are < 2^64
+  if (last) v[14] = ~v[14];
+  for (int i = 0; i < 16; i++) m[i] = load64le(block + 8 * i);
+
+#define B2B_G(a, b, c, d, x, y)           \
+  do {                                    \
+    v[a] = v[a] + v[b] + (x);             \
+    v[d] = rotr64(v[d] ^ v[a], 32);       \
+    v[c] = v[c] + v[d];                   \
+    v[b] = rotr64(v[b] ^ v[c], 24);       \
+    v[a] = v[a] + v[b] + (y);             \
+    v[d] = rotr64(v[d] ^ v[a], 16);       \
+    v[c] = v[c] + v[d];                   \
+    v[b] = rotr64(v[b] ^ v[c], 63);       \
+  } while (0)
+
+  for (int r = 0; r < 12; r++) {
+    const uint8_t* s = B2B_SIGMA[r];
+    B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+    B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+    B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+    B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+    B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+    B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+    B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+    B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+  }
+#undef B2B_G
+
+  for (int i = 0; i < 8; i++) h[i] ^= v[i] ^ v[i + 8];
+}
+
+// 8-byte unkeyed blake2b of data[0:len], returned as the uint64 whose
+// little-endian serialization is the digest (== int.from_bytes(d, "little")).
+uint64_t lz_blake2b8(const uint8_t* data, int64_t len) {
+  uint64_t h[8];
+  for (int i = 0; i < 8; i++) h[i] = B2B_IV[i];
+  h[0] ^= 0x01010000ULL ^ 8ULL;  // depth=1, fanout=1, outlen=8, no key
+
+  uint64_t t = 0;
+  while (len > 128) {
+    t += 128;
+    b2b_compress(h, data, t, false);
+    data += 128;
+    len -= 128;
+  }
+  uint8_t block[128];
+  memset(block, 0, sizeof(block));
+  memcpy(block, data, (size_t)len);
+  t += (uint64_t)len;
+  b2b_compress(h, block, t, true);
+  return h[0];
+}
+
+// ---------------------------------------------------------------------------
+// Batch hash tokenization.
+//
+// Mirrors HashTokenizer.encode: lowercase, split on [a-z0-9]+ runs, bucket =
+// RESERVED + blake2b8(token) % (vocab_size - RESERVED); layout
+// [CLS] tok... [SEP] PAD..., truncated to max_len (at most max_len - 2
+// content tokens). ASCII-exact vs the Python implementation; callers route
+// non-ASCII strings through Python.
+// ---------------------------------------------------------------------------
+
+enum { LZ_PAD = 0, LZ_CLS = 1, LZ_SEP = 2, LZ_RESERVED = 4 };
+
+void lz_encode_one(const uint8_t* text, int64_t len, int32_t vocab_size,
+                   int32_t max_len, int32_t* out) {
+  const uint64_t space = (uint64_t)(vocab_size - LZ_RESERVED);
+  if (max_len <= 0) return;
+  int32_t pos = 0;
+  out[pos++] = LZ_CLS;  // matches Python ids[:max_len]: CLS survives, SEP may not
+  std::vector<uint8_t> tok;  // tokens can be arbitrarily long; hash them whole
+  for (int64_t i = 0; i <= len && pos < max_len - 1; i++) {
+    uint8_t c = (i < len) ? text[i] : 0;
+    if (c >= 'A' && c <= 'Z') c = c - 'A' + 'a';
+    bool is_tok = (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9');
+    if (is_tok) {
+      tok.push_back(c);
+    } else if (!tok.empty()) {
+      out[pos++] = LZ_RESERVED +
+                   (int32_t)(lz_blake2b8(tok.data(), (int64_t)tok.size()) % space);
+      tok.clear();
+    }
+  }
+  if (pos < max_len) out[pos++] = LZ_SEP;
+  while (pos < max_len) out[pos++] = LZ_PAD;
+}
+
+// texts: concatenated UTF-8 bytes; offsets: n+1 cumulative byte offsets.
+// out: [n, max_len] int32, row-major.
+void lz_encode_batch(const uint8_t* texts, const int64_t* offsets, int64_t n,
+                     int32_t vocab_size, int32_t max_len, int32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    lz_encode_one(texts + offsets[i], offsets[i + 1] - offsets[i], vocab_size,
+                  max_len, out + i * max_len);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Masked cosine top-k.
+//
+// emb: [n, d] row-major f32 (need not be pre-normalized); alive: [n] u8 mask;
+// query: [d] f32. Writes k (score, row) pairs sorted descending; rows with
+// alive==0 or zero norm never appear (emitted as row=-1, score=-inf when
+// fewer than k alive rows exist). nthreads<=0 picks hardware concurrency.
+// ---------------------------------------------------------------------------
+
+struct TopKHeap {  // fixed-size min-heap on score
+  float* scores;
+  int64_t* rows;
+  int32_t k;
+  int32_t size = 0;
+
+  void push(float s, int64_t r) {
+    if (size < k) {
+      scores[size] = s;
+      rows[size] = r;
+      size++;
+      sift_up(size - 1);
+    } else if (s > scores[0]) {
+      scores[0] = s;
+      rows[0] = r;
+      sift_down(0);
+    }
+  }
+  void sift_up(int32_t i) {
+    while (i > 0) {
+      int32_t p = (i - 1) / 2;
+      if (scores[p] <= scores[i]) break;
+      std::swap(scores[p], scores[i]);
+      std::swap(rows[p], rows[i]);
+      i = p;
+    }
+  }
+  void sift_down(int32_t i) {
+    for (;;) {
+      int32_t l = 2 * i + 1, r = 2 * i + 2, m = i;
+      if (l < size && scores[l] < scores[m]) m = l;
+      if (r < size && scores[r] < scores[m]) m = r;
+      if (m == i) break;
+      std::swap(scores[m], scores[i]);
+      std::swap(rows[m], rows[i]);
+      i = m;
+    }
+  }
+};
+
+static void topk_range(const float* emb, const uint8_t* alive,
+                       const float* query, int64_t d, int64_t lo, int64_t hi,
+                       float inv_qnorm, TopKHeap* heap) {
+  for (int64_t i = lo; i < hi; i++) {
+    if (alive && !alive[i]) continue;
+    const float* row = emb + i * d;
+    float dot = 0.f, sq = 0.f;
+    for (int64_t j = 0; j < d; j++) {  // auto-vectorizes under -O3
+      dot += row[j] * query[j];
+      sq += row[j] * row[j];
+    }
+    if (sq <= 0.f) continue;
+    heap->push(dot * inv_qnorm / sqrtf(sq), i);
+  }
+}
+
+void lz_masked_topk_f32(const float* emb, const uint8_t* alive,
+                        const float* query, int64_t n, int64_t d, int32_t k,
+                        int32_t nthreads, float* out_scores,
+                        int64_t* out_rows) {
+  float qsq = 0.f;
+  for (int64_t j = 0; j < d; j++) qsq += query[j] * query[j];
+  for (int32_t i = 0; i < k; i++) {
+    out_scores[i] = -1e30f;
+    out_rows[i] = -1;
+  }
+  if (qsq <= 0.f || n <= 0) return;
+  float inv_qnorm = 1.f / sqrtf(qsq);
+
+  if (nthreads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    nthreads = hc ? (int32_t)hc : 4;
+  }
+  // Below ~64k rows the thread spawn costs more than it saves.
+  int64_t min_rows_per_thread = 65536;
+  int32_t t = (int32_t)((n + min_rows_per_thread - 1) / min_rows_per_thread);
+  if (t < nthreads) nthreads = t < 1 ? 1 : t;
+
+  std::vector<std::vector<float>> tscores(nthreads, std::vector<float>(k));
+  std::vector<std::vector<int64_t>> trows(nthreads, std::vector<int64_t>(k));
+  std::vector<TopKHeap> heaps(nthreads);
+  std::vector<std::thread> workers;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int32_t ti = 0; ti < nthreads; ti++) {
+    heaps[ti] = TopKHeap{tscores[ti].data(), trows[ti].data(), k, 0};
+    int64_t lo = ti * chunk, hi = std::min(n, lo + chunk);
+    workers.emplace_back(topk_range, emb, alive, query, d, lo, hi, inv_qnorm,
+                         &heaps[ti]);
+  }
+  for (auto& w : workers) w.join();
+
+  TopKHeap merged{out_scores, out_rows, k, 0};
+  for (int32_t i = 0; i < k; i++) {  // reset sentinel fill before merging
+    out_scores[i] = -1e30f;
+    out_rows[i] = -1;
+  }
+  for (int32_t ti = 0; ti < nthreads; ti++)
+    for (int32_t i = 0; i < heaps[ti].size; i++)
+      merged.push(tscores[ti][i], trows[ti][i]);
+
+  // Heap → descending order (stable tie-break on row asc for determinism).
+  struct Pair {
+    float s;
+    int64_t r;
+  };
+  std::vector<Pair> pairs(merged.size);
+  for (int32_t i = 0; i < merged.size; i++) pairs[i] = {out_scores[i], out_rows[i]};
+  std::sort(pairs.begin(), pairs.end(), [](const Pair& a, const Pair& b) {
+    if (a.s != b.s) return a.s > b.s;
+    return a.r < b.r;
+  });
+  for (int32_t i = 0; i < k; i++) {
+    if (i < (int32_t)pairs.size()) {
+      out_scores[i] = pairs[i].s;
+      out_rows[i] = pairs[i].r;
+    } else {
+      out_scores[i] = -1e30f;
+      out_rows[i] = -1;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Write-ahead log.
+//
+// On-disk framing per record: u32 magic 'LZW1' | u32 payload_len |
+// u32 crc32(payload) | payload bytes. Append is a single write(2) followed by
+// fdatasync, so a crash mid-append leaves at most one torn tail record, which
+// replay detects (bad magic/len/crc) and discards.
+// ---------------------------------------------------------------------------
+
+static const uint32_t LZ_WAL_MAGIC = 0x4c5a5731u;  // "LZW1" little-endian
+
+static uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t len) {
+  static uint32_t table[256];
+  static std::atomic<bool> ready{false};
+  if (!ready.load(std::memory_order_acquire)) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int j = 0; j < 8; j++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    ready.store(true, std::memory_order_release);
+  }
+  crc = ~crc;
+  for (size_t i = 0; i < len; i++) crc = table[(crc ^ p[i]) & 0xff] ^ (crc >> 8);
+  return ~crc;
+}
+
+uint32_t lz_crc32(const uint8_t* p, int64_t len) {
+  return crc32_update(0, p, (size_t)len);
+}
+
+// Appends one record; returns 0 on success, negative errno-style code on
+// failure. do_fsync=1 makes the record durable before returning.
+int64_t lz_wal_append(const char* path, const uint8_t* data, int64_t len,
+                      int32_t do_fsync) {
+  int fd = open(path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd < 0) return -1;
+  uint32_t header[3] = {LZ_WAL_MAGIC, (uint32_t)len,
+                        crc32_update(0, data, (size_t)len)};
+  std::vector<uint8_t> buf(sizeof(header) + (size_t)len);
+  memcpy(buf.data(), header, sizeof(header));
+  if (len > 0) memcpy(buf.data() + sizeof(header), data, (size_t)len);
+  const uint8_t* p = buf.data();
+  size_t remaining = buf.size();
+  while (remaining > 0) {
+    ssize_t w = write(fd, p, remaining);
+    if (w < 0) {
+      close(fd);
+      return -2;
+    }
+    p += w;
+    remaining -= (size_t)w;
+  }
+  int rc = 0;
+  if (do_fsync && fdatasync(fd) != 0) rc = -3;
+  close(fd);
+  return rc;
+}
+
+// Loads all valid records. Returns a malloc'd buffer of concatenated
+// (u32 len | payload) entries and sets *out_len to its size; caller frees via
+// lz_free. Returns nullptr with *out_len = -1 if the file doesn't exist,
+// *out_len = 0 for an empty/fully-torn log. Scanning stops at the first
+// invalid record (torn tail).
+uint8_t* lz_wal_load(const char* path, int64_t* out_len) {
+  *out_len = -1;
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  long fsize = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> raw((size_t)fsize);
+  if (fsize > 0 && fread(raw.data(), 1, (size_t)fsize, f) != (size_t)fsize) {
+    fclose(f);
+    *out_len = 0;
+    return nullptr;
+  }
+  fclose(f);
+
+  std::vector<uint8_t> out;
+  size_t pos = 0;
+  while (pos + 12 <= raw.size()) {
+    uint32_t magic, len, crc;
+    memcpy(&magic, raw.data() + pos, 4);
+    memcpy(&len, raw.data() + pos + 4, 4);
+    memcpy(&crc, raw.data() + pos + 8, 4);
+    if (magic != LZ_WAL_MAGIC || pos + 12 + len > raw.size()) break;
+    if (crc32_update(0, raw.data() + pos + 12, len) != crc) break;
+    uint32_t len_le = len;
+    out.insert(out.end(), (uint8_t*)&len_le, (uint8_t*)&len_le + 4);
+    out.insert(out.end(), raw.data() + pos + 12, raw.data() + pos + 12 + len);
+    pos += 12 + len;
+  }
+  *out_len = (int64_t)out.size();
+  if (out.empty()) return nullptr;
+  uint8_t* ret = (uint8_t*)malloc(out.size());
+  memcpy(ret, out.data(), out.size());
+  return ret;
+}
+
+void lz_free(uint8_t* p) { free(p); }
+
+// Truncates (resets) the log; returns 0 on success.
+int64_t lz_wal_reset(const char* path) {
+  int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  close(fd);
+  return 0;
+}
+
+int32_t lz_abi_version() { return 1; }
+
+}  // extern "C"

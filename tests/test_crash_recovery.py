@@ -1,0 +1,159 @@
+"""Elastic recovery: the turn journal survives a process crash.
+
+The reference has no failure-detection/recovery story (SURVEY §5): it
+persists only at conversation end (memory_system.py:648), so a crash
+mid-conversation silently loses every buffered turn. Here each
+``add_to_short_term`` appends to a CRC-framed WAL; a new instance on the same
+db_dir replays it and re-opens the conversation.
+"""
+
+from lazzaro_tpu import MemorySystem
+from tests.fakes import MockEmbedder, MockLLM, extraction_response
+
+
+def _make(tmp_db, llm=None, **kw):
+    return MemorySystem(
+        llm_provider=llm or MockLLM(), embedding_provider=MockEmbedder(dim=32),
+        db_dir=tmp_db, enable_async=False, verbose=False, **kw)
+
+
+def test_crashed_turns_recovered(tmp_db):
+    ms = _make(tmp_db)
+    ms.start_conversation()
+    ms.add_to_short_term("User is a marine biologist", "semantic", 0.9)
+    ms.add_to_short_term("User visited a coral reef today", "episodic", 0.7)
+    # Simulated crash: no end_conversation, no close.
+
+    llm = MockLLM(sniffers={"Extract distinct": extraction_response([
+        {"content": "User is a marine biologist", "type": "semantic",
+         "salience": 0.9, "topic": "work"}])})
+    ms2 = _make(tmp_db, llm=llm)
+    assert ms2.conversation_active
+    contents = [t["content"] for t in ms2.short_term_memory]
+    assert contents == ["User is a marine biologist",
+                        "User visited a coral reef today"]
+
+    # The recovered conversation consolidates normally.
+    ms2.end_conversation()
+    assert any("marine" in n.content
+               for n in ms2.search_memories("User is a marine biologist"))
+
+
+def test_journal_cleared_after_consolidation(tmp_db):
+    llm = MockLLM(sniffers={"Extract distinct": extraction_response([
+        {"content": "User likes tea", "type": "semantic",
+         "salience": 0.6, "topic": "personal"}])})
+    ms = _make(tmp_db, llm=llm)
+    ms.start_conversation()
+    ms.add_to_short_term("User likes tea", "semantic", 0.6)
+    ms.end_conversation()
+
+    ms2 = _make(tmp_db)
+    assert not ms2.conversation_active
+    assert ms2.short_term_memory == []
+
+
+def test_journal_is_per_user(tmp_db):
+    ms = _make(tmp_db, user_id="alice")
+    ms.start_conversation()
+    ms.add_to_short_term("Alice plays violin", "semantic", 0.8)
+
+    bob = _make(tmp_db, user_id="bob")
+    assert not bob.conversation_active
+    alice2 = _make(tmp_db, user_id="alice")
+    assert alice2.conversation_active
+    assert alice2.short_term_memory[0]["content"] == "Alice plays violin"
+
+
+def test_journal_disabled_flag(tmp_db):
+    ms = _make(tmp_db)
+    ms.config.journal = False
+    ms._setup_journal()
+    assert ms._journal is None
+    ms.start_conversation()
+    ms.add_to_short_term("ephemeral turn", "semantic", 0.5)
+
+    ms2 = _make(tmp_db)
+    # The flag-off turn was never journaled, so nothing to recover.
+    assert all(t["content"] != "ephemeral turn" for t in ms2.short_term_memory)
+
+
+def test_start_conversation_consolidates_recovered_turns(tmp_db):
+    """A recovered buffer must survive the common post-restart '/start' flow
+    (not be silently discarded the way a normal abandoned buffer is)."""
+    ms = _make(tmp_db)
+    ms.start_conversation()
+    ms.add_to_short_term("User speaks Basque", "semantic", 0.9)
+    # crash
+
+    llm = MockLLM(sniffers={"Extract distinct": extraction_response([
+        {"content": "User speaks Basque", "type": "semantic",
+         "salience": 0.9, "topic": "personal"}])})
+    ms2 = _make(tmp_db, llm=llm)
+    assert ms2._recovered_turns
+    ms2.start_conversation()           # consolidates, then opens fresh buffer
+    assert ms2.short_term_memory == []
+    assert any("Basque" in n.content
+               for n in ms2.search_memories("User speaks Basque"))
+
+
+def test_abandoned_buffer_discarded_on_start(tmp_db):
+    """Reference parity: a NON-recovered active buffer is dropped by
+    start_conversation, and its journal entries go with it."""
+    ms = _make(tmp_db)
+    ms.start_conversation()
+    ms.add_to_short_term("abandoned turn", "semantic", 0.5)
+    ms.start_conversation()
+    assert ms.short_term_memory == []
+
+    ms2 = _make(tmp_db)
+    assert all(t["content"] != "abandoned turn" for t in ms2.short_term_memory)
+
+
+def test_async_consolidation_does_not_wipe_new_turns(tmp_db):
+    """Background consolidation finishing after a new conversation started
+    must leave the new conversation's turns in the WAL."""
+    llm = MockLLM(sniffers={"Extract distinct": extraction_response([
+        {"content": "User ran a marathon", "type": "episodic",
+         "salience": 0.8, "topic": "health"}])})
+    ms = MemorySystem(llm_provider=llm, embedding_provider=MockEmbedder(dim=32),
+                      db_dir=tmp_db, enable_async=True, verbose=False)
+    ms.start_conversation()
+    ms.add_to_short_term("User ran a marathon", "episodic", 0.8)
+    ms.end_conversation()              # queues background consolidation
+    ms.start_conversation()
+    ms.add_to_short_term("fresh turn after restart of convo", "semantic", 0.6)
+    ms._drain_background()             # consolidation completes + journal sync
+    ms.close()
+
+    ms2 = _make(tmp_db)
+    contents = [t["content"] for t in ms2.short_term_memory]
+    assert contents == ["fresh turn after restart of convo"]
+
+
+def test_load_from_disk_false_skips_replay(tmp_db):
+    ms = _make(tmp_db)
+    ms.start_conversation()
+    ms.add_to_short_term("persisted-in-wal", "semantic", 0.5)
+    # crash
+
+    clean = _make(tmp_db, load_from_disk=False)
+    assert not clean.conversation_active
+    assert clean.short_term_memory == []
+    # ...and the crashed turns are still recoverable by a loading instance.
+    ms2 = _make(tmp_db)
+    assert [t["content"] for t in ms2.short_term_memory] == ["persisted-in-wal"]
+
+
+def test_injected_store_skips_journal():
+    """In-memory stores (no db_dir attribute) get no journal."""
+
+    class NullStore:
+        def close(self):
+            pass
+
+    ms = MemorySystem(llm_provider=MockLLM(),
+                      embedding_provider=MockEmbedder(dim=32),
+                      store=NullStore(), load_from_disk=False,
+                      enable_async=False, verbose=False)
+    assert ms._journal is None
