@@ -43,6 +43,13 @@ class LMConfig:
     max_seq: int = 2048
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"
+    # Cache-less full-sequence attention (training forward / logits_for):
+    # "xla" = einsum + materialized scores; "flash" = Pallas fused online-
+    # softmax kernel (ops/flash_attention.py) — GQA-aware, causal-skipping.
+    # generate()'s prefill/decode passes a KV cache and always uses "xla".
+    # Single-device kernel: incompatible with a >1 'model' mesh axis
+    # (make_train_step raises).
+    attn_impl: str = "xla"
 
     @staticmethod
     def tiny() -> "LMConfig":
@@ -102,37 +109,40 @@ class Attention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
 
-        if cache is not None:
+        assert cfg.attn_impl in ("xla", "flash"), \
+            f"attn_impl must be 'xla' or 'flash', got {cfg.attn_impl!r}"
+        if cache is None and cfg.attn_impl == "flash":
+            from lazzaro_tpu.ops.flash_attention import flash_attention
+            out = flash_attention(q, k, v).astype(dt)   # [B,T,H,D], GQA inside
+            new_cache = None
+        elif cache is not None:
             # Prefill/decode: scatter this call's K/V rows into the cache at
             # their positions, then attend over the whole cache with a
             # causal-vs-position mask.
             batch_idx = jnp.arange(B)[:, None]                 # [B, 1]
             ck = cache["k"].at[batch_idx, positions].set(k.astype(dt))
             cv = cache["v"].at[batch_idx, positions].set(v.astype(dt))
-            k_all, v_all = ck, cv
             new_cache = {"k": ck, "v": cv}
             kv_len = ck.shape[1]
             kv_pos = jnp.arange(kv_len)[None, None, :]          # [1, 1, S]
             attn_mask = kv_pos <= positions[:, :, None]         # [B, T, S]
+            out = self._xla_attention(q, ck, cv, attn_mask)
         else:
-            k_all, v_all = k, v
             new_cache = None
             attn_mask = jnp.broadcast_to(
                 jnp.tril(jnp.ones((T, T), bool))[None], (B, T, T))
+            out = self._xla_attention(q, k, v, attn_mask)
 
-        # GQA: repeat kv heads
-        rep = cfg.heads // cfg.kv_heads
-        k_all = jnp.repeat(k_all, rep, axis=2)
-        v_all = jnp.repeat(v_all, rep, axis=2)
-
-        scores = jnp.einsum("bthd,bshd->bhts", q, k_all).astype(jnp.float32)
-        scores = scores / np.sqrt(cfg.head_dim)
-        scores = jnp.where(attn_mask[:, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v_all)
         out = nn.DenseGeneral(cfg.hidden, axis=(-2, -1), use_bias=False,
                               dtype=dt, name="o")(out)
         return out, new_cache
+
+    def _xla_attention(self, q, k_all, v_all, attn_mask):
+        """Materialized-scores path: [B,T,H,D] × [B,S,Hkv,D] → [B,T,H,D].
+        Delegates to the one canonical einsum formulation so the XLA path,
+        the flash VJP, and the parity oracle can never diverge."""
+        from lazzaro_tpu.ops.flash_attention import reference_attention
+        return reference_attention(q, k_all, v_all, attn_mask)
 
 
 class MLP(nn.Module):
@@ -239,9 +249,25 @@ def shard_params(params: Dict, mesh: Mesh) -> Dict:
 # ---------------------------------------------------------------------------
 
 
+def _check_flash_tensor_parallel(cfg: LMConfig, mesh: Optional[Mesh]) -> None:
+    """attn_impl='flash' is a single-device kernel: pallas_call has no
+    partitioning rule for a heads-sharded 'model' axis. Every place a config
+    meets a mesh routes through here so the failure is a clear error, not an
+    obscure SPMD one."""
+    if (cfg.attn_impl == "flash" and mesh is not None
+            and "model" in mesh.axis_names and mesh.shape["model"] > 1):
+        raise ValueError(
+            "attn_impl='flash' is a single-device kernel; pallas_call has no "
+            "partitioning rule for a heads-sharded 'model' axis — use "
+            "attn_impl='xla' under tensor parallelism")
+
+
 def make_train_step(cfg: LMConfig, optimizer, mesh: Optional[Mesh] = None):
     """Next-token CE train step. With a mesh: batch over 'data', params over
-    'model' (call ``shard_params`` on params and optimizer state first)."""
+    'model' (call ``shard_params`` on params and optimizer state first).
+    NOTE: attn_impl='flash' speeds the forward only — its VJP recomputes via
+    the materialized-scores reference, so training peak HBM is unchanged."""
+    _check_flash_tensor_parallel(cfg, mesh)
     model = Decoder(cfg)
 
     def loss_fn(params, tokens, mask):
@@ -276,6 +302,7 @@ class LanguageModel:
     def __init__(self, cfg: Optional[LMConfig] = None, seed: int = 0,
                  mesh: Optional[Mesh] = None):
         self.cfg = cfg or LMConfig.small()
+        _check_flash_tensor_parallel(self.cfg, mesh)
         self.tokenizer = ByteTokenizer()
         self.model = Decoder(self.cfg)
         dummy = jnp.zeros((1, 8), jnp.int32)

@@ -1,0 +1,216 @@
+"""Pallas TPU kernel: causal flash attention with GQA (online softmax).
+
+The decoder LM's full-sequence attention (``models/llm.py`` Attention) is the
+FLOPs-heavy op of on-TPU consolidation and training. The plain XLA path
+materializes a [B, H, T, S] f32 score tensor in HBM; this kernel tiles Q into
+VMEM blocks and streams K/V through VMEM one ``blk_k`` block per grid step
+(accumulators live in VMEM scratch across the inner grid dimension), so the
+score tensor never touches HBM, VMEM usage is independent of sequence length,
+and the matmuls stay on the MXU in the input dtype (bf16) with f32
+accumulation.
+
+Grouped-query attention costs nothing here: the K/V BlockSpec index map sends
+query head ``h`` to kv head ``h // rep``, so kv heads are never materialized
+``rep`` times (the XLA path pays a ``jnp.repeat``).
+
+The causal mask is END-ALIGNED: query row ``i`` (of T) attends keys
+``0 .. (S - T) + i``, so chunked prefill — q = the last T positions of an
+S-token context — is supported, with standard self-attention as the S == T
+special case. Fully-masked kv blocks above the diagonal skip their compute
+via predication.
+
+The backward pass is a custom VJP that recomputes attention with the
+reference einsum formulation — forward gets the fused kernel, training gets
+correct (XLA-fused) gradients. Consequence: the backward DOES materialize the
+[B, H, T, S] score tensor, so training peak HBM is unchanged vs the XLA path;
+the kernel's memory/speed win applies to forward-only paths (``logits_for``,
+scoring, evaluation). A fused flash backward is future work.
+
+Single-device semantics: under a tensor-parallel ('model') mesh the heads
+axis is sharded and ``pallas_call`` has no partitioning rule — callers must
+run it inside ``shard_map`` or fall back to the XLA path
+(``models/llm.py`` guards this).
+
+Use ``interpret=True`` (automatic off-TPU) for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e30
+LANES = 128   # scalar-per-row scratch is stored broadcast across lanes
+
+
+def _flash_kernel(blk_q: int, blk_k: int, nk: int, offset: int, scale: float):
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+        iq = pl.program_id(2)
+        jk = pl.program_id(3)
+
+        @pl.when(jk == 0)
+        def _():
+            m_ref[:] = jnp.full_like(m_ref, NEG)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        # Query rows of this block cover absolute key window up to
+        # offset + iq*blk_q + blk_q - 1; kv blocks fully above it skip.
+        @pl.when(jk * blk_k <= offset + iq * blk_q + blk_q - 1)
+        def _():
+            q = q_ref[0, 0]                                   # [blk_q, D]
+            k_blk = k_ref[0, 0]                               # [blk_k, D]
+            v_blk = v_ref[0, 0]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [blk_q, blk_k]
+            row = offset + iq * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            col = jk * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(col <= row, s, NEG)
+            m_prev = m_ref[:, :1]                             # [blk_q, 1]
+            l_prev = l_ref[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+                p.astype(v_blk.dtype), v_blk,
+                preferred_element_type=jnp.float32)
+            m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(jk == nk - 1)
+        def _():
+            l = jnp.maximum(l_ref[:, :1], 1e-30)
+            o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("blk_q", "blk_k", "offset", "interpret"))
+def _flash_fwd_bhtd(q: jax.Array, k: jax.Array, v: jax.Array,
+                    blk_q: int, blk_k: int, offset: int,
+                    interpret: bool) -> jax.Array:
+    """q [B, H, T, D], k/v [B, Hkv, S, D] (pre-transposed; T % blk_q == 0,
+    S % blk_k == 0). ``offset`` is the UNPADDED S - T: query row i attends
+    absolute keys 0..offset+i (padded tail rows/cols are positionally
+    outside every real window). → [B, H, T, D]."""
+    B, H, T, D = q.shape
+    _, Hkv, S, _ = k.shape
+    assert H % Hkv == 0, f"heads {H} not a multiple of kv heads {Hkv}"
+    rep = H // Hkv
+    nq, nk = T // blk_q, S // blk_k
+    scale = 1.0 / np.sqrt(D)
+
+    return pl.pallas_call(
+        _flash_kernel(blk_q, blk_k, nk, offset, scale),
+        grid=(B, H, nq, nk),          # jk innermost: accumulators in scratch
+        in_specs=[
+            pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, blk_k, D),
+                         lambda b, h, i, j: (b, h // rep, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, blk_k, D),
+                         lambda b, h, i, j: (b, h // rep, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, blk_q, D),
+                               lambda b, h, i, j: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, LANES), jnp.float32),   # running max m
+            pltpu.VMEM((blk_q, LANES), jnp.float32),   # running sum l
+            pltpu.VMEM((blk_q, D), jnp.float32),       # output accumulator
+        ],
+        # B/H/nq are independent → Megacore-parallel; only the innermost nk
+        # dimension carries the scratch accumulators and must stay sequential.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, attn_mask):
+    """Materialized-scores GQA attention — THE canonical einsum formulation,
+    shared by the decoder's XLA path (``models/llm.py``), the flash VJP, and
+    the parity tests. q [B,T,H,D], k/v [B,S,Hkv,D], attn_mask [B,T,S] (or
+    broadcastable) → [B,T,H,D] in q's dtype."""
+    H, D = q.shape[2], q.shape[3]
+    Hkv = k.shape[2]
+    k = jnp.repeat(k, H // Hkv, axis=2)
+    v = jnp.repeat(v, H // Hkv, axis=2)
+    s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) / np.sqrt(D)
+    s = jnp.where(attn_mask[:, None], s, NEG)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", p, v)
+
+
+def _reference_gqa(q, k, v):
+    """End-aligned causal reference — VJP + parity oracle."""
+    T, S = q.shape[1], k.shape[1]
+    row = (S - T) + jnp.arange(T)[:, None]
+    col = jnp.arange(S)[None, :]
+    return reference_attention(q, k, v, (col <= row)[None])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    blk_q: int = 128, blk_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Causal GQA flash attention.
+
+    q: [B, T, H, D]; k, v: [B, S, Hkv, D] with H % Hkv == 0 and S >= T. The
+    causal diagonal is end-aligned: query row i attends keys 0..(S-T)+i
+    (standard self-attention when S == T; chunked prefill when S > T).
+    Sequence lengths are padded internally to the block size — padded kv
+    columns fall outside every real row's causal window, so no explicit
+    length mask is needed. Returns [B, T, H, D] in q's dtype.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    if S < T:
+        raise ValueError(f"kv length {S} shorter than query length {T}")
+    blk_q = min(blk_q, max(8, 1 << (T - 1).bit_length()))
+    blk_k = min(blk_k, max(8, 1 << (S - 1).bit_length()))
+    Tp = -(-T // blk_q) * blk_q
+    Sp = -(-S // blk_k) * blk_k
+    qt = jnp.moveaxis(q, 1, 2)                      # [B, H, T, D]
+    kt = jnp.moveaxis(k, 1, 2)
+    vt = jnp.moveaxis(v, 1, 2)
+    # Back-pad both; the kernel masks by ABSOLUTE positions with the
+    # unpadded offset S - T, so padded q rows are garbage (sliced off) and
+    # padded kv columns sit beyond every real row's window.
+    if Tp != T:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, Tp - T), (0, 0)))
+    if Sp != S:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+    out = _flash_fwd_bhtd(qt, kt, vt, blk_q, blk_k, S - T, interpret)
+    return jnp.moveaxis(out[:, :, :T], 2, 1)
+
+
+def _fwd(q, k, v, blk_q, blk_k, interpret):
+    return flash_attention(q, k, v, blk_q, blk_k, interpret), (q, k, v)
+
+
+def _bwd(blk_q, blk_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(_reference_gqa, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
