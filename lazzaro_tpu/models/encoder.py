@@ -1,9 +1,19 @@
 """On-device text encoder (bge-base-en-class) in Flax.
 
 Replaces the reference's remote embedding providers (``core/providers.py``
-OpenAIEmbedder :36-57, GeminiEmbedder :101-128, TogetherEmbedder :170-196) with
-an in-tree JAX forward pass: BERT-style pre-LN transformer, mean pooling over
-the attention mask, L2-normalized output — batched onto the MXU in bfloat16.
+OpenAIEmbedder :36-57, GeminiEmbedder :101-128, TogetherEmbedder :170-196)
+with an in-tree JAX forward pass batched onto the MXU in bfloat16.
+
+Two architectures, selected by ``EncoderConfig.arch``:
+
+- ``"pre_ln"`` (default): pre-LayerNorm transformer, mean pooling — the
+  compact in-tree geometry for random-weight / from-scratch use.
+- ``"bert"``: post-LayerNorm HF-BERT numerics (eps 1e-12, exact GELU, CLS
+  pooling) — bit-compatible with bge-base-en-class checkpoints.
+  ``TextEncoder.from_hf`` maps a ``transformers`` BertModel's weights
+  directly into this module (token-type embeddings folded into position
+  embeddings, torch Linear kernels transposed), so a locally available real
+  checkpoint drops in with zero egress.
 
 Weights are deterministic random by default (no egress to fetch checkpoints);
 ``load_params`` restores an Orbax checkpoint for real deployments. Batch data
@@ -34,6 +44,8 @@ class EncoderConfig:
     mlp_dim: int = 3072
     max_len: int = 128
     dtype: str = "bfloat16"
+    arch: str = "pre_ln"      # "pre_ln" | "bert" (HF post-LN numerics)
+    pooling: str = "mean"     # "mean" | "cls" (bge-class uses CLS)
 
     @staticmethod
     def tiny() -> "EncoderConfig":
@@ -43,6 +55,13 @@ class EncoderConfig:
     @staticmethod
     def base() -> "EncoderConfig":
         return EncoderConfig()
+
+    @staticmethod
+    def bge_base() -> "EncoderConfig":
+        """bge-base-en-v1.5 geometry (BERT-base, CLS pooling)."""
+        return EncoderConfig(vocab_size=30522, hidden=768, layers=12,
+                             heads=12, mlp_dim=3072, max_len=512,
+                             dtype="float32", arch="bert", pooling="cls")
 
 
 class EncoderBlock(nn.Module):
@@ -63,6 +82,17 @@ class EncoderBlock(nn.Module):
         return x + h
 
 
+def _pool_and_normalize(x, pad_mask, pooling: str):
+    """[B, L, H] hidden states → [B, H] f32 L2-normalized sentence vector."""
+    if pooling == "cls":
+        pooled = x.astype(jnp.float32)[:, 0]
+    else:
+        m = pad_mask[..., None].astype(jnp.float32)
+        pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)
+
+
 class Encoder(nn.Module):
     cfg: EncoderConfig
 
@@ -80,11 +110,60 @@ class Encoder(nn.Module):
         for _ in range(cfg.layers):
             x = EncoderBlock(cfg)(x, attn_mask)
         x = nn.LayerNorm(dtype=dt)(x)
-        # masked mean pooling
-        m = pad_mask[..., None].astype(jnp.float32)
-        pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
-        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
-        return pooled / jnp.maximum(norm, 1e-9)
+        return _pool_and_normalize(x, pad_mask, self.cfg.pooling)
+
+
+LN_EPS_BERT = 1e-12
+
+
+class BertLayer(nn.Module):
+    """One HF-BERT encoder layer: post-LN, exact GELU, eps 1e-12."""
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        from lazzaro_tpu.ops.flash_attention import reference_attention
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        B, L, H = x.shape
+        nh = cfg.heads
+        dh = H // nh
+        q = nn.Dense(H, dtype=dt, name="q")(x).reshape(B, L, nh, dh)
+        k = nn.Dense(H, dtype=dt, name="k")(x).reshape(B, L, nh, dh)
+        v = nn.Dense(H, dtype=dt, name="v")(x).reshape(B, L, nh, dh)
+        # Same canonical einsum formulation the decoder and flash VJP use;
+        # keys masked by padding, queries unmasked (HF semantics).
+        ctx = reference_attention(q, k, v, pad_mask[:, None, :])
+        ctx = ctx.reshape(B, L, H)
+        h = nn.Dense(H, dtype=dt, name="attn_out")(ctx)
+        x = nn.LayerNorm(epsilon=LN_EPS_BERT, dtype=dt, name="attn_ln")(x + h)
+        h = nn.Dense(cfg.mlp_dim, dtype=dt, name="ffn_in")(x)
+        h = nn.gelu(h, approximate=False)          # HF "gelu" is erf-exact
+        h = nn.Dense(H, dtype=dt, name="ffn_out")(h)
+        return nn.LayerNorm(epsilon=LN_EPS_BERT, dtype=dt, name="ffn_ln")(x + h)
+
+
+class BertEncoder(nn.Module):
+    """HF-BertModel-compatible encoder (``TextEncoder.from_hf`` fills the
+    params from a transformers checkpoint; token-type embeddings are folded
+    into ``pos_emb`` since every input is segment 0)."""
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, token_ids, return_hidden: bool = False):
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        pad_mask = token_ids != PAD_ID                        # [B, L]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=dt,
+                     name="word_emb")(token_ids)
+        x = x + nn.Embed(cfg.max_len, cfg.hidden, dtype=dt, name="pos_emb")(
+            jnp.arange(token_ids.shape[1])[None, :])
+        x = nn.LayerNorm(epsilon=LN_EPS_BERT, dtype=dt, name="emb_ln")(x)
+        for i in range(cfg.layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, pad_mask)
+        if return_hidden:
+            return x
+        return _pool_and_normalize(x, pad_mask, cfg.pooling)
 
 
 class TextEncoder:
@@ -92,13 +171,43 @@ class TextEncoder:
     power-of-two batch bucketing (static shapes, bounded compile cache)."""
 
     def __init__(self, cfg: Optional[EncoderConfig] = None, seed: int = 0,
-                 tokenizer: Optional[HashTokenizer] = None):
+                 tokenizer: Optional[HashTokenizer] = None,
+                 init_params: bool = True):
         self.cfg = cfg or EncoderConfig.base()
         self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size, self.cfg.max_len)
-        self.model = Encoder(self.cfg)
-        dummy = jnp.zeros((1, self.cfg.max_len), jnp.int32)
-        self.params = self.model.init(jax.random.PRNGKey(seed), dummy)
+        cls = BertEncoder if self.cfg.arch == "bert" else Encoder
+        self.model = cls(self.cfg)
+        if init_params:
+            dummy = jnp.zeros((1, self.cfg.max_len), jnp.int32)
+            self.params = self.model.init(jax.random.PRNGKey(seed), dummy)
+        else:
+            self.params = None        # caller installs params (from_hf)
         self._apply = jax.jit(self.model.apply)
+
+    @classmethod
+    def from_hf(cls, hf_model, tokenizer=None, pooling: str = "cls",
+                max_len: int = 128) -> "TextEncoder":
+        """Build a ``BertEncoder``-backed TextEncoder from a local
+        ``transformers`` BertModel (bge-base-en-class) — no egress, the
+        checkpoint must already be on disk/in memory.
+
+        ``tokenizer``: anything with ``batch_encode(texts, max_len) ->
+        List[List[int]]``; pass ``HFTokenizerAdapter(hf_tok, max_len)`` for
+        the checkpoint's real WordPiece vocab. Defaults to the hash
+        tokenizer (fine for smoke tests, wrong vocab for real retrieval).
+        """
+        hc = hf_model.config
+        cfg = EncoderConfig(
+            vocab_size=hc.vocab_size, hidden=hc.hidden_size,
+            layers=hc.num_hidden_layers, heads=hc.num_attention_heads,
+            mlp_dim=hc.intermediate_size,
+            max_len=min(max_len, hc.max_position_embeddings),
+            dtype="float32", arch="bert", pooling=pooling)
+        enc = cls(cfg, tokenizer=tokenizer, init_params=False)
+        enc.params = {"params": bert_params_from_hf(hf_model, cfg)}
+        if hasattr(enc.tokenizer, "max_len"):
+            enc.tokenizer.max_len = cfg.max_len    # keep pos table in range
+        return enc
 
     @property
     def dim(self) -> int:
@@ -115,7 +224,11 @@ class TextEncoder:
     def encode_batch(self, texts) -> np.ndarray:
         if not texts:
             return np.zeros((0, self.dim), np.float32)
-        ids = np.asarray(self.tokenizer.batch_encode(list(texts)), np.int32)
+        # Always tokenize to cfg.max_len: longer rows would index past the
+        # position table (Flax Embed fills OOB lookups with NaN, silently).
+        ids = np.asarray(
+            self.tokenizer.batch_encode(list(texts), self.cfg.max_len),
+            np.int32)
         n = ids.shape[0]
         bucket = 1 << (max(1, n - 1)).bit_length()
         if bucket > n:
@@ -125,3 +238,57 @@ class TextEncoder:
 
     def encode(self, text: str) -> np.ndarray:
         return self.encode_batch([text])[0]
+
+
+class HFTokenizerAdapter:
+    """Duck-types ``batch_encode`` over a HuggingFace tokenizer so a real
+    WordPiece vocab can drive ``TextEncoder`` (``from_hf``)."""
+
+    def __init__(self, hf_tokenizer, max_len: int = 128):
+        self.hf = hf_tokenizer
+        self.max_len = max_len
+
+    def batch_encode(self, texts, max_len: Optional[int] = None):
+        out = self.hf(list(texts), padding="max_length", truncation=True,
+                      max_length=max_len or self.max_len)
+        return out["input_ids"]
+
+    def encode(self, text: str, max_len: Optional[int] = None):
+        return self.batch_encode([text], max_len)[0]
+
+
+def bert_params_from_hf(hf_model, cfg: EncoderConfig) -> dict:
+    """Map a torch ``transformers`` BertModel state_dict onto ``BertEncoder``
+    params: torch Linear kernels are [out, in] → transposed; token-type
+    embedding row 0 is folded into the position table (all inputs are
+    segment 0, so the sums are identical)."""
+    sd = {k: np.asarray(v.detach().cpu().numpy())
+          for k, v in hf_model.state_dict().items()}
+
+    def dense(prefix):
+        return {"kernel": sd[f"{prefix}.weight"].T.copy(),
+                "bias": sd[f"{prefix}.bias"]}
+
+    def ln(prefix):
+        return {"scale": sd[f"{prefix}.weight"], "bias": sd[f"{prefix}.bias"]}
+
+    pos = sd["embeddings.position_embeddings.weight"][:cfg.max_len].copy()
+    pos += sd["embeddings.token_type_embeddings.weight"][0]
+    params = {
+        "word_emb": {"embedding": sd["embeddings.word_embeddings.weight"]},
+        "pos_emb": {"embedding": pos},
+        "emb_ln": ln("embeddings.LayerNorm"),
+    }
+    for i in range(cfg.layers):
+        a = f"encoder.layer.{i}"
+        params[f"layer_{i}"] = {
+            "q": dense(f"{a}.attention.self.query"),
+            "k": dense(f"{a}.attention.self.key"),
+            "v": dense(f"{a}.attention.self.value"),
+            "attn_out": dense(f"{a}.attention.output.dense"),
+            "attn_ln": ln(f"{a}.attention.output.LayerNorm"),
+            "ffn_in": dense(f"{a}.intermediate.dense"),
+            "ffn_out": dense(f"{a}.output.dense"),
+            "ffn_ln": ln(f"{a}.output.LayerNorm"),
+        }
+    return jax.tree_util.tree_map(jnp.asarray, params)
