@@ -175,6 +175,14 @@ class TextEncoder:
                  init_params: bool = True):
         self.cfg = cfg or EncoderConfig.base()
         self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size, self.cfg.max_len)
+        # The pad mask is ``token_ids != PAD_ID`` (PAD_ID=0) in both encoder
+        # archs; a tokenizer whose pad id differs would silently corrupt
+        # attention (pads attended, vocab row 0 masked everywhere).
+        tok_pad = getattr(self.tokenizer, "pad_id", PAD_ID)
+        if tok_pad != PAD_ID:
+            raise ValueError(
+                f"tokenizer pad id {tok_pad} != {PAD_ID}; the encoder masks "
+                f"token id {PAD_ID} as padding — use a vocab with [PAD] at row 0")
         cls = BertEncoder if self.cfg.arch == "bert" else Encoder
         self.model = cls(self.cfg)
         if init_params:
@@ -186,17 +194,32 @@ class TextEncoder:
 
     @classmethod
     def from_hf(cls, hf_model, tokenizer=None, pooling: str = "cls",
-                max_len: int = 128) -> "TextEncoder":
+                max_len: int = 128,
+                vocab_file: Optional[str] = None) -> "TextEncoder":
         """Build a ``BertEncoder``-backed TextEncoder from a local
         ``transformers`` BertModel (bge-base-en-class) — no egress, the
         checkpoint must already be on disk/in memory.
 
         ``tokenizer``: anything with ``batch_encode(texts, max_len) ->
         List[List[int]]``; pass ``HFTokenizerAdapter(hf_tok, max_len)`` for
-        the checkpoint's real WordPiece vocab. Defaults to the hash
+        a live transformers tokenizer, or give ``vocab_file`` (the
+        checkpoint's ``vocab.txt``) to use the in-tree WordPiece tokenizer
+        (HF-id-exact, ``models/wordpiece.py``). Defaults to the hash
         tokenizer (fine for smoke tests, wrong vocab for real retrieval).
         """
+        if tokenizer is not None and vocab_file is not None:
+            raise ValueError("pass either tokenizer or vocab_file, not both")
+        if vocab_file is not None:
+            from lazzaro_tpu.models.wordpiece import WordPieceTokenizer
+            tokenizer = WordPieceTokenizer.from_vocab_file(
+                vocab_file, max_len=max_len)
         hc = hf_model.config
+        tok_vocab = getattr(tokenizer, "vocab_size", None)
+        if tok_vocab is not None and tok_vocab > hc.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab_size {tok_vocab} exceeds checkpoint "
+                f"vocab_size {hc.vocab_size}; out-of-range ids would produce "
+                f"silent NaN embeddings (Flax Embed OOB lookup)")
         cfg = EncoderConfig(
             vocab_size=hc.vocab_size, hidden=hc.hidden_size,
             layers=hc.num_hidden_layers, heads=hc.num_attention_heads,
@@ -247,6 +270,17 @@ class HFTokenizerAdapter:
     def __init__(self, hf_tokenizer, max_len: int = 128):
         self.hf = hf_tokenizer
         self.max_len = max_len
+
+    @property
+    def pad_id(self) -> int:
+        """Surfaced so TextEncoder's pad-mask guard sees the real pad id
+        (BERT-family = 0; a RoBERTa-style pad_token_id=1 must be rejected)."""
+        pad = getattr(self.hf, "pad_token_id", 0)
+        return 0 if pad is None else int(pad)
+
+    @property
+    def vocab_size(self) -> int:
+        return int(len(self.hf))
 
     def batch_encode(self, texts, max_len: Optional[int] = None):
         out = self.hf(list(texts), padding="max_length", truncation=True,

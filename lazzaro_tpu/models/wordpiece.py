@@ -1,0 +1,232 @@
+"""In-tree WordPiece tokenizer (BERT-compatible, zero dependencies).
+
+``TextEncoder.from_hf`` maps real bge/BERT checkpoint weights into the in-tree
+``BertEncoder``, but token ids must come from the checkpoint's WordPiece vocab
+for the embeddings to mean anything. ``HFTokenizerAdapter`` covers the case
+where a ``transformers`` tokenizer object is at hand; this module makes the
+framework self-sufficient: given just the checkpoint's ``vocab.txt``, it
+reproduces HuggingFace ``BertTokenizer`` ids exactly (basic tokenization —
+cleaning, lowercasing, accent stripping, punctuation splitting, CJK isolation —
+followed by greedy longest-match WordPiece).
+
+The reference never tokenizes (embeddings are remote API calls,
+``core/providers.py:36-57``); this is infrastructure the TPU-native encoder
+path needs instead.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, Iterable, List, Optional
+
+PAD_TOKEN = "[PAD]"
+UNK_TOKEN = "[UNK]"
+CLS_TOKEN = "[CLS]"
+SEP_TOKEN = "[SEP]"
+MASK_TOKEN = "[MASK]"
+
+
+def _is_whitespace(ch: str) -> bool:
+    if ch in (" ", "\t", "\n", "\r"):
+        return True
+    return unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch: str) -> bool:
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    # ASCII ranges BERT treats as punctuation even when unicodedata doesn't
+    # (e.g. ``$``, ``^``, backtick).
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return ((0x4E00 <= cp <= 0x9FFF) or (0x3400 <= cp <= 0x4DBF)
+            or (0x20000 <= cp <= 0x2A6DF) or (0x2A700 <= cp <= 0x2B73F)
+            or (0x2B740 <= cp <= 0x2B81F) or (0x2B820 <= cp <= 0x2CEAF)
+            or (0xF900 <= cp <= 0xFAFF) or (0x2F800 <= cp <= 0x2FA1F))
+
+
+class BasicTokenizer:
+    """HF ``BasicTokenizer`` semantics: clean → CJK-isolate → whitespace split
+    → (lowercase + accent-strip) → punctuation split."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        text = self._clean(text)
+        text = self._isolate_cjk(text)
+        tokens: List[str] = []
+        for tok in text.split():
+            if self.do_lower_case:
+                tok = tok.lower()
+                tok = self._strip_accents(tok)
+            tokens.extend(self._split_punct(tok))
+        return [t for t in tokens if t]
+
+    @staticmethod
+    def _clean(text: str) -> str:
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            out.append(" " if _is_whitespace(ch) else ch)
+        return "".join(out)
+
+    @staticmethod
+    def _isolate_cjk(text: str) -> str:
+        out = []
+        for ch in text:
+            if _is_cjk(ord(ch)):
+                out.append(f" {ch} ")
+            else:
+                out.append(ch)
+        return "".join(out)
+
+    @staticmethod
+    def _strip_accents(text: str) -> str:
+        return "".join(ch for ch in unicodedata.normalize("NFD", text)
+                       if unicodedata.category(ch) != "Mn")
+
+    @staticmethod
+    def _split_punct(token: str) -> List[str]:
+        out: List[List[str]] = []
+        start_new = True
+        for ch in token:
+            if _is_punctuation(ch):
+                out.append([ch])
+                start_new = True
+            else:
+                if start_new:
+                    out.append([])
+                    start_new = False
+                out[-1].append(ch)
+        return ["".join(chars) for chars in out]
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match-first WordPiece over a BERT ``vocab.txt``.
+
+    Drop-in for ``HashTokenizer`` on the ``TextEncoder`` path: exposes the
+    same ``encode``/``batch_encode``/``max_len``/``vocab_size`` surface, and
+    produces ids identical to HuggingFace ``BertTokenizer`` for the same
+    vocab (verified in ``tests/test_wordpiece.py``).
+    """
+
+    def __init__(self, vocab: Dict[str, int], max_len: int = 128,
+                 do_lower_case: bool = True,
+                 max_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.max_len = max_len
+        self.max_chars_per_word = max_chars_per_word
+        self.basic = BasicTokenizer(do_lower_case)
+        for tok in (PAD_TOKEN, UNK_TOKEN, CLS_TOKEN, SEP_TOKEN):
+            if tok not in vocab:
+                raise ValueError(f"vocab missing special token {tok}")
+        self.pad_id = vocab[PAD_TOKEN]
+        self.unk_id = vocab[UNK_TOKEN]
+        self.cls_id = vocab[CLS_TOKEN]
+        self.sep_id = vocab[SEP_TOKEN]
+        # Special tokens pass through tokenization verbatim (HF splits raw
+        # text on all_special_tokens before basic tokenization).
+        self.special_tokens = [t for t in (PAD_TOKEN, UNK_TOKEN, CLS_TOKEN,
+                                           SEP_TOKEN, MASK_TOKEN)
+                               if t in vocab]
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_vocab_file(cls, path: str, **kw) -> "WordPieceTokenizer":
+        """Load a standard one-token-per-line ``vocab.txt`` (id = line no)."""
+        vocab: Dict[str, int] = {}
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                tok = line.rstrip("\n")
+                if tok:
+                    vocab[tok] = i     # duplicate lines: last wins (HF load_vocab)
+        return cls(vocab, **kw)
+
+    @classmethod
+    def from_tokens(cls, tokens: Iterable[str], **kw) -> "WordPieceTokenizer":
+        return cls({t: i for i, t in enumerate(tokens)}, **kw)
+
+    @property
+    def vocab_size(self) -> int:
+        return max(self.vocab.values()) + 1
+
+    # -- tokenization -------------------------------------------------------
+    def _wordpiece(self, word: str) -> List[str]:
+        if len(word) > self.max_chars_per_word:
+            return [UNK_TOKEN]
+        pieces: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [UNK_TOKEN]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    def _split_specials(self, text: str) -> List[str]:
+        """Split on exact special-token strings (pre-lowercasing, as HF's
+        ``split_on_tokens`` does) so e.g. a literal ``[SEP]`` in the input
+        maps to its id rather than being punctuation-split into [UNK]s."""
+        chunks = [text]
+        for tok in self.special_tokens:
+            nxt: List[str] = []
+            for chunk in chunks:
+                if chunk in self.special_tokens:
+                    nxt.append(chunk)
+                    continue
+                parts = chunk.split(tok)
+                for i, part in enumerate(parts):
+                    if i:
+                        nxt.append(tok)
+                    if part:
+                        nxt.append(part)
+            chunks = nxt
+        return chunks
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for chunk in self._split_specials(text):
+            if chunk in self.special_tokens:
+                out.append(chunk)
+                continue
+            for word in self.basic.tokenize(chunk):
+                out.extend(self._wordpiece(word))
+        return out
+
+    def encode(self, text: str, max_len: Optional[int] = None) -> List[int]:
+        """``[CLS] tok... [SEP]`` padded/truncated to ``max_len`` — the same
+        framing ``HashTokenizer.encode`` uses, so ``TextEncoder`` is agnostic
+        to which tokenizer drives it."""
+        max_len = max_len or self.max_len
+        ids = [self.cls_id]
+        for piece in self.tokenize(text)[: max_len - 2]:
+            ids.append(self.vocab.get(piece, self.unk_id))
+        ids.append(self.sep_id)
+        ids += [self.pad_id] * (max_len - len(ids))
+        return ids[:max_len]
+
+    def batch_encode(self, texts: List[str],
+                     max_len: Optional[int] = None) -> List[List[int]]:
+        return [self.encode(t, max_len) for t in texts]

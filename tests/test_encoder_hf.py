@@ -84,6 +84,60 @@ def test_from_hf_cls_pooling_matches_manual(hf_model):
     np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=2e-5)
 
 
+def test_from_hf_with_vocab_file_matches_full_hf_pipeline(hf_model, tmp_path):
+    """from_hf(vocab_file=...) reproduces the COMPLETE HF pipeline — real
+    WordPiece ids + BertModel forward + CLS pooling — from just vocab.txt."""
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "fox",
+             "jump", "##s", "hello", "world", "data", "engineer", "."]
+    vocab += [f"tok{i}" for i in range(100 - len(vocab))]   # pad to hf vocab_size
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(vocab) + "\n", encoding="utf-8")
+
+    enc = TextEncoder.from_hf(hf_model, max_len=16, vocab_file=str(vf))
+    texts = ["the quick fox jumps.", "hello world", "unknownword data engineer"]
+    ours = enc.encode_batch(texts)
+
+    hf_tok = transformers.BertTokenizer(str(vf), do_lower_case=True)
+    batch = hf_tok(texts, padding="max_length", truncation=True,
+                   max_length=16, return_tensors="pt")
+    with torch.no_grad():
+        h = hf_model(input_ids=batch["input_ids"],
+                     attention_mask=batch["attention_mask"]
+                     ).last_hidden_state.numpy()
+    cls = h[:, 0]
+    ref = cls / np.linalg.norm(cls, axis=-1, keepdims=True)
+    np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_from_hf_guards(hf_model, tmp_path):
+    from lazzaro_tpu.models.encoder import HFTokenizerAdapter
+    from lazzaro_tpu.models.wordpiece import WordPieceTokenizer
+
+    # tokenizer XOR vocab_file
+    vf = tmp_path / "v.txt"
+    vf.write_text("[PAD]\n[UNK]\n[CLS]\n[SEP]\na\n", encoding="utf-8")
+    tok = WordPieceTokenizer.from_vocab_file(str(vf))
+    with pytest.raises(ValueError, match="not both"):
+        TextEncoder.from_hf(hf_model, tokenizer=tok, vocab_file=str(vf))
+
+    # vocab larger than the checkpoint's embedding table → reject (silent
+    # NaN from Flax Embed OOB otherwise)
+    big = tmp_path / "big.txt"
+    big.write_text("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]"]
+                             + [f"t{i}" for i in range(200)]) + "\n",
+                   encoding="utf-8")
+    with pytest.raises(ValueError, match="vocab_size"):
+        TextEncoder.from_hf(hf_model, vocab_file=str(big))
+
+    # HFTokenizerAdapter surfaces a nonzero pad id to the guard
+    hf_tok = transformers.BertTokenizer(str(vf), do_lower_case=True)
+    hf_tok.pad_token = "[UNK]"           # forces pad_token_id=1
+    adapter = HFTokenizerAdapter(hf_tok, max_len=16)
+    assert adapter.pad_id == 1
+    with pytest.raises(ValueError, match="pad id"):
+        TextEncoder.from_hf(hf_model, tokenizer=adapter)
+
+
 def test_from_hf_encode_pipeline(hf_model):
     """End-to-end encode() through the hash tokenizer: shape + normalization
     + determinism (vocab is wrong for real retrieval, pipeline must work)."""

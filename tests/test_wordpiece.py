@@ -1,0 +1,119 @@
+"""WordPiece tokenizer parity vs HuggingFace ``BertTokenizer``.
+
+Builds a synthetic ``vocab.txt`` locally (no egress) and checks that the
+in-tree tokenizer reproduces HF ids exactly — basic-tokenization corner cases
+included (accents, punctuation runs, CJK isolation, unknown words, long-word
+bailout, padding/truncation framing).
+"""
+
+import numpy as np
+import pytest
+
+from lazzaro_tpu.models.wordpiece import WordPieceTokenizer
+
+VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
+    "over", "lazy", "dog", "un", "##want", "##able", "run", "##ner",
+    "a", "i", "work", "as", "data", "engineer", "!", "?", ",", ".", "'",
+    "$", "3", "##5", "cafe", "年", "中",
+]
+
+TEXTS = [
+    "The quick brown fox jumps over the lazy dog",
+    "unwantable running!",
+    "I work as a data engineer.",
+    "Café, cafe?",                      # accent stripping
+    "$35!!!",                           # punctuation runs + digits
+    "年中 work",                         # CJK isolation
+    "supercalifragilistic",             # whole-word [UNK]
+    "  whitespace\t\tand\nnewlines  ",
+    "",
+    "x" * 150,                          # > max_chars_per_word → [UNK]
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def hf_tok(vocab_file):
+    transformers = pytest.importorskip("transformers")
+    return transformers.BertTokenizer(vocab_file, do_lower_case=True)
+
+
+def test_tokenize_matches_hf(vocab_file, hf_tok):
+    tok = WordPieceTokenizer.from_vocab_file(vocab_file)
+    for text in TEXTS:
+        assert tok.tokenize(text) == hf_tok.tokenize(text), text
+
+
+def test_encode_matches_hf(vocab_file, hf_tok):
+    max_len = 16
+    tok = WordPieceTokenizer.from_vocab_file(vocab_file, max_len=max_len)
+    ours = tok.batch_encode(TEXTS)
+    theirs = hf_tok(TEXTS, padding="max_length", truncation=True,
+                    max_length=max_len)["input_ids"]
+    assert ours == theirs
+
+
+def test_special_ids_standard_layout(vocab_file):
+    tok = WordPieceTokenizer.from_vocab_file(vocab_file)
+    # [PAD] must be id 0: the encoder's pad mask is ``token_ids != 0``
+    # (models/encoder.py pad_mask), the standard BERT vocab layout.
+    assert tok.pad_id == 0 and tok.cls_id == 2 and tok.sep_id == 3
+    assert tok.vocab_size == len(VOCAB)
+
+
+def test_special_tokens_in_raw_text_match_hf(vocab_file, hf_tok):
+    """Literal special tokens in input text pass through verbatim (HF splits
+    on all_special_tokens before basic tokenization)."""
+    tok = WordPieceTokenizer.from_vocab_file(vocab_file)
+    for text in ["the fox [SEP] lazy dog", "[CLS] work [MASK] !", "[SEP]",
+                 "a[SEP]b", "the [sep] dog"]:   # lowercase [sep] is NOT special
+        assert tok.tokenize(text) == hf_tok.tokenize(text), text
+
+
+def test_duplicate_vocab_lines_last_wins(tmp_path):
+    """HF load_vocab assigns vocab[token]=index per line, so later duplicate
+    lines win; ids must match the checkpoint's embedding rows."""
+    p = tmp_path / "dup_vocab.txt"
+    p.write_text("[PAD]\n[UNK]\n[CLS]\n[SEP]\ndog\ncat\ndog\n", encoding="utf-8")
+    tok = WordPieceTokenizer.from_vocab_file(p)
+    assert tok.vocab["dog"] == 6
+    transformers = pytest.importorskip("transformers")
+    hf = transformers.BertTokenizer(str(p), do_lower_case=True)
+    assert tok.tokenize("dog cat") == hf.tokenize("dog cat")
+    assert tok.encode("dog cat", 6) == hf(
+        "dog cat", padding="max_length", truncation=True,
+        max_length=6)["input_ids"]
+
+
+def test_nonzero_pad_id_rejected_by_encoder(tmp_path):
+    """A vocab with [PAD] off row 0 must be rejected, not silently corrupt
+    the pad mask (encoder masks token id 0)."""
+    from lazzaro_tpu.models.encoder import EncoderConfig, TextEncoder
+
+    p = tmp_path / "bad_vocab.txt"
+    p.write_text("[UNK]\n[PAD]\n[CLS]\n[SEP]\ndog\n", encoding="utf-8")
+    tok = WordPieceTokenizer.from_vocab_file(p)
+    cfg = EncoderConfig.tiny()
+    with pytest.raises(ValueError, match="pad id"):
+        TextEncoder(cfg, tokenizer=tok)
+
+
+def test_drives_text_encoder(vocab_file):
+    """WordPiece slots into TextEncoder exactly like HashTokenizer."""
+    from lazzaro_tpu.models.encoder import EncoderConfig, TextEncoder
+
+    tok = WordPieceTokenizer.from_vocab_file(vocab_file, max_len=16)
+    cfg = EncoderConfig(vocab_size=tok.vocab_size, hidden=32, layers=1,
+                        heads=2, mlp_dim=64, max_len=16, dtype="float32")
+    enc = TextEncoder(cfg, tokenizer=tok)
+    out = enc.encode_batch(["the quick fox", "lazy dog!"])
+    assert out.shape == (2, 32)
+    assert np.allclose(np.linalg.norm(out, axis=1), 1.0, atol=1e-5)
