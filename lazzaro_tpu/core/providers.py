@@ -186,9 +186,13 @@ class HeuristicLLM:
 class OnDeviceLLM:
     """TPU decoder-LM provider (Gemma-class, ``lazzaro_tpu.models.llm``).
 
-    Greedy/temperature sampling with a KV cache, fully jitted. With the
-    default random init the output is noise — load an Orbax checkpoint for
-    real use; the HeuristicLLM handles structured prompts offline."""
+    Greedy/temperature sampling with a KV cache, fully jitted. With
+    ``response_format={"type": "json_object"}`` the decode runs under the
+    byte-level JSON grammar automaton (``models/json_constrain.py``), so the
+    consolidation pipeline's extraction prompts get valid JSON by
+    construction — no fence stripping, no parse-failure path. With the
+    default random init free-text output is noise — load an Orbax checkpoint
+    for real use; the HeuristicLLM handles structured prompts offline."""
 
     def __init__(self, lm=None, max_new_tokens: int = 128, temperature: float = 0.0):
         if lm is None:
@@ -206,7 +210,12 @@ class OnDeviceLLM:
 
     def completion(self, messages: List[Dict[str, str]],
                    response_format: Optional[Dict] = None) -> str:
-        return self.lm.generate(self._render(messages),
+        prompt = self._render(messages)
+        if response_format and response_format.get("type") == "json_object":
+            return self.lm.generate_json(prompt,
+                                         max_new_tokens=self.max_new_tokens,
+                                         temperature=self.temperature)
+        return self.lm.generate(prompt,
                                 max_new_tokens=self.max_new_tokens,
                                 temperature=self.temperature)
 

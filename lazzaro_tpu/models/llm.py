@@ -343,12 +343,12 @@ class LanguageModel:
             {"params": params}, token[:, None], position[:, None], caches)
         return logits[:, -1], caches
 
-    def generate(self, prompt: str, max_new_tokens: int = 64,
-                 temperature: float = 0.0, seed: int = 0) -> str:
+    def _prep_prompt(self, prompt: str, max_new_tokens: int):
+        """Shared generation preamble: clamp the budget, keep the prompt tail
+        that fits (a naive negative slice turns into [-0:] when the budget
+        hits zero and silently keeps everything), prefill the KV cache.
+        Returns (clamped_max_new_tokens, last-position logits, caches, pos)."""
         cfg = self.cfg
-        # Leave at least one prompt token: clamp the generation budget, then
-        # keep only the prompt tail that fits (a naive negative slice turns
-        # into [-0:] when the budget hits zero and silently keeps everything).
         max_new_tokens = min(max_new_tokens, cfg.max_seq - 2)
         prompt_budget = cfg.max_seq - 1 - max_new_tokens
         ids = self.tokenizer.encode(prompt)
@@ -358,10 +358,16 @@ class LanguageModel:
         positions = jnp.arange(len(ids))[None, :]
         caches = self._empty_cache(1)
         logits, caches = self._prefill(self.params, tokens, positions, caches)
+        return max_new_tokens, logits, caches, len(ids)
+
+    def generate(self, prompt: str, max_new_tokens: int = 64,
+                 temperature: float = 0.0, seed: int = 0) -> str:
+        cfg = self.cfg
+        max_new_tokens, logits, caches, pos = self._prep_prompt(
+            prompt, max_new_tokens)
 
         key = jax.random.PRNGKey(seed)
         out_ids = []
-        pos = len(ids)
         token = None
         for _ in range(max_new_tokens):
             if temperature > 0:
@@ -378,6 +384,56 @@ class LanguageModel:
                 jnp.asarray([pos], jnp.int32), caches)
             pos += 1
         return self.tokenizer.decode(out_ids)
+
+    def generate_json(self, prompt: str, max_new_tokens: int = 256,
+                      temperature: float = 0.0, seed: int = 0,
+                      force_object: bool = True) -> str:
+        """Grammar-constrained generation: the output is valid JSON by
+        construction (any weights, including random). A byte-level pushdown
+        automaton (``models/json_constrain.py``) computes the legal next-byte
+        set each step; illegal logits are masked to -inf before sampling; if
+        the token budget runs out mid-document, the shortest closing suffix
+        completes it. Replaces the reference's trust-the-API
+        ``response_format`` + fence-stripping + parse-failure path
+        (providers.py:10-19, memory_system.py:684-703)."""
+        from lazzaro_tpu.models.json_constrain import JsonState, constrain_mask
+
+        cfg = self.cfg
+        max_new_tokens, logits, caches, pos = self._prep_prompt(
+            prompt, max_new_tokens)
+
+        state = JsonState(force_object=force_object)
+        key = jax.random.PRNGKey(seed)
+        out = bytearray()
+        for _ in range(max_new_tokens):
+            mask = constrain_mask(state, cfg.vocab_size, ByteTokenizer.EOS)
+            host_logits = np.array(logits[0], np.float32)   # writable copy
+            host_logits[~mask] = -np.inf
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                tid = int(jax.random.categorical(
+                    sub, jnp.asarray(host_logits)[None, :] / temperature,
+                    axis=-1)[0])
+            else:
+                tid = int(host_logits.argmax())
+            if tid == ByteTokenizer.EOS:
+                break
+            out.append(tid)
+            state.feed(tid)
+            if state.mode == "done":
+                # Structurally complete (container closed / literal / string
+                # ended) — only whitespace could follow. A top-level number is
+                # `done` but extendable ("4" → "42"), so it keeps decoding
+                # until the model itself picks EOS (legal once done).
+                break
+            if pos >= cfg.max_seq - 1:
+                break
+            logits, caches = self._decode_one(
+                self.params, jnp.asarray([tid], jnp.int32),
+                jnp.asarray([pos], jnp.int32), caches)
+            pos += 1
+        out += state.closing_suffix()
+        return out.decode("utf-8", errors="replace")
 
     def logits_for(self, text: str) -> np.ndarray:
         """Full-sequence forward (no cache) — training/eval path."""
