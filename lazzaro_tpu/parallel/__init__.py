@@ -1,3 +1,6 @@
 from lazzaro_tpu.parallel.mesh import make_mesh, single_device_mesh, spec
+from lazzaro_tpu.parallel.ring_attention import make_ring_attention
+from lazzaro_tpu.parallel.ulysses import make_ulysses_attention
 
-__all__ = ["make_mesh", "single_device_mesh", "spec"]
+__all__ = ["make_mesh", "single_device_mesh", "spec",
+           "make_ring_attention", "make_ulysses_attention"]
