@@ -15,12 +15,8 @@ TPU-native capabilities of the in-tree LM stack.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
-
-NEG = -1e30
 
 
 def make_ulysses_attention(mesh: Mesh, axis: str = "sp"):
@@ -40,7 +36,6 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp"):
         if k.shape[2] != H or v.shape[2] != H:
             raise ValueError("ulysses requires full MHA (kv heads == q heads);"
                              " repeat GQA kv heads first or use ring attention")
-        scale = 1.0 / np.sqrt(D)
 
         def seq_to_heads(x):
             # [B, Tc, H, D] seq-sharded → [B, n·Tc, H/n, D] head-sharded.
@@ -55,13 +50,10 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp"):
                                       tiled=True)
 
         qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-        T = qg.shape[1]
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qg, kg).astype(jnp.float32) * scale
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(mask[None, None], scores, NEG)
-        p = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
-        return heads_to_seq(out.astype(q.dtype))
+        # Full-sequence dense causal attention on the head shard — the same
+        # oracle formulation ring attention is verified against.
+        from lazzaro_tpu.parallel.ring_attention import reference_causal_attention
+        return heads_to_seq(reference_causal_attention(qg, kg, vg))
 
     mapped = shard_map(
         local_fn, mesh=mesh,
