@@ -45,7 +45,8 @@ def build_memory(args) -> "MemorySystem":
 
 HELP = ("Available commands: /start, /end, /stats, /profile, /memories [n], "
         "/consolidate, /merge, /prune [thresh], /config, /set <k> <v>, "
-        "/save [file], /load [file], /users, /switch <user>, /quit")
+        "/save [file], /load [file], /snapshot [dir], /restore [dir], "
+        "/users, /switch <user>, /quit")
 
 CONFIG_PARAMS = ["max_buffer_size", "prune_threshold", "consolidate_every",
                  "auto_consolidate", "auto_prune", "enable_sharding",
@@ -116,6 +117,12 @@ def handle_command(memory, user_input: str) -> bool:
         else:
             memory._load_from_persistence()
             print(f"\n✓ Reloaded user '{memory.user_id}' from {memory.config.db_dir}")
+    elif cmd == "/snapshot":
+        target = parts[1] if len(parts) > 1 else "memory_snapshot"
+        print("\n" + memory.save_snapshot(target))
+    elif cmd == "/restore":
+        target = parts[1] if len(parts) > 1 else "memory_snapshot"
+        print("\n" + memory.load_snapshot(target))
     elif cmd == "/users":
         for u in memory.get_all_users():
             marker = " ←" if u == memory.user_id else ""

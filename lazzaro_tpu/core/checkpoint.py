@@ -125,9 +125,14 @@ def save_index(index: MemoryIndex, ckpt_dir: str) -> None:
 
     # The flip: readers see the old snapshot until this single replace lands.
     fd, ptr_tmp = tempfile.mkstemp(dir=ckpt_dir, prefix=".cur-")
-    with os.fdopen(fd, "w") as f:
-        f.write(vname)
-    os.replace(ptr_tmp, _current_path(ckpt_dir))
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(vname)
+        os.replace(ptr_tmp, _current_path(ckpt_dir))
+    except BaseException:
+        if os.path.exists(ptr_tmp):
+            os.unlink(ptr_tmp)
+        raise
 
     # Prune superseded versions (best-effort; debris never affects readers).
     import shutil

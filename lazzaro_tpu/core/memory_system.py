@@ -20,6 +20,7 @@ CLI /save path — SURVEY §2.2 quirks).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -1016,8 +1017,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 self.store.add_nodes([{
                     "id": keep_id,
                     "content": node1.content,
-                    "embedding": [float(x) for x in (node1.embedding
-                                                     if node1.embedding is not None else [])],
+                    "embedding": self._node_embedding(node1) or [],
                     "type": node1.type,
                     "salience": node1.salience,
                     "shard_key": node1.shard_key,
@@ -1076,17 +1076,48 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         return [n for n in (self.buffer.get_node(c) for c in connected) if n]
 
     # ------------------------------------------------------------ persistence
+    def _node_embedding(self, node: Node) -> Optional[List[float]]:
+        """Host embedding, or the authoritative arena row when the host copy
+        was never materialized (snapshot-loaded graphs). Arena rows are
+        L2-normalized; all downstream similarity is cosine, so this is
+        semantics-preserving."""
+        if node.embedding is not None:
+            return [float(x) for x in node.embedding]
+        emb = self.index.get_embedding(self._q(node.id))
+        return [float(x) for x in emb] if emb is not None else None
+
+    def _bulk_fill_embeddings(self, dicts: List[Dict[str, Any]],
+                              node_ids: List[str]) -> None:
+        """Fill missing/empty 'embedding' entries from the arena in ONE
+        device gather (snapshot-loaded nodes don't materialize host copies)."""
+        missing = [(i, self._q(nid))
+                   for i, (d, nid) in enumerate(zip(dicts, node_ids))
+                   if not d.get("embedding")]
+        if not missing:
+            return
+        valid = []
+        for i, q in missing:
+            r = self.index.id_to_row.get(q)
+            if r is not None:
+                valid.append((i, r))
+        if not valid:
+            return
+        gathered = np.asarray(
+            self.index.state.emb[np.asarray([r for _, r in valid])],
+            np.float32)
+        for (i, _), e in zip(valid, gathered):
+            dicts[i]["embedding"] = [float(x) for x in e]
+
     def _save_to_persistence(self) -> None:
         """Full rewrite of the user's durable rows (parity with
-        memory_system.py:1275-1302: delete-all + re-insert)."""
+        memory_system.py:1275-1302: delete-all + re-insert). Nodes whose
+        host embedding is unmaterialized get theirs from the arena in one
+        bulk gather. ``buffer.nodes`` already merges super-nodes in."""
         with self._mutex:
             self._sync_from_arena()
-            nodes_data = []
-            for shard in self.shards.values():
-                for node in shard.nodes.values():
-                    nodes_data.append(self._node_row(node))
-            for node in self.super_nodes.values():
-                nodes_data.append(self._node_row(node))
+            all_nodes = list(self.buffer.nodes.values())
+            nodes_data = [self._node_row(n) for n in all_nodes]
+            self._bulk_fill_embeddings(nodes_data, [n.id for n in all_nodes])
             edges_data = []
             for shard in self.shards.values():
                 for edge in shard.edges.values():
@@ -1217,18 +1248,117 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         return False
 
     # ----------------------------------------------------------- JSON snapshot
-    def save_state(self, filename: str = "memory_state.json") -> str:
+    def save_snapshot(self, snapshot_dir: str) -> str:
+        """Fast binary system snapshot: the arena checkpoint (ALL tenants'
+        embeddings + numerics, ``core/checkpoint.py``) plus a host-side JSON
+        of the current user's structural graph WITHOUT embeddings — the
+        1M-scale complement to ``save_state``'s human-readable JSON
+        (reference memory_system.py:1216-1273)."""
+        from lazzaro_tpu.core import checkpoint as ckpt
+        from lazzaro_tpu.core.store import _atomic_write
+
+        # Drain BEFORE taking the mutex: the background worker acquires the
+        # same mutex to consolidate, so draining inside it would deadlock —
+        # and snapshotting without draining would miss the just-ended
+        # conversation's memories.
+        self._drain_background()
         with self._mutex:
             self._sync_from_arena()
-            state = {
+            os.makedirs(snapshot_dir, exist_ok=True)
+            ckpt.save_index(self.index, os.path.join(snapshot_dir, "index"))
+
+            def slim(node: Node) -> Dict[str, Any]:
+                d = node.to_dict()
+                d.pop("embedding", None)
+                return d
+
+            host = {
+                "user_id": self.user_id,
                 "shards": {
                     k: {
-                        "nodes": [n.to_dict() for n in v.nodes.values()],
+                        "nodes": [slim(n) for n in v.nodes.values()],
                         "edges": [e.to_dict() for e in v.edges.values()],
                     }
                     for k, v in self.shards.items()
                 },
-                "super_nodes": [n.to_dict() for n in self.super_nodes.values()],
+                "super_nodes": [slim(n) for n in self.super_nodes.values()],
+                "profile": self.profile.to_dict(),
+                "node_counter": self.node_counter,
+                "conversation_count": self.conversation_count,
+                "settings": {
+                    "auto_consolidate": self.auto_consolidate,
+                    "consolidate_every": self.consolidate_every,
+                    "auto_prune": self.auto_prune,
+                    "prune_threshold": self.prune_threshold,
+                    "max_buffer_size": self.max_buffer_size,
+                },
+            }
+            _atomic_write(os.path.join(snapshot_dir, "host.json"),
+                          json.dumps(host).encode())
+        return f"✓ Snapshot saved to {snapshot_dir}"
+
+    def load_snapshot(self, snapshot_dir: str) -> str:
+        """Restore from ``save_snapshot`` output. Host nodes come back with
+        ``embedding=None`` — the arena owns the vectors; persistence and
+        merge paths fetch them on demand (``_node_embedding``)."""
+        from lazzaro_tpu.core import checkpoint as ckpt
+
+        try:
+            with open(os.path.join(snapshot_dir, "host.json")) as f:
+                host = json.load(f)
+        except FileNotFoundError:
+            return f"⚠ No snapshot at {snapshot_dir}"
+
+        self._drain_background()   # outside the mutex: the worker needs it
+        with self._mutex:
+            self.index = ckpt.load_index(os.path.join(snapshot_dir, "index"))
+            self.user_id = host.get("user_id", self.user_id)
+            self.shards.clear()
+            self.super_nodes.clear()
+            for shard_key, sd in host.get("shards", {}).items():
+                shard = self._get_or_create_shard(shard_key)
+                for nd in sd.get("nodes", []):
+                    shard.add_node(Node.from_dict(nd))
+                for ed in sd.get("edges", []):
+                    edge = Edge.from_dict(ed)
+                    shard.edges[edge.key] = edge
+            for nd in host.get("super_nodes", []):
+                node = Node.from_dict(nd)
+                self.super_nodes[node.id] = node
+            profile_data = host.get("profile", {})
+            self.profile.data = profile_data.get("data", self.profile.data)
+            self.profile.last_updated = profile_data.get(
+                "last_updated", time.time())
+            self.node_counter = host.get("node_counter", 0)
+            self.conversation_count = host.get("conversation_count", 0)
+            for key, val in host.get("settings", {}).items():
+                if hasattr(self, key):
+                    setattr(self, key, val)
+            if self.query_cache:
+                self.query_cache.invalidate_results()
+        return f"✓ Snapshot loaded from {snapshot_dir}"
+
+    def save_state(self, filename: str = "memory_state.json") -> str:
+        with self._mutex:
+            self._sync_from_arena()
+
+            def dicts_for(nodes: List[Node]) -> List[Dict[str, Any]]:
+                # Snapshot-loaded nodes carry embedding=None; fill from the
+                # arena so a save_state → load_state round trip keeps them
+                # searchable (load_state skips embedding-less nodes).
+                out = [n.to_dict() for n in nodes]
+                self._bulk_fill_embeddings(out, [n.id for n in nodes])
+                return out
+
+            state = {
+                "shards": {
+                    k: {
+                        "nodes": dicts_for(list(v.nodes.values())),
+                        "edges": [e.to_dict() for e in v.edges.values()],
+                    }
+                    for k, v in self.shards.items()
+                },
+                "super_nodes": dicts_for(list(self.super_nodes.values())),
                 "profile": self.profile.to_dict(),
                 "node_counter": self.node_counter,
                 "conversation_count": self.conversation_count,
