@@ -142,27 +142,48 @@ class MemoryIndex:
 
     def search(self, query: np.ndarray, tenant: str, k: int = 10,
                super_filter: int = 0) -> Tuple[List[str], List[float]]:
-        """Masked cosine top-k; returns (ids, scores), dead/padded hits dropped."""
-        if not self.id_to_row:
-            return [], []
+        """Masked cosine top-k; returns (ids, scores), dead/padded hits
+        dropped. Single-query view of ``search_batch``."""
+        return self.search_batch(np.asarray(query, np.float32)[None, :],
+                                 tenant, k, super_filter)[0]
+
+    def search_batch(self, queries: np.ndarray, tenant: str, k: int = 10,
+                     super_filter: int = 0) -> List[Tuple[List[str], List[float]]]:
+        """Multi-query masked top-k: ONE matmul + top_k for Q queries (the
+        TPU serving path for fleets of agents — per-query dispatch amortized
+        away). Returns a (ids, scores) pair per query. Q is bucketed to a
+        power of two so jit specializations stay bounded."""
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        nq = queries.shape[0]
+        if nq == 0 or not self.id_to_row:
+            return [([], [])] * nq
         tid = self._tenants.get(tenant)
         if tid is None:
-            return [], []
+            return [([], [])] * nq
+        bucket = 1 << (max(1, nq - 1)).bit_length()
+        if bucket > nq:
+            queries = np.concatenate(
+                [queries, np.zeros((bucket - nq, queries.shape[1]), np.float32)])
         k_eff = min(k, self.state.capacity)
         scores, rows = S.arena_search(
-            self.state, jnp.asarray(np.asarray(query, np.float32)),
-            jnp.int32(tid), k_eff, super_filter)
-        scores = np.asarray(scores)
-        rows = np.asarray(rows)
-        ids, out_scores = [], []
-        for s, r in zip(scores, rows):
-            if s <= S.NEG_INF / 2:
-                continue
-            node_id = self.row_to_id.get(int(r))
-            if node_id is not None:
-                ids.append(node_id)
-                out_scores.append(float(s))
-        return ids, out_scores
+            self.state, jnp.asarray(queries), jnp.int32(tid), k_eff,
+            super_filter)
+        scores = np.asarray(scores)[:nq]
+        rows = np.asarray(rows)[:nq]
+        out: List[Tuple[List[str], List[float]]] = []
+        for qi in range(nq):
+            ids, sc = [], []
+            for s, r in zip(scores[qi], rows[qi]):
+                if s <= S.NEG_INF / 2:
+                    continue
+                node_id = self.row_to_id.get(int(r))
+                if node_id is not None:
+                    ids.append(node_id)
+                    sc.append(float(s))
+            out.append((ids, sc))
+        return out
 
     # ------------------------------------------------------- numeric sweeps
     def update_access(self, ids: Sequence[str], boost: float = 0.05,

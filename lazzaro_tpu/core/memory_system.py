@@ -1065,6 +1065,26 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 results.append(node)
         return results
 
+    def search_memories_batch(self, queries: List[str], limit: int = 5
+                              ) -> List[List[Node]]:
+        """Fleet-serving variant of ``search_memories``: ONE batched encoder
+        forward + ONE batched top-k kernel for all queries (per-query
+        dispatch amortized — the reason the index lives in HBM)."""
+        if not queries:
+            return []
+        embs = np.asarray(self._batch_embed(list(queries)), np.float32)
+        per_query = self.index.search_batch(embs, self.user_id, k=limit,
+                                            super_filter=-1)
+        results: List[List[Node]] = []
+        for ids, _scores in per_query:
+            nodes = []
+            for qid in ids:
+                node = self.buffer.get_node(qid.partition(":")[2])
+                if node:
+                    nodes.append(node)
+            results.append(nodes)
+        return results
+
     def get_connected_memories(self, node_id: str) -> List[Node]:
         connected: Set[str] = set()
         for shard in self.shards.values():
@@ -1300,7 +1320,9 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
     def load_snapshot(self, snapshot_dir: str) -> str:
         """Restore from ``save_snapshot`` output. Host nodes come back with
         ``embedding=None`` — the arena owns the vectors; persistence and
-        merge paths fetch them on demand (``_node_embedding``)."""
+        merge paths fetch them on demand (``_node_embedding``). Any
+        in-flight conversation is discarded (the snapshot is the new truth)
+        and the per-user WAL is reopened for the snapshot's user."""
         from lazzaro_tpu.core import checkpoint as ckpt
 
         try:
@@ -1309,21 +1331,42 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         except FileNotFoundError:
             return f"⚠ No snapshot at {snapshot_dir}"
 
+        # Stage EVERYTHING fallibly before touching live state, so a corrupt
+        # snapshot can never leave the system half-restored.
+        try:
+            new_index = ckpt.load_index(os.path.join(snapshot_dir, "index"))
+            staged_shards: Dict[str, Tuple[List[Node], List[Edge]]] = {}
+            for shard_key, sd in host.get("shards", {}).items():
+                staged_shards[shard_key] = (
+                    [Node.from_dict(nd) for nd in sd.get("nodes", [])],
+                    [Edge.from_dict(ed) for ed in sd.get("edges", [])])
+            staged_supers = [Node.from_dict(nd)
+                             for nd in host.get("super_nodes", [])]
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            return f"⚠ Corrupt snapshot at {snapshot_dir}: {e}"
+
         self._drain_background()   # outside the mutex: the worker needs it
         with self._mutex:
-            self.index = ckpt.load_index(os.path.join(snapshot_dir, "index"))
+            self.index = new_index
             self.user_id = host.get("user_id", self.user_id)
             self.shards.clear()
             self.super_nodes.clear()
-            for shard_key, sd in host.get("shards", {}).items():
+            # Pre-restore session state is meaningless against the new graph.
+            self.conversation_active = False
+            self.short_term_memory.clear()
+            self.conversation_history.clear()
+            self.consolidation_queue.clear()
+            self._inflight_batches.clear()
+            # Truncate the pre-restore WAL (still the old user's handle):
+            # the discarded turns must not be replayed as "crashed".
+            self._journal_sync()
+            for shard_key, (nodes, edges) in staged_shards.items():
                 shard = self._get_or_create_shard(shard_key)
-                for nd in sd.get("nodes", []):
-                    shard.add_node(Node.from_dict(nd))
-                for ed in sd.get("edges", []):
-                    edge = Edge.from_dict(ed)
+                for node in nodes:
+                    shard.add_node(node)
+                for edge in edges:
                     shard.edges[edge.key] = edge
-            for nd in host.get("super_nodes", []):
-                node = Node.from_dict(nd)
+            for node in staged_supers:
                 self.super_nodes[node.id] = node
             profile_data = host.get("profile", {})
             self.profile.data = profile_data.get("data", self.profile.data)
@@ -1336,6 +1379,9 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                     setattr(self, key, val)
             if self.query_cache:
                 self.query_cache.invalidate_results()
+        # Reopen the WAL for the (possibly different) restored user —
+        # mirrors switch_user; replays that user's crashed turns if any.
+        self._setup_journal()
         return f"✓ Snapshot loaded from {snapshot_dir}"
 
     def save_state(self, filename: str = "memory_state.json") -> str:

@@ -153,25 +153,47 @@ class ShardedMemoryIndex:
 
     def search(self, query: np.ndarray, tenant: str
                ) -> Tuple[List[str], List[float]]:
-        """Distributed masked top-k: local per-chip → all_gather → global."""
+        """Distributed masked top-k: local per-chip → all_gather → global.
+        Single-query view of ``search_batch``."""
+        return self.search_batch(np.asarray(query, np.float32)[None, :],
+                                 tenant)[0]
+
+    def search_batch(self, queries: np.ndarray, tenant: str
+                     ) -> List[Tuple[List[str], List[float]]]:
+        """Multi-query distributed top-k: Q queries share one local-score
+        matmul per chip and one all_gather — fleet serving over the pod."""
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        nq = queries.shape[0]
         tid = self._tenants.get(tenant)
-        if tid is None:
-            return [], []
-        q = np.asarray(query, np.float32)
-        q = q / max(np.linalg.norm(q), 1e-9)
+        if tid is None or nq == 0:
+            return [([], [])] * nq
+        norms = np.maximum(np.linalg.norm(queries, axis=1, keepdims=True), 1e-9)
+        q = queries / norms
+        # Bucket Q to a power of two: each distinct query-batch shape would
+        # otherwise retrace the pod-wide shard_map kernel (multi-second
+        # compiles are most expensive exactly here).
+        bucket = 1 << (max(1, nq - 1)).bit_length()
+        if bucket > nq:
+            q = np.concatenate(
+                [q, np.zeros((bucket - nq, q.shape[1]), np.float32)])
         mask = self.alive & (self.tenant == tid)
         scores, rows = self._search(self.emb, mask, jnp.asarray(q))
-        scores = np.asarray(scores)[0]
-        rows = np.asarray(rows)[0]
-        ids, out = [], []
-        for s, r in zip(scores, rows):
-            if s <= NEG_INF / 2:
-                continue
-            nid = self.row_to_id.get(int(r))
-            if nid is not None:
-                ids.append(nid)
-                out.append(float(s))
-        return ids, out
+        scores = np.asarray(scores)[:nq]
+        rows = np.asarray(rows)[:nq]
+        out: List[Tuple[List[str], List[float]]] = []
+        for qi in range(nq):
+            ids, sc = [], []
+            for s, r in zip(scores[qi], rows[qi]):
+                if s <= NEG_INF / 2:
+                    continue
+                nid = self.row_to_id.get(int(r))
+                if nid is not None:
+                    ids.append(nid)
+                    sc.append(float(s))
+            out.append((ids, sc))
+        return out
 
     def decay(self, tenant: str, rate: float, floor: float = 0.2) -> None:
         tid = self._tenants.get(tenant)

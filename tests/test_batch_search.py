@@ -1,0 +1,83 @@
+"""Batched multi-query retrieval: index kernel, sharded index, orchestrator."""
+
+import jax
+import numpy as np
+import pytest
+
+from lazzaro_tpu.core.index import MemoryIndex
+from lazzaro_tpu.core.memory_system import MemorySystem
+from lazzaro_tpu.parallel.index import ShardedMemoryIndex
+from lazzaro_tpu.parallel.mesh import make_mesh
+
+
+def _filled_index(n=30, d=16, seed=0):
+    idx = MemoryIndex(dim=d, capacity=64, edge_capacity=16)
+    rng = np.random.RandomState(seed)
+    emb = rng.randn(n, d).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    idx.add([f"n{i}" for i in range(n)], emb, [0.5] * n, [0.0] * n,
+            ["semantic"] * n, ["work"] * n, "default")
+    return idx, emb
+
+
+def test_batch_matches_single_query():
+    idx, emb = _filled_index()
+    queries = emb[[3, 7, 11, 19]]
+    batched = idx.search_batch(queries, "default", k=5)
+    for q, (ids, scores) in zip(queries, batched):
+        s_ids, s_scores = idx.search(q, "default", k=5)
+        assert ids == s_ids
+        np.testing.assert_allclose(scores, s_scores, rtol=1e-6)
+        assert ids[0] in {f"n{i}" for i in [3, 7, 11, 19]}
+
+
+def test_batch_edge_cases():
+    idx, emb = _filled_index()
+    assert idx.search_batch(np.zeros((0, 16)), "default") == []
+    assert idx.search_batch(emb[:2], "ghost-tenant") == [([], [])] * 2
+    # 1-D query promoted to a single-row batch
+    out = idx.search_batch(emb[0], "default", k=3)
+    assert len(out) == 1 and out[0][0][0] == "n0"
+    # Non-power-of-two batch sizes hit the padding path
+    out = idx.search_batch(emb[:5], "default", k=3)
+    assert len(out) == 5 and all(ids for ids, _ in out)
+
+
+def test_sharded_batch_matches_single():
+    n_dev = min(8, len(jax.devices()))
+    mesh = make_mesh(("data",), (n_dev,), devices=jax.devices()[:n_dev])
+    idx = ShardedMemoryIndex(mesh, dim=16, capacity=64 * n_dev, k=5)
+    rng = np.random.RandomState(1)
+    emb = rng.randn(40, 16).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    idx.add([f"s{i}" for i in range(40)], emb, "default")
+
+    batched = idx.search_batch(emb[[2, 9, 33]], "default")
+    for qi, (ids, scores) in zip([2, 9, 33], batched):
+        s_ids, s_scores = idx.search(emb[qi], "default")
+        assert ids == s_ids
+        assert ids[0] == f"s{qi}"
+        np.testing.assert_allclose(scores, s_scores, rtol=1e-6)
+
+
+def test_memory_system_batch(tmp_path):
+    ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False)
+    ms.start_conversation()
+    ms.chat("I work as a data engineer on a big ETL project.")
+    ms.chat("I love hiking in the mountains on weekends.")
+    ms.chat("My cat is named Whiskers.")
+    ms.end_conversation()
+
+    # Hashing-embedder retrieval is token-overlap based: queries share
+    # tokens with their target facts.
+    queries = ["data engineer work?", "hiking mountains?", "cat Whiskers name?"]
+    batched = ms.search_memories_batch(queries, limit=3)
+    assert len(batched) == 3
+    singles = [ms.search_memories(q, limit=3) for q in queries]
+    for b, s in zip(batched, singles):
+        assert [n.id for n in b] == [n.id for n in s]
+    assert any("data engineer" in n.content for n in batched[0])
+    assert any("Whiskers" in n.content for n in batched[2])
+    assert ms.search_memories_batch([]) == []
+    ms.close()

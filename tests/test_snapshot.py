@@ -152,6 +152,66 @@ def test_async_snapshot_drains_consolidation(tmp_path):
     ms2.close()
 
 
+def test_restore_discards_inflight_conversation(tmp_path):
+    """/restore mid-conversation must not leak pre-restore turns into the
+    restored graph."""
+    ms = _seeded_system(str(tmp_path / "db"))
+    snap = str(tmp_path / "snap")
+    ms.save_snapshot(snap)
+
+    ms.start_conversation()
+    ms.chat("This turn must NOT survive the restore.")
+    assert ms.conversation_active and ms.short_term_memory
+    ms.load_snapshot(snap)
+    assert not ms.conversation_active
+    assert not ms.short_term_memory and not ms.conversation_history
+    # The discarded turn never consolidates into the restored graph.
+    ms.start_conversation()
+    ms.end_conversation()
+    assert not any("must NOT survive" in n.content
+                   for n in ms.buffer.nodes.values())
+    ms.close()
+
+
+def test_restore_reopens_journal_for_snapshot_user(tmp_path):
+    ms = _seeded_system(str(tmp_path / "db"))
+    ms.switch_user("alice")
+    ms.start_conversation()
+    ms.chat("I play the violin.")
+    ms.end_conversation()
+    snap = str(tmp_path / "snap")
+    ms.save_snapshot(snap)
+    ms.close()
+
+    ms2 = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db2"),
+                       verbose=False, load_from_disk=False)
+    ms2.load_snapshot(snap)
+    assert ms2.user_id == "alice"
+    if ms2._journal is not None:           # journal active with a real store
+        assert "alice" in ms2._journal.path
+        # New turns journal under alice, not the pre-restore default user.
+        ms2.start_conversation()
+        ms2.chat("Practicing scales today.")
+        assert (tmp_path / "db2" / "journal__alice.wal").exists()
+    ms2.close()
+
+
+def test_corrupt_snapshot_leaves_system_intact(tmp_path):
+    ms = _seeded_system(str(tmp_path / "db"))
+    before = [n.content for n in ms.search_memories("data engineer work")]
+
+    # host.json present but no index checkpoint underneath.
+    bad = tmp_path / "bad_snap"
+    bad.mkdir()
+    (bad / "host.json").write_text('{"user_id": "default", "shards": {}}')
+    msg = ms.load_snapshot(str(bad))
+    assert msg.startswith("⚠")
+    # Old graph untouched — staging failed before any mutation.
+    after = [n.content for n in ms.search_memories("data engineer work")]
+    assert after == before
+    ms.close()
+
+
 def test_load_snapshot_missing_dir(tmp_path):
     ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
                       verbose=False, load_from_disk=False)
