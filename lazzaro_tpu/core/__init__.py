@@ -4,6 +4,8 @@ from lazzaro_tpu.core.memory_shard import MemoryShard
 from lazzaro_tpu.core.memory_system import MemorySystem
 from lazzaro_tpu.core.profile import Profile
 from lazzaro_tpu.core.query_cache import QueryCache
+from lazzaro_tpu.core.resilience import (CircuitBreaker, ResilientEmbedder,
+                                         ResilientLLM)
 from lazzaro_tpu.core.store import ArrowStore
 
 __all__ = [
@@ -14,4 +16,7 @@ __all__ = [
     "QueryCache",
     "MemoryIndex",
     "ArrowStore",
+    "CircuitBreaker",
+    "ResilientLLM",
+    "ResilientEmbedder",
 ]

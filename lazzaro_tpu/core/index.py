@@ -153,37 +153,24 @@ class MemoryIndex:
         TPU serving path for fleets of agents — per-query dispatch amortized
         away). Returns a (ids, scores) pair per query. Q is bucketed to a
         power of two so jit specializations stay bounded."""
+        from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
+                                                pad_to_pow2)
+
         queries = np.asarray(queries, np.float32)
         if queries.ndim == 1:
             queries = queries[None, :]
         nq = queries.shape[0]
         if nq == 0 or not self.id_to_row:
-            return [([], [])] * nq
+            return empty_results(nq)
         tid = self._tenants.get(tenant)
         if tid is None:
-            return [([], [])] * nq
-        bucket = 1 << (max(1, nq - 1)).bit_length()
-        if bucket > nq:
-            queries = np.concatenate(
-                [queries, np.zeros((bucket - nq, queries.shape[1]), np.float32)])
+            return empty_results(nq)
         k_eff = min(k, self.state.capacity)
         scores, rows = S.arena_search(
-            self.state, jnp.asarray(queries), jnp.int32(tid), k_eff,
-            super_filter)
-        scores = np.asarray(scores)[:nq]
-        rows = np.asarray(rows)[:nq]
-        out: List[Tuple[List[str], List[float]]] = []
-        for qi in range(nq):
-            ids, sc = [], []
-            for s, r in zip(scores[qi], rows[qi]):
-                if s <= S.NEG_INF / 2:
-                    continue
-                node_id = self.row_to_id.get(int(r))
-                if node_id is not None:
-                    ids.append(node_id)
-                    sc.append(float(s))
-            out.append((ids, sc))
-        return out
+            self.state, jnp.asarray(pad_to_pow2(queries)), jnp.int32(tid),
+            k_eff, super_filter)
+        return decode_topk(np.asarray(scores)[:nq], np.asarray(rows)[:nq],
+                           self.row_to_id, S.NEG_INF)
 
     # ------------------------------------------------------- numeric sweeps
     def update_access(self, ids: Sequence[str], boost: float = 0.05,

@@ -1330,6 +1330,10 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 host = json.load(f)
         except FileNotFoundError:
             return f"⚠ No snapshot at {snapshot_dir}"
+        except json.JSONDecodeError as e:
+            return f"⚠ Corrupt snapshot at {snapshot_dir}: {e}"
+        if not isinstance(host, dict):
+            return f"⚠ Corrupt snapshot at {snapshot_dir}: host.json is not an object"
 
         # Stage EVERYTHING fallibly before touching live state, so a corrupt
         # snapshot can never leave the system half-restored.

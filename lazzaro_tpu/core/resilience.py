@@ -1,0 +1,227 @@
+"""Failure detection and graceful degradation for providers.
+
+The reference's failure policy is "swallow and degrade": every provider call
+catches all exceptions and returns ``""`` or zero vectors
+(``providers.py:17-19,45-47,56-57,81-83,117-119`` — SURVEY §5 "failure
+detection: none"), so a dead API silently poisons the graph with zero
+embeddings and empty extractions. Here the degraded outputs are *detected*
+and routed: a circuit breaker tracks consecutive primary failures (raised
+exceptions AND the reference-style empty/zero sentinels), retries once by
+default, falls back to the always-available offline providers
+(``HeuristicLLM`` / ``HashingEmbedder``), and re-probes the primary after a
+cooldown (half-open). Health counters surface in ``health()`` for the stats
+path. The never-crash contract of the reference is preserved — calls always
+return a usable result — but degradation is observable and reversible
+instead of silent.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with cooldown re-probe (half-open).
+
+    closed → (threshold consecutive failures) → open → (cooldown elapses)
+    → half-open probe → success closes / failure re-opens.
+    """
+
+    def __init__(self, threshold: int = 3, cooldown: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.clock = clock
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self.lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        with self.lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self.opened_at is None:
+            return "closed"
+        if self.clock() - self.opened_at >= self.cooldown:
+            return "half-open"
+        return "open"
+
+    def allow(self) -> bool:
+        """May the primary be attempted right now?"""
+        with self.lock:
+            return self._state_locked() != "open"
+
+    def record_success(self) -> None:
+        with self.lock:
+            self.consecutive_failures = 0
+            self.opened_at = None
+
+    def record_failure(self) -> None:
+        with self.lock:
+            self.consecutive_failures += 1
+            if self.consecutive_failures >= self.threshold:
+                self.opened_at = self.clock()
+
+
+class _ResilientBase:
+    def __init__(self, breaker_threshold: int, cooldown: float,
+                 max_retries: int, clock: Callable[[], float]):
+        self.breaker = CircuitBreaker(breaker_threshold, cooldown, clock)
+        self.max_retries = max_retries
+        self.stats = {"primary_calls": 0, "primary_failures": 0,
+                      "fallback_calls": 0, "breaker_opens": 0}
+        self._stats_lock = threading.Lock()
+
+    def _bump(self, key: str) -> None:
+        with self._stats_lock:
+            self.stats[key] += 1
+
+    def health(self) -> Dict:
+        with self._stats_lock:
+            out = dict(self.stats)
+        out["breaker_state"] = self.breaker.state
+        out["consecutive_failures"] = self.breaker.consecutive_failures
+        return out
+
+    def _run_with_policy(self, attempt: Callable[[], object],
+                         degraded: Callable[[object], bool],
+                         fallback: Callable[[], object]) -> object:
+        """attempt() up to 1+max_retries times while the breaker allows;
+        degraded(result) flags reference-style silent failures. Any failure
+        path lands on fallback()."""
+        if self.breaker.allow():
+            for _ in range(1 + self.max_retries):
+                self._bump("primary_calls")
+                try:
+                    result = attempt()
+                except Exception:
+                    result = None
+                if result is not None and not degraded(result):
+                    self.breaker.record_success()
+                    return result
+                self._bump("primary_failures")
+                self.breaker.record_failure()
+            if self.breaker.state == "open":
+                self._bump("breaker_opens")
+        self._bump("fallback_calls")
+        return fallback()
+
+
+class ResilientLLM(_ResilientBase):
+    """LLMProvider wrapper: primary with retries + breaker, offline fallback.
+
+    A primary returning ``""`` (the reference's swallowed-exception sentinel,
+    providers.py:17-19) counts as a failure — that's the case the reference
+    can't see.
+    """
+
+    def __init__(self, primary, fallback=None, max_retries: int = 1,
+                 breaker_threshold: int = 3, cooldown: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        super().__init__(breaker_threshold, cooldown, max_retries, clock)
+        self.primary = primary
+        if fallback is None:
+            from lazzaro_tpu.core.providers import HeuristicLLM
+            fallback = HeuristicLLM()
+        self.fallback = fallback
+
+    def completion(self, messages: List[Dict[str, str]],
+                   response_format: Optional[Dict] = None) -> str:
+        return self._run_with_policy(
+            lambda: self.primary.completion(messages, response_format),
+            lambda r: not isinstance(r, str) or not r.strip(),
+            lambda: self.fallback.completion(messages, response_format))
+
+    def completion_stream(self, messages: List[Dict[str, str]],
+                          response_format: Optional[Dict] = None
+                          ) -> Iterator[str]:
+        """Streams can't be retried mid-flight; buffer-free policy: if the
+        breaker is open or the stream setup/first chunk fails, stream the
+        fallback instead."""
+        if self.breaker.allow() and hasattr(self.primary, "completion_stream"):
+            self._bump("primary_calls")
+            try:
+                stream = self.primary.completion_stream(messages, response_format)
+                first = next(stream, None)
+            except Exception:
+                first = None
+                stream = iter(())
+            if first is not None:
+                self.breaker.record_success()
+                yield first
+                yield from stream
+                return
+            self._bump("primary_failures")
+            self.breaker.record_failure()
+        self._bump("fallback_calls")
+        if hasattr(self.fallback, "completion_stream"):
+            yield from self.fallback.completion_stream(messages, response_format)
+        else:
+            yield self.fallback.completion(messages, response_format)
+
+
+class ResilientEmbedder(_ResilientBase):
+    """EmbeddingProvider wrapper. Zero vectors — the reference's swallowed
+    embedding failure (providers.py:45-47) — count as failures.
+
+    NOTE: primary and fallback must share ``dim``; mixing dimensions would
+    corrupt the index schema (the reference's 1536-vs-768 bug, SURVEY §2.2).
+    """
+
+    def __init__(self, primary, fallback=None, max_retries: int = 1,
+                 breaker_threshold: int = 3, cooldown: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        super().__init__(breaker_threshold, cooldown, max_retries, clock)
+        self.primary = primary
+        if fallback is None:
+            from lazzaro_tpu.core.providers import HashingEmbedder
+            dim = getattr(primary, "dim", None) or 768
+            fallback = HashingEmbedder(dim=dim)
+        self.fallback = fallback
+        p_dim = getattr(primary, "dim", None)
+        f_dim = getattr(fallback, "dim", None)
+        if p_dim and f_dim and p_dim != f_dim:
+            raise ValueError(
+                f"primary dim {p_dim} != fallback dim {f_dim}: mixed "
+                f"dimensions would corrupt the index schema")
+        self.dim = p_dim or f_dim
+
+    @staticmethod
+    def _degenerate(vecs) -> bool:
+        arr = np.asarray(vecs, np.float32)
+        if arr.size == 0:
+            return True
+        return bool(np.all(np.abs(arr) < 1e-12))
+
+    def embed(self, text: str) -> List[float]:
+        return self._run_with_policy(
+            lambda: self.primary.embed(text),
+            self._degenerate,
+            lambda: self.fallback.embed(text))
+
+    def batch_embed(self, texts: List[str]) -> List[List[float]]:
+        if not texts:
+            return []
+        result = self._run_with_policy(
+            lambda: self.primary.batch_embed(texts),
+            self._degenerate,
+            lambda: self.fallback.batch_embed(texts))
+        # Partial failure inside an otherwise-good batch: the reference
+        # leaves those rows as silent zero vectors; re-embed just them.
+        arr = np.asarray(result, np.float32)
+        if arr.ndim == 2 and len(result) == len(texts):
+            zero_rows = np.flatnonzero(np.all(np.abs(arr) < 1e-12, axis=1))
+            if zero_rows.size:
+                self._bump("fallback_calls")
+                repaired = self.fallback.batch_embed(
+                    [texts[i] for i in zero_rows])
+                result = [list(r) for r in result]
+                for i, r in zip(zero_rows.tolist(), repaired):
+                    result[i] = list(r)
+        return result

@@ -249,14 +249,13 @@ class TextEncoder:
             return np.zeros((0, self.dim), np.float32)
         # Always tokenize to cfg.max_len: longer rows would index past the
         # position table (Flax Embed fills OOB lookups with NaN, silently).
+        from lazzaro_tpu.utils.batching import pad_to_pow2
+
         ids = np.asarray(
             self.tokenizer.batch_encode(list(texts), self.cfg.max_len),
             np.int32)
         n = ids.shape[0]
-        bucket = 1 << (max(1, n - 1)).bit_length()
-        if bucket > n:
-            ids = np.concatenate([ids, np.zeros((bucket - n, ids.shape[1]), np.int32)])
-        out = self._apply(self.params, jnp.asarray(ids))
+        out = self._apply(self.params, jnp.asarray(pad_to_pow2(ids)))
         return np.asarray(out[:n], np.float32)
 
     def encode(self, text: str) -> np.ndarray:

@@ -161,39 +161,26 @@ class ShardedMemoryIndex:
     def search_batch(self, queries: np.ndarray, tenant: str
                      ) -> List[Tuple[List[str], List[float]]]:
         """Multi-query distributed top-k: Q queries share one local-score
-        matmul per chip and one all_gather — fleet serving over the pod."""
+        matmul per chip and one all_gather — fleet serving over the pod.
+        Q is bucketed to a power of two: each distinct query-batch shape
+        would otherwise retrace the pod-wide shard_map kernel (multi-second
+        compiles are most expensive exactly here)."""
+        from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
+                                                pad_to_pow2)
+
         queries = np.asarray(queries, np.float32)
         if queries.ndim == 1:
             queries = queries[None, :]
         nq = queries.shape[0]
         tid = self._tenants.get(tenant)
         if tid is None or nq == 0:
-            return [([], [])] * nq
+            return empty_results(nq)
         norms = np.maximum(np.linalg.norm(queries, axis=1, keepdims=True), 1e-9)
-        q = queries / norms
-        # Bucket Q to a power of two: each distinct query-batch shape would
-        # otherwise retrace the pod-wide shard_map kernel (multi-second
-        # compiles are most expensive exactly here).
-        bucket = 1 << (max(1, nq - 1)).bit_length()
-        if bucket > nq:
-            q = np.concatenate(
-                [q, np.zeros((bucket - nq, q.shape[1]), np.float32)])
+        q = pad_to_pow2(queries / norms)
         mask = self.alive & (self.tenant == tid)
         scores, rows = self._search(self.emb, mask, jnp.asarray(q))
-        scores = np.asarray(scores)[:nq]
-        rows = np.asarray(rows)[:nq]
-        out: List[Tuple[List[str], List[float]]] = []
-        for qi in range(nq):
-            ids, sc = [], []
-            for s, r in zip(scores[qi], rows[qi]):
-                if s <= NEG_INF / 2:
-                    continue
-                nid = self.row_to_id.get(int(r))
-                if nid is not None:
-                    ids.append(nid)
-                    sc.append(float(s))
-            out.append((ids, sc))
-        return out
+        return decode_topk(np.asarray(scores)[:nq], np.asarray(rows)[:nq],
+                           self.row_to_id, NEG_INF)
 
     def decay(self, tenant: str, rate: float, floor: float = 0.2) -> None:
         tid = self._tenants.get(tenant)

@@ -1,0 +1,56 @@
+"""Host-side batching helpers shared by the index classes and the encoder.
+
+Static shapes are the XLA contract: every distinct batch size compiles a new
+kernel specialization, so hosts bucket batch dims to powers of two. The
+decode loop turns kernel output (scores + arena rows with NEG_INF sentinels)
+back into host id lists — one implementation, used by both the single-chip
+and pod-sharded indexes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (1 for n <= 1 — a single item needs no
+    padding; mapping 1 → 2 would double every single-query dispatch)."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def pad_to_pow2(arr: np.ndarray) -> np.ndarray:
+    """Pad axis 0 with zero rows up to the power-of-two bucket."""
+    n = arr.shape[0]
+    bucket = next_pow2(n)
+    if bucket == n:
+        return arr
+    pad = np.zeros((bucket - n,) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+def decode_topk(scores: np.ndarray, rows: np.ndarray,
+                row_to_id: Dict[int, str], neg_inf: float
+                ) -> List[Tuple[List[str], List[float]]]:
+    """Per query: drop NEG_INF sentinels and rows without a live id mapping;
+    return (ids, scores) pairs."""
+    out: List[Tuple[List[str], List[float]]] = []
+    for qi in range(scores.shape[0]):
+        ids: List[str] = []
+        sc: List[float] = []
+        for s, r in zip(scores[qi], rows[qi]):
+            if s <= neg_inf / 2:
+                continue
+            node_id = row_to_id.get(int(r))
+            if node_id is not None:
+                ids.append(node_id)
+                sc.append(float(s))
+        out.append((ids, sc))
+    return out
+
+
+def empty_results(n: int) -> List[Tuple[List[str], List[float]]]:
+    """n independent ([], []) pairs — NOT `[([], [])] * n`, which aliases
+    the same two lists across every entry."""
+    return [([], []) for _ in range(n)]

@@ -1,0 +1,175 @@
+"""Failure detection: retries, sentinel detection, circuit breaker, fallback."""
+
+import numpy as np
+import pytest
+
+from lazzaro_tpu.core.resilience import (
+    CircuitBreaker, ResilientEmbedder, ResilientLLM)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class ScriptedLLM:
+    """Yields scripted results; 'raise' raises, '' mimics the reference's
+    swallowed-failure sentinel."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def completion(self, messages, response_format=None):
+        self.calls += 1
+        step = self.script.pop(0) if self.script else "ok"
+        if step == "raise":
+            raise ConnectionError("api down")
+        return step
+
+    def completion_stream(self, messages, response_format=None):
+        out = self.completion(messages, response_format)
+        for i in range(0, len(out), 4):
+            yield out[i:i + 4]
+
+
+class ScriptedEmbedder:
+    dim = 8
+
+    def __init__(self, script):
+        self.script = list(script)
+
+    def _next(self, n):
+        step = self.script.pop(0) if self.script else "ok"
+        if step == "raise":
+            raise ConnectionError("api down")
+        if step == "zeros":
+            return [[0.0] * self.dim] * n
+        if step == "partial":
+            rows = [[1.0] + [0.0] * (self.dim - 1)] * n
+            rows[0] = [0.0] * self.dim
+            return rows
+        return [[1.0] + [0.0] * (self.dim - 1)] * n
+
+    def embed(self, text):
+        return self._next(1)[0]
+
+    def batch_embed(self, texts):
+        return self._next(len(texts))
+
+
+MSG = [{"role": "user", "content": "hello"}]
+
+
+def test_breaker_state_machine():
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=2, cooldown=10.0, clock=clock)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    clock.advance(10.0)
+    assert br.state == "half-open" and br.allow()
+    br.record_failure()                      # probe fails → re-open
+    assert br.state == "open"
+    clock.advance(10.0)
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_retry_then_success():
+    llm = ResilientLLM(ScriptedLLM(["raise", "recovered"]), max_retries=1)
+    assert llm.completion(MSG) == "recovered"
+    h = llm.health()
+    assert h["primary_failures"] == 1 and h["fallback_calls"] == 0
+
+
+def test_empty_sentinel_detected_and_falls_back():
+    primary = ScriptedLLM(["", ""])          # reference-style silent failure
+    llm = ResilientLLM(primary, max_retries=1)
+    out = llm.completion(MSG)
+    assert out                               # heuristic fallback answered
+    assert llm.health()["fallback_calls"] == 1
+    assert primary.calls == 2                # initial + one retry
+
+
+def test_breaker_opens_and_skips_primary():
+    clock = FakeClock()
+    primary = ScriptedLLM(["raise"] * 10)
+    llm = ResilientLLM(primary, max_retries=0, breaker_threshold=2,
+                       cooldown=30.0, clock=clock)
+    llm.completion(MSG)
+    llm.completion(MSG)
+    assert llm.health()["breaker_state"] == "open"
+    calls_before = primary.calls
+    llm.completion(MSG)                      # breaker open → straight to fallback
+    assert primary.calls == calls_before
+    clock.advance(30.0)                      # half-open → probe again
+    primary.script = ["back online"]
+    assert llm.completion(MSG) == "back online"
+    assert llm.health()["breaker_state"] == "closed"
+
+
+def test_stream_falls_back_on_error():
+    llm = ResilientLLM(ScriptedLLM(["raise"]), max_retries=0)
+    out = "".join(llm.completion_stream(MSG))
+    assert out                               # fallback streamed something
+    llm2 = ResilientLLM(ScriptedLLM(["streaming works fine"]))
+    assert "".join(llm2.completion_stream(MSG)) == "streaming works fine"
+
+
+def test_embedder_zero_vector_detected():
+    emb = ResilientEmbedder(ScriptedEmbedder(["zeros", "zeros"]), max_retries=1)
+    vec = emb.embed("hello world")
+    assert np.abs(vec).sum() > 0             # fallback hashing embedding
+    assert emb.health()["fallback_calls"] == 1
+
+
+def test_embedder_partial_batch_repaired():
+    emb = ResilientEmbedder(ScriptedEmbedder(["partial"]))
+    out = emb.batch_embed(["a bad row", "a good row", "another good"])
+    arr = np.asarray(out)
+    assert arr.shape == (3, 8)
+    assert np.all(np.abs(arr).sum(axis=1) > 0)   # zero row re-embedded
+
+
+def test_embedder_dim_mismatch_rejected():
+    class OtherDim:
+        dim = 16
+
+        def embed(self, text):
+            return [0.0] * 16
+
+        def batch_embed(self, texts):
+            return [[0.0] * 16 for _ in texts]
+
+    with pytest.raises(ValueError, match="dim"):
+        ResilientEmbedder(ScriptedEmbedder([]), fallback=OtherDim())
+
+
+def test_memory_system_with_resilient_providers(tmp_path):
+    """End-to-end: a flaky primary LLM + embedder still produce a working
+    ingest → retrieval cycle via fallbacks."""
+    from lazzaro_tpu.core.memory_system import MemorySystem
+
+    flaky_llm = ResilientLLM(ScriptedLLM(["raise"] * 50), max_retries=0,
+                             breaker_threshold=2)
+    flaky_emb = ResilientEmbedder(ScriptedEmbedder(["raise"] * 50),
+                                  max_retries=0, breaker_threshold=2)
+    ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False,
+                      llm_provider=flaky_llm, embedding_provider=flaky_emb)
+    ms.start_conversation()
+    ms.chat("I work as a data engineer on a big ETL project.")
+    ms.end_conversation()
+    hits = [n.content for n in ms.search_memories("data engineer work")]
+    assert any("data engineer" in h for h in hits)
+    assert flaky_llm.health()["fallback_calls"] > 0
+    ms.close()
