@@ -141,8 +141,12 @@ def save_index(index: MemoryIndex, ckpt_dir: str) -> None:
             shutil.rmtree(os.path.join(ckpt_dir, entry), ignore_errors=True)
 
 
-def load_index(ckpt_dir: str) -> MemoryIndex:
-    """Rebuild a MemoryIndex from the snapshot ``CURRENT`` points at."""
+def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data") -> MemoryIndex:
+    """Rebuild a MemoryIndex from the snapshot ``CURRENT`` points at.
+
+    ``mesh``: restore row-sharded over the mesh axis (the saved total row
+    count must divide the axis size — mesh-created indexes guarantee this
+    via capacity rounding)."""
     cur = _read_current(ckpt_dir)
     if cur is None:
         raise FileNotFoundError(f"no checkpoint at {ckpt_dir} (missing CURRENT)")
@@ -163,8 +167,8 @@ def load_index(ckpt_dir: str) -> MemoryIndex:
 
     dt = jnp.bfloat16 if meta["dtype"] == "bfloat16" else jnp.dtype(meta["dtype"])
     index = MemoryIndex(meta["dim"], capacity=1, edge_capacity=1, dtype=dt,
-                        epoch=meta["epoch"])
-    index.state = arena
+                        epoch=meta["epoch"], mesh=mesh, shard_axis=shard_axis)
+    index.state = arena        # setter re-shards over the mesh if given
     index.edge_state = edges
 
     node_rows = data["node_rows"].astype(np.int64)

@@ -26,13 +26,32 @@ from lazzaro_tpu.ops import graphops
 
 
 class MemoryIndex:
+    """Single-chip by default; pass ``mesh`` to row-shard every arena column
+    over a mesh axis — the scaling-book recipe: annotate the shardings, let
+    XLA insert the collectives. All kernels (search matmul, scatter
+    mutations, decay sweeps, link matmuls) are plain jnp under jit, so GSPMD
+    partitions them automatically; the state setters re-constrain outputs so
+    a kernel can never silently replicate the arena. This scales the FULL
+    orchestrator (edges, decay, linking included) — ``ShardedMemoryIndex``
+    remains the lean retrieval-only variant with tenant→partition affinity."""
+
     def __init__(self, dim: int, capacity: int = 1024, edge_capacity: int = 8192,
-                 dtype=jnp.float32, epoch: Optional[float] = None):
+                 dtype=jnp.float32, epoch: Optional[float] = None,
+                 mesh=None, shard_axis: str = "data"):
         self.dim = dim
         self.dtype = dtype
+        self.mesh = mesh
+        self.shard_axis = shard_axis
+        self._n_parts = int(mesh.shape[shard_axis]) if mesh is not None else 1
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._row_sharding = NamedSharding(mesh, P(shard_axis))
+            self._mat_sharding = NamedSharding(mesh, P(shard_axis, None))
         # Timestamps are stored relative to this epoch so f32 keeps sub-second
         # precision (raw unix seconds ~1.7e9 would quantize to ~2 minutes).
         self.epoch = float(epoch if epoch is not None else time.time())
+        capacity = self._round_capacity(capacity)
+        edge_capacity = self._round_capacity(edge_capacity)
         self.state = S.init_arena(capacity, dim, dtype)
         self.edge_state = S.init_edges(edge_capacity)
         self._free_rows: List[int] = list(range(capacity - 1, -1, -1))
@@ -43,6 +62,47 @@ class MemoryIndex:
         self._tenants: Dict[str, int] = {}
         self._shards: Dict[str, int] = {}
         self.tenant_nodes: Dict[str, set] = {}
+
+    # -------------------------------------------------------------- sharding
+    def _round_capacity(self, capacity: int) -> int:
+        """Row counts include the +1 sentinel; under a mesh the TOTAL must
+        divide evenly across the axis, so round capacity+1 up."""
+        if self._n_parts <= 1:
+            return capacity
+        total = capacity + 1
+        total = -(-total // self._n_parts) * self._n_parts
+        return total - 1
+
+    def _grown_capacity(self, old_capacity: int) -> int:
+        """Doubling that preserves mesh divisibility of capacity+1."""
+        if self._n_parts <= 1:
+            return old_capacity * 2
+        return (old_capacity + 1) * 2 - 1
+
+    def _reshard(self, pytree):
+        """Constrain every column to its row sharding (the only 2-D leaf,
+        ``emb``, gets P(axis, None)). Shardings are built once in __init__;
+        device_put is a no-op when the leaf is already placed correctly."""
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                a, self._mat_sharding if a.ndim == 2 else self._row_sharding),
+            pytree)
+
+    @property
+    def state(self) -> S.ArenaState:
+        return self._state
+
+    @state.setter
+    def state(self, s: S.ArenaState) -> None:
+        self._state = s if self.mesh is None else self._reshard(s)
+
+    @property
+    def edge_state(self) -> S.EdgeState:
+        return self._edge_state
+
+    @edge_state.setter
+    def edge_state(self, s: S.EdgeState) -> None:
+        self._edge_state = s if self.mesh is None else self._reshard(s)
 
     # ------------------------------------------------------------------ ids
     def tenant_id(self, name: str) -> int:
@@ -66,8 +126,9 @@ class MemoryIndex:
     def _alloc_rows(self, n: int) -> List[int]:
         while len(self._free_rows) < n:
             old_cap = self.state.capacity
-            self.state = S.grow_arena(self.state, old_cap * 2)
-            self._free_rows = list(range(old_cap * 2 - 1, old_cap - 1, -1)) + self._free_rows
+            new_cap = self._grown_capacity(old_cap)
+            self.state = S.grow_arena(self.state, new_cap)
+            self._free_rows = list(range(new_cap - 1, old_cap - 1, -1)) + self._free_rows
         return [self._free_rows.pop() for _ in range(n)]
 
     def add(self, ids: Sequence[str], embeddings: np.ndarray,
@@ -323,8 +384,9 @@ class MemoryIndex:
     def _alloc_edge_slots(self, n: int) -> List[int]:
         while len(self._free_edge_slots) < n:
             old = self.edge_state.capacity
-            self.edge_state = S.grow_edges(self.edge_state, old * 2)
-            self._free_edge_slots = list(range(old * 2 - 1, old - 1, -1)) + self._free_edge_slots
+            new = self._grown_capacity(old)
+            self.edge_state = S.grow_edges(self.edge_state, new)
+            self._free_edge_slots = list(range(new - 1, old - 1, -1)) + self._free_edge_slots
         return [self._free_edge_slots.pop() for _ in range(n)]
 
     def add_edges(self, triples: Sequence[Tuple[str, str, float]], tenant: str,

@@ -61,7 +61,11 @@ class MemorySystem:
         store=None,
         config: Optional[MemoryConfig] = None,
         verbose: bool = True,
+        mesh=None,
     ):
+        """``mesh``: optional ``jax.sharding.Mesh`` with a 'data' axis — the
+        arena index row-shards across it and every kernel runs SPMD (full
+        pod-scale orchestrator; see MemoryIndex sharding notes)."""
         # Explicit kwargs win; otherwise values come from the (possibly
         # caller-supplied) MemoryConfig, whose defaults match the reference
         # constructor (memory_system.py:63-84).
@@ -104,8 +108,9 @@ class MemorySystem:
         self.super_nodes: Dict[str, Node] = {}
         self.buffer = BufferGraph(self.shards, self.super_nodes)
         self.profile = Profile()
+        self.mesh = mesh
         self.index = MemoryIndex(dim, capacity=cfg.initial_capacity,
-                                 edge_capacity=cfg.max_edges)
+                                 edge_capacity=cfg.max_edges, mesh=mesh)
 
         self.query_cache = QueryCache(cfg.cache_size) if enable_caching else None
 
@@ -1338,7 +1343,8 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         # Stage EVERYTHING fallibly before touching live state, so a corrupt
         # snapshot can never leave the system half-restored.
         try:
-            new_index = ckpt.load_index(os.path.join(snapshot_dir, "index"))
+            new_index = ckpt.load_index(os.path.join(snapshot_dir, "index"),
+                                        mesh=self.mesh)
             staged_shards: Dict[str, Tuple[List[Node], List[Edge]]] = {}
             for shard_key, sd in host.get("shards", {}).items():
                 staged_shards[shard_key] = (
