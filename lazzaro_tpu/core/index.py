@@ -122,6 +122,21 @@ class MemoryIndex:
     def __len__(self) -> int:
         return len(self.id_to_row)
 
+    def stats(self) -> Dict[str, object]:
+        """Public observability surface (keeps dashboards off private
+        bookkeeping)."""
+        return {
+            "rows": len(self.id_to_row),
+            "capacity": self.state.capacity,
+            "edge_capacity": self.edge_state.capacity,
+            "edges": len(self.edge_slots),
+            "dim": self.dim,
+            "dtype": str(np.dtype(self.dtype)),
+            "tenants": len(self._tenants),
+            "mesh": (f"{self._n_parts}x {self.shard_axis}"
+                     if self.mesh is not None else None),
+        }
+
     # ---------------------------------------------------------------- nodes
     def _alloc_rows(self, n: int) -> List[int]:
         while len(self._free_rows) < n:

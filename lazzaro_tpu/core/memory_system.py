@@ -1552,6 +1552,15 @@ Be clinical yet insightful. Do not include conversational filler."""
                 "llm_calls": self.metrics["llm_calls"],
                 "embedding_calls": self.metrics["embedding_calls"],
             },
+            "index": self.index.stats(),
+            "providers": {
+                "llm": type(self.llm).__name__,
+                "embedder": type(self.embedder).__name__,
+                "llm_health": (self.llm.health()
+                               if hasattr(self.llm, "health") else None),
+                "embedder_health": (self.embedder.health()
+                                    if hasattr(self.embedder, "health") else None),
+            },
         }
 
     def display_stats(self) -> str:

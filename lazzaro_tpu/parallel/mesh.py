@@ -41,5 +41,50 @@ def single_device_mesh() -> Mesh:
     return make_mesh(("data",), (1,), devices=jax.devices()[:1])
 
 
+def make_hybrid_mesh(ici_axes: Sequence[str], ici_sizes: Sequence[int],
+                     dcn_axis: str = "slice",
+                     num_slices: Optional[int] = None) -> Mesh:
+    """Multi-slice mesh: a DCN axis across slices, ICI axes within each.
+
+    Lay shardings out so collectives on ``ici_axes`` ride the intra-slice
+    interconnect and only the ``dcn_axis`` (put FIRST, slowest-varying)
+    crosses the data-center network — e.g. data-parallel over slices,
+    tensor/index-parallel within. Call ``jax.distributed.initialize()``
+    first on multi-host deployments.
+
+    Requires ``prod(ici_sizes)`` devices per slice (extra devices in a
+    slice are unused). On platforms with no slice topology (CPU, single
+    slice) the result is the same axes with a size-1 ``dcn_axis``, so mesh
+    consumers never special-case slice count.
+    """
+    per_slice = int(np.prod(ici_sizes))
+    groups: dict = {}
+    for d in jax.devices():
+        groups.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
+    slice_ids = sorted(groups)
+    n_slices = num_slices if num_slices is not None else len(slice_ids)
+    if len(slice_ids) < n_slices:
+        raise ValueError(f"requested {n_slices} slices, platform exposes "
+                         f"{len(slice_ids)}")
+    short = [s for s in slice_ids[:n_slices] if len(groups[s]) < per_slice]
+    if short:
+        raise ValueError(f"slices {short} have fewer than prod(ici_sizes)="
+                         f"{per_slice} devices")
+    if n_slices <= 1:
+        devs = groups[slice_ids[0]][:per_slice] if slice_ids else []
+        return make_mesh((dcn_axis,) + tuple(ici_axes), (1,) + tuple(ici_sizes),
+                         devices=devs)
+    # Topology-aware ICI ordering within each slice, explicit stacking
+    # across slices (documented create_device_mesh contract — no reliance
+    # on create_hybrid_device_mesh's internal block layout).
+    from jax.experimental import mesh_utils
+    per_slice_arrays = [
+        mesh_utils.create_device_mesh(tuple(ici_sizes),
+                                      devices=groups[s][:per_slice])
+        for s in slice_ids[:n_slices]]
+    dev_array = np.stack(per_slice_arrays)
+    return Mesh(dev_array, (dcn_axis,) + tuple(ici_axes))
+
+
 def spec(mesh: Mesh, *axes: Optional[str]) -> NamedSharding:
     return NamedSharding(mesh, P(*axes))

@@ -1,0 +1,113 @@
+"""Concurrency stress: the single-writer + mutex design under real threads.
+
+The reference's ThreadPoolExecutor mutates shards/counters unlocked — a
+data race SURVEY §5 says to design away. These tests hammer the orchestrator
+from concurrent reader threads while background consolidations run, then
+check structural invariants that unsynchronized mutation would violate.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from lazzaro_tpu.core.memory_system import MemorySystem
+
+
+def _invariants(ms):
+    """Host graph ↔ arena coherence checks."""
+    # Every host node has exactly one arena row, and vice versa (this user).
+    host_ids = set(ms.buffer.nodes.keys())
+    arena_ids = {q.partition(":")[2] for q in
+                 ms.index.tenant_nodes.get(ms.user_id, set())}
+    assert host_ids == arena_ids, (host_ids ^ arena_ids)
+    # id maps are mutually inverse.
+    for nid, row in ms.index.id_to_row.items():
+        assert ms.index.row_to_id[row] == nid
+    # No row is both free and allocated.
+    free = set(ms.index._free_rows)
+    used = set(ms.index.row_to_id)
+    assert not (free & used)
+    # Node counter never collides with an existing id.
+    assert f"node_{ms.node_counter + 1}" not in host_ids
+
+
+def test_concurrent_searches_during_async_ingest(tmp_path):
+    ms = MemorySystem(enable_async=True, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False)
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                ms.search_memories("data engineer hiking cat", limit=3)
+                ms.get_stats()
+            except Exception as e:          # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(6):
+            ms.start_conversation()
+            ms.chat(f"Fact number {i}: I enjoy topic {i} very much.")
+            ms.end_conversation()           # async consolidation each time
+    finally:
+        ms._drain_background()
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors, errors
+    _invariants(ms)
+    assert len(ms.buffer.nodes) > 0
+    ms.close()
+
+
+def test_interleaved_users_with_async_worker(tmp_path):
+    """switch_user barriers: facts never leak across tenants even when
+    consolidations queue up behind each other."""
+    ms = MemorySystem(enable_async=True, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False)
+    for user, fact in [("alice", "Alice plays violin in an orchestra."),
+                       ("bob", "Bob repairs vintage motorcycles."),
+                       ("alice", "Alice is learning Italian.")]:
+        ms.switch_user(user)
+        ms.start_conversation()
+        ms.chat(fact)
+        ms.end_conversation()
+    ms._drain_background()
+
+    ms.switch_user("alice")
+    _invariants(ms)
+    alice = " ".join(n.content for n in ms.buffer.nodes.values())
+    assert "violin" in alice and "motorcycles" not in alice
+    ms.switch_user("bob")
+    _invariants(ms)
+    bob = " ".join(n.content for n in ms.buffer.nodes.values())
+    assert "motorcycles" in bob and "violin" not in bob
+    ms.close()
+
+
+def test_stats_expose_index_and_provider_health(tmp_path):
+    from lazzaro_tpu.core.resilience import ResilientLLM
+
+    class DeadLLM:
+        def completion(self, messages, response_format=None):
+            raise ConnectionError("down")
+
+    ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False,
+                      llm_provider=ResilientLLM(DeadLLM(), max_retries=0))
+    ms.start_conversation()
+    ms.chat("I collect rare stamps.")
+    ms.end_conversation()
+    stats = ms.get_stats()
+    assert stats["index"]["rows"] == len(ms.index)
+    assert stats["index"]["dim"] == ms.embed_dim
+    assert stats["providers"]["llm"] == "ResilientLLM"
+    assert stats["providers"]["llm_health"]["fallback_calls"] > 0
+    assert stats["providers"]["embedder_health"] is None   # plain embedder
+    ms.close()

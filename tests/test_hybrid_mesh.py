@@ -1,0 +1,40 @@
+"""Hybrid (multi-slice) mesh helper: single-slice fallback path on CPU."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lazzaro_tpu.parallel.mesh import make_hybrid_mesh
+
+
+def test_single_slice_fallback_shape():
+    mesh = make_hybrid_mesh(("data",), (8,))
+    assert mesh.axis_names == ("slice", "data")
+    assert mesh.shape["slice"] == 1 and mesh.shape["data"] == 8
+
+
+def test_hybrid_mesh_drives_sharded_compute():
+    mesh = make_hybrid_mesh(("data",), (8,))
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "data")))
+    out = jax.jit(lambda a: (a * 2).sum())(xs)
+    assert float(out) == x.sum() * 2
+
+
+def test_hybrid_mesh_with_two_ici_axes():
+    mesh = make_hybrid_mesh(("data", "model"), (4, 2))
+    assert mesh.axis_names == ("slice", "data", "model")
+    assert mesh.shape == {"slice": 1, "data": 4, "model": 2}
+
+
+def test_explicit_num_slices_on_flat_topology():
+    # CPU devices expose no slice topology; forcing num_slices>1 must fail
+    # loudly, not build a bogus cross-"slice" mesh.
+    with pytest.raises(ValueError, match="slices"):
+        make_hybrid_mesh(("data",), (4,), num_slices=2)
+
+
+def test_too_large_ici_request_fails_loudly():
+    with pytest.raises(ValueError, match="devices"):
+        make_hybrid_mesh(("data",), (512,))
