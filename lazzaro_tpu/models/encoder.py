@@ -295,7 +295,8 @@ def bert_params_from_hf(hf_model, cfg: EncoderConfig) -> dict:
     params: torch Linear kernels are [out, in] → transposed; token-type
     embedding row 0 is folded into the position table (all inputs are
     segment 0, so the sums are identical)."""
-    sd = {k: np.asarray(v.detach().cpu().numpy())
+    # .float() first: bf16 torch tensors do not support .numpy().
+    sd = {k: np.asarray(v.detach().cpu().float().numpy())
           for k, v in hf_model.state_dict().items()}
 
     def dense(prefix):

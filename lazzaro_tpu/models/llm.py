@@ -300,20 +300,62 @@ def make_train_step(cfg: LMConfig, optimizer, mesh: Optional[Mesh] = None):
 
 class LanguageModel:
     def __init__(self, cfg: Optional[LMConfig] = None, seed: int = 0,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, tokenizer=None,
+                 init_params: bool = True):
         self.cfg = cfg or LMConfig.small()
         _check_flash_tensor_parallel(self.cfg, mesh)
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
+        self.eos_id = int(getattr(self.tokenizer, "EOS", None)
+                          or getattr(self.tokenizer, "eos_id", ByteTokenizer.EOS))
         self.model = Decoder(self.cfg)
-        dummy = jnp.zeros((1, 8), jnp.int32)
-        pos = jnp.zeros((1, 8), jnp.int32)
-        variables = self.model.init(jax.random.PRNGKey(seed), dummy, pos)
-        self.params = variables["params"]
-        if mesh is not None:
-            self.params = shard_params(self.params, mesh)
+        if init_params:
+            dummy = jnp.zeros((1, 8), jnp.int32)
+            pos = jnp.zeros((1, 8), jnp.int32)
+            variables = self.model.init(jax.random.PRNGKey(seed), dummy, pos)
+            self.params = variables["params"]
+            if mesh is not None:
+                self.params = shard_params(self.params, mesh)
+        else:
+            self.params = None            # caller installs params (from_hf)
         self.mesh = mesh
         self._prefill = jax.jit(self._prefill_impl)
         self._decode_one = jax.jit(self._decode_impl)
+
+    @classmethod
+    def from_hf(cls, hf_model, hf_tokenizer=None,
+                max_seq: int = 2048, dtype: str = "float32",
+                mesh: Optional[Mesh] = None) -> "LanguageModel":
+        """Build from a local ``transformers`` Gemma-family causal LM — the
+        decoder-side analog of ``TextEncoder.from_hf`` (zero egress; the
+        checkpoint must already be on disk/in memory). Maps GemmaModel
+        weights onto the in-tree Decoder: torch Linear kernels transposed
+        and reshaped to (hidden, heads, head_dim), RMSNorm weights shifted
+        by +1 (Gemma computes ``x * (1 + w)``; this module multiplies by the
+        scale directly), embeddings tied for the LM head.
+
+        ``hf_tokenizer``: optional transformers tokenizer wrapped via
+        ``HFLMTokenizerAdapter`` — without it the byte tokenizer is kept
+        (mechanically fine, but ids won't match the checkpoint's
+        sentencepiece vocab, so generations are meaningless)."""
+        hc = hf_model.config
+        if getattr(hc, "model_type", "gemma") != "gemma":
+            raise ValueError(
+                f"from_hf supports Gemma-1-family checkpoints (model_type "
+                f"'gemma'), got {hc.model_type!r} — Gemma-2's softcapping/"
+                f"pre-post norms and other families need their own mapping")
+        cfg = LMConfig(
+            vocab_size=hc.vocab_size, hidden=hc.hidden_size,
+            layers=hc.num_hidden_layers, heads=hc.num_attention_heads,
+            kv_heads=hc.num_key_value_heads, head_dim=hc.head_dim,
+            mlp_dim=hc.intermediate_size,
+            max_seq=min(max_seq, hc.max_position_embeddings),
+            rope_theta=float(getattr(hc, "rope_theta", 10000.0)),
+            dtype=dtype)
+        tok = HFLMTokenizerAdapter(hf_tokenizer) if hf_tokenizer is not None else None
+        lm = cls(cfg, tokenizer=tok, mesh=mesh, init_params=False)
+        params = gemma_params_from_hf(hf_model, cfg)
+        lm.params = shard_params(params, mesh) if mesh is not None else params
+        return lm
 
     # -- checkpointing ------------------------------------------------------
     def save_params(self, ckpt_dir: str) -> None:
@@ -376,7 +418,7 @@ class LanguageModel:
             else:
                 token = jnp.argmax(logits, axis=-1)
             tid = int(token[0])
-            if tid == ByteTokenizer.EOS or pos >= cfg.max_seq - 1:
+            if tid == self.eos_id or pos >= cfg.max_seq - 1:
                 break
             out_ids.append(tid)
             logits, caches = self._decode_one(
@@ -398,6 +440,10 @@ class LanguageModel:
         (providers.py:10-19, memory_system.py:684-703)."""
         from lazzaro_tpu.models.json_constrain import JsonState, constrain_mask
 
+        if not isinstance(self.tokenizer, ByteTokenizer):
+            raise ValueError(
+                "generate_json requires the byte tokenizer (the JSON grammar "
+                "automaton masks logits per BYTE; subword ids don't map 1:1)")
         cfg = self.cfg
         max_new_tokens, logits, caches, pos = self._prep_prompt(
             prompt, max_new_tokens)
@@ -442,3 +488,75 @@ class LanguageModel:
         positions = jnp.arange(len(ids))[None, :]
         logits, _ = self.model.apply({"params": self.params}, tokens, positions)
         return np.asarray(logits[0])
+
+
+class HFLMTokenizerAdapter:
+    """Duck-types the ByteTokenizer surface over a HuggingFace tokenizer so
+    a real checkpoint's (e.g. sentencepiece) vocab can drive generation."""
+
+    def __init__(self, hf_tokenizer):
+        self.hf = hf_tokenizer
+
+    @property
+    def eos_id(self) -> int:
+        eos = getattr(self.hf, "eos_token_id", None)
+        return int(eos) if eos is not None else -1
+
+    def encode(self, text: str, add_bos: bool = True,
+               add_eos: bool = False) -> list:
+        ids = self.hf.encode(text, add_special_tokens=False)
+        bos = getattr(self.hf, "bos_token_id", None)
+        if add_bos and bos is not None:
+            ids = [int(bos)] + list(ids)
+        if add_eos and self.eos_id >= 0:
+            ids = list(ids) + [self.eos_id]
+        return list(ids)
+
+    def decode(self, ids) -> str:
+        return self.hf.decode([int(i) for i in ids],
+                              skip_special_tokens=True)
+
+
+def gemma_params_from_hf(hf_model, cfg: LMConfig) -> Dict:
+    """Map a torch ``transformers`` Gemma-family causal LM's state_dict onto
+    ``Decoder`` params. Conventions handled: torch Linear kernels are
+    [out, in] → transposed (and reshaped to (hidden, heads, head_dim) for
+    q/k/v, (heads, head_dim, hidden) for o); Gemma RMSNorm multiplies by
+    ``1 + weight`` → +1 folded into the scale; embeddings are tied for the
+    LM head (``Decoder`` computes logits against the embedding table)."""
+    # .float() first: Gemma checkpoints are natively bf16 and torch bf16
+    # tensors do not support .numpy().
+    sd = {k: np.asarray(v.detach().cpu().float().numpy())
+          for k, v in hf_model.state_dict().items()}
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    def ln(name):
+        return {"scale": sd[name] + 1.0}
+
+    params: Dict = {
+        "embed": sd[f"{pre}embed_tokens.weight"],
+        "ln_f": ln(f"{pre}norm.weight"),
+    }
+    H, Hkv, D, hid = cfg.heads, cfg.kv_heads, cfg.head_dim, cfg.hidden
+    for i in range(cfg.layers):
+        a = f"{pre}layers.{i}"
+        params[f"block_{i}"] = {
+            "ln1": ln(f"{a}.input_layernorm.weight"),
+            "ln2": ln(f"{a}.post_attention_layernorm.weight"),
+            "attn": {
+                "q": {"kernel": sd[f"{a}.self_attn.q_proj.weight"].T
+                      .reshape(hid, H, D)},
+                "k": {"kernel": sd[f"{a}.self_attn.k_proj.weight"].T
+                      .reshape(hid, Hkv, D)},
+                "v": {"kernel": sd[f"{a}.self_attn.v_proj.weight"].T
+                      .reshape(hid, Hkv, D)},
+                "o": {"kernel": sd[f"{a}.self_attn.o_proj.weight"].T
+                      .reshape(H, D, hid)},
+            },
+            "mlp": {
+                "gate": {"kernel": sd[f"{a}.mlp.gate_proj.weight"].T},
+                "up": {"kernel": sd[f"{a}.mlp.up_proj.weight"].T},
+                "down": {"kernel": sd[f"{a}.mlp.down_proj.weight"].T},
+            },
+        }
+    return jax.tree_util.tree_map(jnp.asarray, params)

@@ -70,13 +70,11 @@ def make_hybrid_mesh(ici_axes: Sequence[str], ici_sizes: Sequence[int],
     if short:
         raise ValueError(f"slices {short} have fewer than prod(ici_sizes)="
                          f"{per_slice} devices")
-    if n_slices <= 1:
-        devs = groups[slice_ids[0]][:per_slice] if slice_ids else []
-        return make_mesh((dcn_axis,) + tuple(ici_axes), (1,) + tuple(ici_sizes),
-                         devices=devs)
-    # Topology-aware ICI ordering within each slice, explicit stacking
-    # across slices (documented create_device_mesh contract — no reliance
-    # on create_hybrid_device_mesh's internal block layout).
+    # Topology-aware ICI ordering within each slice (single-slice included —
+    # naive reshape could put logically adjacent mesh neighbors on
+    # physically non-adjacent chips), explicit stacking across slices
+    # (documented create_device_mesh contract — no reliance on
+    # create_hybrid_device_mesh's internal block layout).
     from jax.experimental import mesh_utils
     per_slice_arrays = [
         mesh_utils.create_device_mesh(tuple(ici_sizes),
