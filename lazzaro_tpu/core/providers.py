@@ -221,7 +221,14 @@ class OnDeviceLLM:
 
     def completion_stream(self, messages: List[Dict[str, str]],
                           response_format: Optional[Dict] = None) -> Iterator[str]:
-        yield self.completion(messages, response_format)
+        if response_format and response_format.get("type") == "json_object":
+            # Constrained decoding can't stream piecewise (budget repair may
+            # rewrite the tail); emit the finished document.
+            yield self.completion(messages, response_format)
+            return
+        yield from self.lm.generate_stream(self._render(messages),
+                                           max_new_tokens=self.max_new_tokens,
+                                           temperature=self.temperature)
 
 
 # ---------------------------------------------------------------------------

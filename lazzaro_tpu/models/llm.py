@@ -305,8 +305,10 @@ class LanguageModel:
         self.cfg = cfg or LMConfig.small()
         _check_flash_tensor_parallel(self.cfg, mesh)
         self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
-        self.eos_id = int(getattr(self.tokenizer, "EOS", None)
-                          or getattr(self.tokenizer, "eos_id", ByteTokenizer.EOS))
+        eos = getattr(self.tokenizer, "EOS", None)      # explicit None checks:
+        if eos is None:                                 # an EOS of id 0 is valid
+            eos = getattr(self.tokenizer, "eos_id", None)
+        self.eos_id = int(eos) if eos is not None else ByteTokenizer.EOS
         self.model = Decoder(self.cfg)
         if init_params:
             dummy = jnp.zeros((1, 8), jnp.int32)
@@ -343,6 +345,19 @@ class LanguageModel:
                 f"from_hf supports Gemma-1-family checkpoints (model_type "
                 f"'gemma'), got {hc.model_type!r} — Gemma-2's softcapping/"
                 f"pre-post norms and other families need their own mapping")
+        # Numerics this module hardcodes — reject configs that differ rather
+        # than silently produce wrong logits.
+        if getattr(hc, "attention_bias", False):
+            raise ValueError("attention_bias=True checkpoints unsupported "
+                             "(in-tree attention projections have no bias)")
+        eps = float(getattr(hc, "rms_norm_eps", 1e-6))
+        if abs(eps - 1e-6) > 1e-12:
+            raise ValueError(f"rms_norm_eps {eps} != the hardcoded 1e-6")
+        act = (getattr(hc, "hidden_activation", None)
+               or getattr(hc, "hidden_act", None))
+        if act not in (None, "gelu_pytorch_tanh"):
+            raise ValueError(f"hidden activation {act!r} != the in-tree "
+                             f"tanh-approximate GeLU ('gelu_pytorch_tanh')")
         cfg = LMConfig(
             vocab_size=hc.vocab_size, hidden=hc.hidden_size,
             layers=hc.num_hidden_layers, heads=hc.num_attention_heads,
@@ -402,15 +417,15 @@ class LanguageModel:
         logits, caches = self._prefill(self.params, tokens, positions, caches)
         return max_new_tokens, logits, caches, len(ids)
 
-    def generate(self, prompt: str, max_new_tokens: int = 64,
-                 temperature: float = 0.0, seed: int = 0) -> str:
+    def _token_stream(self, prompt: str, max_new_tokens: int,
+                      temperature: float, seed: int):
+        """The ONE sampling loop: prefill, then sample → yield id → decode
+        step, stopping on EOS or the context limit. Both generate() and
+        generate_stream() consume this, so they can never drift."""
         cfg = self.cfg
         max_new_tokens, logits, caches, pos = self._prep_prompt(
             prompt, max_new_tokens)
-
         key = jax.random.PRNGKey(seed)
-        out_ids = []
-        token = None
         for _ in range(max_new_tokens):
             if temperature > 0:
                 key, sub = jax.random.split(key)
@@ -419,13 +434,54 @@ class LanguageModel:
                 token = jnp.argmax(logits, axis=-1)
             tid = int(token[0])
             if tid == self.eos_id or pos >= cfg.max_seq - 1:
-                break
-            out_ids.append(tid)
+                return
+            yield tid
             logits, caches = self._decode_one(
-                self.params, token.astype(jnp.int32),
+                self.params, jnp.asarray([tid], jnp.int32),
                 jnp.asarray([pos], jnp.int32), caches)
             pos += 1
-        return self.tokenizer.decode(out_ids)
+
+    def generate(self, prompt: str, max_new_tokens: int = 64,
+                 temperature: float = 0.0, seed: int = 0) -> str:
+        ids = list(self._token_stream(prompt, max_new_tokens, temperature, seed))
+        return self.tokenizer.decode(ids)
+
+    def generate_stream(self, prompt: str, max_new_tokens: int = 64,
+                        temperature: float = 0.0, seed: int = 0):
+        """Incremental generation: yields text pieces as tokens decode;
+        the concatenated pieces equal ``generate()``'s output exactly.
+
+        Byte tokenizer: an incremental UTF-8 decoder buffers partial
+        multi-byte sequences and replaces invalid ones just like
+        ``bytes.decode(errors="replace")``. Subword tokenizers: the growing
+        prefix is re-decoded and the text delta yielded (per-token decode
+        would drop sentencepiece's leading-space markers)."""
+        import codecs
+
+        stream = self._token_stream(prompt, max_new_tokens, temperature, seed)
+        if isinstance(self.tokenizer, ByteTokenizer):
+            decoder = codecs.getincrementaldecoder("utf-8")("replace")
+            for tid in stream:
+                if 0 <= tid < 256:
+                    piece = decoder.decode(bytes([tid]))
+                    if piece:
+                        yield piece
+            tail = decoder.decode(b"", final=True)
+            if tail:
+                yield tail
+        else:
+            ids: list = []
+            prev = ""
+            for tid in stream:
+                ids.append(tid)
+                text = self.tokenizer.decode(ids)
+                if len(text) > len(prev) and text.startswith(prev):
+                    yield text[len(prev):]
+                    prev = text
+            # Tokens held back by a non-monotone decode land here.
+            final = self.tokenizer.decode(ids) if ids else ""
+            if len(final) > len(prev) and final.startswith(prev):
+                yield final[len(prev):]
 
     def generate_json(self, prompt: str, max_new_tokens: int = 256,
                       temperature: float = 0.0, seed: int = 0,

@@ -116,3 +116,70 @@ def test_on_device_llm_provider(lm):
     assert isinstance(out, str)
     chunks = list(provider.completion_stream([{"role": "user", "content": "hi"}]))
     assert "".join(chunks) == out
+
+
+def test_generate_stream_matches_generate(lm):
+    """Greedy streaming concatenates to exactly the non-streaming output
+    (incremental UTF-8 replace == whole-sequence replace), across seeds so
+    invalid multi-byte sequences from random weights get exercised."""
+    for seed in range(3):
+        full = lm.generate("stream parity", max_new_tokens=24, seed=seed)
+        pieces = list(lm.generate_stream("stream parity", max_new_tokens=24,
+                                         seed=seed))
+        assert "".join(pieces) == full
+
+
+def test_generate_stream_temperature_matches(lm):
+    full = lm.generate("hot", max_new_tokens=16, temperature=0.9, seed=5)
+    pieces = list(lm.generate_stream("hot", max_new_tokens=16,
+                                     temperature=0.9, seed=5))
+    assert "".join(pieces) == full
+
+
+def test_generate_stream_subword_tokenizer_keeps_whitespace():
+    """Subword decode merges tokens with spaces the per-token decode would
+    drop; the prefix-delta stream must reproduce generate() exactly."""
+    from lazzaro_tpu.models.llm import LanguageModel, LMConfig
+
+    class SPLikeTok:
+        eos_id = 1
+
+        def encode(self, text, add_bos=True, add_eos=False):
+            return [5, 6, 7]
+
+        def decode(self, ids):
+            return " ".join(f"w{i}" for i in ids)   # sentencepiece-ish join
+
+    lm = LanguageModel(LMConfig.tiny(), seed=3, tokenizer=SPLikeTok())
+    assert lm.eos_id == 1
+    full = lm.generate("x", max_new_tokens=6)
+    pieces = list(lm.generate_stream("x", max_new_tokens=6))
+    assert "".join(pieces) == full
+    if full.count("w") > 1:
+        assert " " in full                           # spaces survived
+
+
+def test_eos_id_zero_respected():
+    from lazzaro_tpu.models.llm import LanguageModel, LMConfig
+
+    class EosZeroTok:
+        EOS = 0
+
+        def encode(self, text, add_bos=True, add_eos=False):
+            return [5, 6]
+
+        def decode(self, ids):
+            return "".join(chr(65 + i % 26) for i in ids)
+
+    lm = LanguageModel(LMConfig.tiny(), tokenizer=EosZeroTok())
+    assert lm.eos_id == 0
+
+
+def test_json_stream_yields_complete_document(lm):
+    import json as _json
+    from lazzaro_tpu.core.providers import OnDeviceLLM
+    provider = OnDeviceLLM(lm=lm, max_new_tokens=32)
+    chunks = list(provider.completion_stream(
+        [{"role": "user", "content": "extract"}],
+        response_format={"type": "json_object"}))
+    assert isinstance(_json.loads("".join(chunks)), dict)
