@@ -1,0 +1,23 @@
+"""Quickstart: offline agent memory in six lines.
+
+No API keys, no downloads — the default providers are the on-device hashing
+embedder and the heuristic LLM, so this runs anywhere JAX does (CPU or TPU).
+
+    python examples/01_quickstart.py
+"""
+
+from lazzaro_tpu import MemorySystem
+
+ms = MemorySystem(db_dir="quickstart_db", enable_async=False)
+
+ms.start_conversation()
+print(ms.chat("I work as a data engineer on a big ETL project."))
+print(ms.chat("I love hiking in the mountains on weekends."))
+ms.end_conversation()          # LLM fact extraction → graph consolidation
+
+print("\nRecalled memories:")
+for node in ms.search_memories("what does the user do for work?"):
+    print(f"  [{node.type}] {node.content}  (salience {node.salience:.2f})")
+
+print("\nStats:", ms.get_stats()["index"])
+ms.close()

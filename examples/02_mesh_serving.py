@@ -1,0 +1,38 @@
+"""Pod-scale serving: the SAME orchestrator code, sharded over a mesh.
+
+The arena index row-shards over the mesh 'data' axis; GSPMD partitions every
+kernel (search matmul, scatters, decay, linking) and inserts the collectives.
+Run on real chips, or simulate a pod on CPU:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/02_mesh_serving.py
+"""
+
+import jax
+
+from lazzaro_tpu import MemorySystem
+from lazzaro_tpu.parallel.mesh import make_mesh
+
+n = len(jax.devices())
+mesh = make_mesh(("data",), (n,))
+print(f"mesh: {n} devices on the 'data' axis")
+
+ms = MemorySystem(db_dir="mesh_db", enable_async=False, mesh=mesh)
+ms.start_conversation()
+ms.chat("My research area is sparse retrieval over TPU pods.")
+ms.chat("I maintain a 1M-node memory graph for a fleet of agents.")
+ms.end_conversation()
+
+# Fleet serving: many agents' queries in ONE batched kernel dispatch.
+queries = [
+    "what is the research area?",
+    "how big is the memory graph?",
+    "sparse retrieval pods",
+]
+for q, nodes in zip(queries, ms.search_memories_batch(queries, limit=2)):
+    print(f"\n{q}")
+    for node in nodes:
+        print(f"  → {node.content}")
+
+print("\nindex:", ms.get_stats()["index"])   # note the mesh field
+ms.close()

@@ -1,0 +1,47 @@
+"""Real checkpoints, zero egress: drop local HF weights into the in-tree
+models. This example builds tiny RANDOM torch models in memory (stand-ins
+for files you already have on disk) — swap in your own paths.
+
+    python examples/03_hf_checkpoints.py
+"""
+
+import numpy as np
+import torch
+import transformers
+
+from lazzaro_tpu.models.encoder import TextEncoder
+from lazzaro_tpu.models.llm import LanguageModel
+
+# --- Encoder: a BERT/bge-class checkpoint + its vocab.txt ------------------
+bert_cfg = transformers.BertConfig(
+    vocab_size=100, hidden_size=32, num_hidden_layers=2,
+    num_attention_heads=4, intermediate_size=64, max_position_embeddings=64)
+bert = transformers.BertModel(bert_cfg).eval()
+
+vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "fox", "hello",
+         "world"] + [f"tok{i}" for i in range(91)]
+with open("/tmp/example_vocab.txt", "w") as f:
+    f.write("\n".join(vocab) + "\n")
+
+enc = TextEncoder.from_hf(bert, vocab_file="/tmp/example_vocab.txt", max_len=16)
+vecs = enc.encode_batch(["the quick fox", "hello world"])
+print("encoder vectors:", vecs.shape, "norms:", np.linalg.norm(vecs, axis=1))
+
+# --- Decoder: a Gemma-1-class causal LM ------------------------------------
+gemma_cfg = transformers.GemmaConfig(
+    vocab_size=128, hidden_size=32, intermediate_size=64,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    head_dim=8, max_position_embeddings=64)
+gemma = transformers.GemmaForCausalLM(gemma_cfg).eval()
+
+lm = LanguageModel.from_hf(gemma, max_seq=64)
+ids = np.random.RandomState(0).randint(3, 128, (1, 8))
+print("decoder logits:", lm.model.apply(
+    {"params": lm.params},
+    np.asarray(ids, np.int32),
+    np.arange(8)[None, :].astype(np.int32))[0].shape)
+
+# With a real checkpoint you'd also pass its tokenizer:
+#   tok = transformers.AutoTokenizer.from_pretrained("/path/to/gemma")
+#   lm = LanguageModel.from_hf(gemma, hf_tokenizer=tok)
+#   print(lm.generate("The capital of France is", max_new_tokens=16))

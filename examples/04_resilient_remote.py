@@ -1,0 +1,39 @@
+"""Remote providers with failure detection: circuit breaker + offline
+fallback, instead of the silent ""/zero-vector degradation remote APIs
+usually cause. This example scripts a flaky "remote" provider; swap in
+OpenAILLM/OpenAIEmbedder (same protocols) for the real thing.
+
+    python examples/04_resilient_remote.py
+"""
+
+from lazzaro_tpu import MemorySystem
+from lazzaro_tpu.core.resilience import ResilientEmbedder, ResilientLLM
+
+
+class FlakyRemoteLLM:
+    """Stands in for a remote API that dies mid-session."""
+    def __init__(self):
+        self.calls = 0
+
+    def completion(self, messages, response_format=None):
+        self.calls += 1
+        if self.calls > 2:
+            raise ConnectionError("remote API down")
+        return ""   # reference-style swallowed failure — ALSO detected
+
+
+llm = ResilientLLM(FlakyRemoteLLM(), max_retries=1,
+                   breaker_threshold=3, cooldown=30.0)
+
+ms = MemorySystem(db_dir="resilient_db", enable_async=False,
+                  llm_provider=llm)
+ms.start_conversation()
+print(ms.chat("I collect rare stamps from the 1950s."))
+ms.end_conversation()
+
+print("\nmemories survived the dead API:")
+for node in ms.search_memories("stamps collection"):
+    print("  →", node.content)
+
+print("\nprovider health:", llm.health())
+ms.close()
