@@ -27,6 +27,7 @@ import tempfile
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import ml_dtypes
 
@@ -41,7 +42,12 @@ FORMAT_VERSION = 1
 
 
 def _host(arr) -> Tuple[np.ndarray, str]:
-    """Device array → (numpy array, dtype tag); bf16 bit-cast to uint16."""
+    """Device array → (numpy array, dtype tag); bf16 bit-cast to uint16.
+    Multi-host meshes: shards on non-addressable devices are gathered to
+    every process first (np.asarray alone would raise)."""
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        arr = multihost_utils.process_allgather(arr, tiled=True)
     a = np.asarray(arr)
     if a.dtype == ml_dtypes.bfloat16:
         return a.view(np.uint16), "bfloat16"
@@ -52,6 +58,50 @@ def _device(a: np.ndarray, tag: str):
     if tag == "bfloat16":
         a = a.view(ml_dtypes.bfloat16)
     return jnp.asarray(a)
+
+
+def _write_versioned(ckpt_dir: str, arrays: Dict[str, np.ndarray],
+                     meta: Dict) -> None:
+    """Stage arrays.npz + meta.json into a new version dir, flip CURRENT."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    cur = _read_current(ckpt_dir)
+    next_n = int(cur[1:]) + 1 if cur else 1
+    while os.path.exists(os.path.join(ckpt_dir, f"v{next_n}")):
+        next_n += 1
+    vname = f"v{next_n}"
+    tmp = tempfile.mkdtemp(dir=ckpt_dir, prefix=".stage-")
+    try:
+        np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(ckpt_dir, vname))
+    except BaseException:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    fd, ptr_tmp = tempfile.mkstemp(dir=ckpt_dir, prefix=".cur-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(vname)
+        os.replace(ptr_tmp, _current_path(ckpt_dir))
+    except BaseException:
+        if os.path.exists(ptr_tmp):
+            os.unlink(ptr_tmp)
+        raise
+    import shutil
+    for entry in os.listdir(ckpt_dir):
+        if entry != vname and (entry.startswith("v") or entry.startswith(".stage-")):
+            shutil.rmtree(os.path.join(ckpt_dir, entry), ignore_errors=True)
+
+
+def _read_versioned(ckpt_dir: str):
+    cur = _read_current(ckpt_dir)
+    if cur is None:
+        raise FileNotFoundError(f"no checkpoint at {ckpt_dir} (missing CURRENT)")
+    vdir = os.path.join(ckpt_dir, cur)
+    with open(os.path.join(vdir, "meta.json")) as f:
+        meta = json.load(f)
+    return np.load(os.path.join(vdir, "arrays.npz")), meta
 
 
 def _current_path(ckpt_dir: str) -> str:
@@ -78,67 +128,31 @@ def save_index(index: MemoryIndex, ckpt_dir: str) -> None:
     previous snapshot readable (single-replace semantics, same contract as
     ArrowStore._atomic_write). Superseded version dirs are pruned after the
     flip."""
-    os.makedirs(ckpt_dir, exist_ok=True)
-    cur = _read_current(ckpt_dir)
-    next_n = int(cur[1:]) + 1 if cur else 1
-    # Skip over stranded version dirs from a crashed save (payload landed,
-    # CURRENT never flipped) — os.replace can't overwrite a non-empty dir.
-    while os.path.exists(os.path.join(ckpt_dir, f"v{next_n}")):
-        next_n += 1
-    vname = f"v{next_n}"
-    tmp = tempfile.mkdtemp(dir=ckpt_dir, prefix=".stage-")
-    try:
-        arrays: Dict[str, np.ndarray] = {}
-        dtypes: Dict[str, str] = {}
-        for col in _ARENA_COLS:
-            arrays[f"arena_{col}"], dtypes[f"arena_{col}"] = _host(
-                getattr(index.state, col))
-        for col in _EDGE_COLS:
-            arrays[f"edge_{col}"], dtypes[f"edge_{col}"] = _host(
-                getattr(index.edge_state, col))
-        # id map: two aligned columns instead of a dict (1M-entry JSON dicts
-        # are the slow path this module exists to avoid)
-        ids = list(index.id_to_row.keys())
-        arrays["node_rows"] = np.asarray(
-            [index.id_to_row[i] for i in ids], np.int64)
-        np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
-
-        meta = {
-            "format_version": FORMAT_VERSION,
-            "dim": index.dim,
-            "dtype": "bfloat16" if index.dtype == jnp.bfloat16 else str(
-                np.dtype(index.dtype)),
-            "epoch": index.epoch,
-            "column_dtypes": dtypes,
-            "node_ids": ids,
-            "tenants": index._tenants,
-            "shards": index._shards,
-        }
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f)
-
-        os.replace(tmp, os.path.join(ckpt_dir, vname))
-    except BaseException:
-        import shutil
-        shutil.rmtree(tmp, ignore_errors=True)
-        raise
-
-    # The flip: readers see the old snapshot until this single replace lands.
-    fd, ptr_tmp = tempfile.mkstemp(dir=ckpt_dir, prefix=".cur-")
-    try:
-        with os.fdopen(fd, "w") as f:
-            f.write(vname)
-        os.replace(ptr_tmp, _current_path(ckpt_dir))
-    except BaseException:
-        if os.path.exists(ptr_tmp):
-            os.unlink(ptr_tmp)
-        raise
-
-    # Prune superseded versions (best-effort; debris never affects readers).
-    import shutil
-    for entry in os.listdir(ckpt_dir):
-        if entry != vname and (entry.startswith("v") or entry.startswith(".stage-")):
-            shutil.rmtree(os.path.join(ckpt_dir, entry), ignore_errors=True)
+    arrays: Dict[str, np.ndarray] = {}
+    dtypes: Dict[str, str] = {}
+    for col in _ARENA_COLS:
+        arrays[f"arena_{col}"], dtypes[f"arena_{col}"] = _host(
+            getattr(index.state, col))
+    for col in _EDGE_COLS:
+        arrays[f"edge_{col}"], dtypes[f"edge_{col}"] = _host(
+            getattr(index.edge_state, col))
+    # id map: two aligned columns instead of a dict (1M-entry JSON dicts
+    # are the slow path this module exists to avoid)
+    ids = list(index.id_to_row.keys())
+    arrays["node_rows"] = np.asarray(
+        [index.id_to_row[i] for i in ids], np.int64)
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "dim": index.dim,
+        "dtype": "bfloat16" if index.dtype == jnp.bfloat16 else str(
+            np.dtype(index.dtype)),
+        "epoch": index.epoch,
+        "column_dtypes": dtypes,
+        "node_ids": ids,
+        "tenants": index._tenants,
+        "shards": index._shards,
+    }
+    _write_versioned(ckpt_dir, arrays, meta)
 
 
 def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data") -> MemoryIndex:
@@ -147,15 +161,12 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data") -> MemoryInde
     ``mesh``: restore row-sharded over the mesh axis (the saved total row
     count must divide the axis size — mesh-created indexes guarantee this
     via capacity rounding)."""
-    cur = _read_current(ckpt_dir)
-    if cur is None:
-        raise FileNotFoundError(f"no checkpoint at {ckpt_dir} (missing CURRENT)")
-    vdir = os.path.join(ckpt_dir, cur)
-    with open(os.path.join(vdir, "meta.json")) as f:
-        meta = json.load(f)
+    data, meta = _read_versioned(ckpt_dir)
+    if meta.get("kind") == "sharded":
+        raise ValueError(f"{ckpt_dir} is a sharded-index checkpoint — use "
+                         f"load_sharded_index")
     if meta["format_version"] != FORMAT_VERSION:
         raise ValueError(f"unsupported checkpoint format {meta['format_version']}")
-    data = np.load(os.path.join(vdir, "arrays.npz"))
     dtypes = meta["column_dtypes"]
 
     arena = S.ArenaState(**{
@@ -208,4 +219,75 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data") -> MemoryInde
     index.tenant_nodes = {
         t: set(node_ids[tenant_per_node == tid].tolist())
         for t, tid in index._tenants.items()}
+    return index
+
+
+# ---------------------------------------------------------------------------
+# Pod-sharded index (parallel.index.ShardedMemoryIndex)
+# ---------------------------------------------------------------------------
+
+_SHARDED_COLS = ("emb", "alive", "tenant", "salience")
+
+
+def save_sharded_index(index, ckpt_dir: str) -> None:
+    """Checkpoint a ``ShardedMemoryIndex``: columns are gathered to host
+    (cross-process allgather when the mesh spans hosts) and written under
+    the same versioned-CURRENT layout as ``save_index``."""
+    arrays: Dict[str, np.ndarray] = {}
+    dtypes: Dict[str, str] = {}
+    for col in _SHARDED_COLS:
+        arrays[col], dtypes[col] = _host(getattr(index, col))
+    ids = list(index.id_to_row.keys())
+    arrays["node_rows"] = np.asarray([index.id_to_row[i] for i in ids],
+                                     np.int64)
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "kind": "sharded",
+        "dim": index.dim,
+        "capacity": index.capacity,
+        "axis": index.axis,
+        "tenant_affinity": index.tenant_affinity,
+        "column_dtypes": dtypes,
+        "node_ids": ids,
+        "tenants": index._tenants,
+    }
+    _write_versioned(ckpt_dir, arrays, meta)
+
+
+def load_sharded_index(ckpt_dir: str, mesh, k: int = 10):
+    """Rebuild a ``ShardedMemoryIndex`` on ``mesh`` from ``save_sharded_index``
+    output. The mesh axis size must divide the saved capacity (any mesh whose
+    axis size divides it works — checkpoints are portable across pod shapes)."""
+    from lazzaro_tpu.parallel.index import ShardedMemoryIndex
+
+    data, meta = _read_versioned(ckpt_dir)
+    if meta.get("kind") != "sharded":
+        raise ValueError(f"{ckpt_dir} is not a sharded-index checkpoint")
+    if meta["format_version"] != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint format {meta['format_version']}")
+    dtypes = meta["column_dtypes"]
+
+    dt = (jnp.bfloat16 if dtypes["emb"] == "bfloat16"
+          else jnp.dtype(dtypes["emb"]))
+    index = ShardedMemoryIndex(
+        mesh, dim=meta["dim"], capacity=meta["capacity"],
+        axis=meta["axis"], dtype=dt,
+        tenant_affinity=meta["tenant_affinity"], k=k)
+    import jax
+    for col in _SHARDED_COLS:
+        sharding = index._mat_sh if col == "emb" else index._row_sh
+        setattr(index, col,
+                jax.device_put(_device(data[col], dtypes[col]), sharding))
+
+    node_rows = data["node_rows"].astype(np.int64)
+    node_ids = np.asarray(meta["node_ids"], object)
+    index.id_to_row = dict(zip(node_ids.tolist(), node_rows.tolist()))
+    index.row_to_id = dict(zip(node_rows.tolist(), node_ids.tolist()))
+    index._tenants = {t: int(v) for t, v in meta["tenants"].items()}
+    # Per-partition free lists via vectorized set-difference (descending
+    # within each — no per-row Python at 1M-capacity scale).
+    index._free = [
+        np.setdiff1d(np.arange(p * index.part_rows, (p + 1) * index.part_rows,
+                               dtype=np.int64), node_rows)[::-1].tolist()
+        for p in range(index.n_parts)]
     return index

@@ -1,0 +1,90 @@
+"""ShardedMemoryIndex checkpoints: round trip + pod-shape portability."""
+
+import jax
+import numpy as np
+import pytest
+
+from lazzaro_tpu.core.checkpoint import (load_index, load_sharded_index,
+                                         save_sharded_index)
+from lazzaro_tpu.parallel.index import ShardedMemoryIndex
+from lazzaro_tpu.parallel.mesh import make_mesh
+
+
+def _mesh(n):
+    return make_mesh(("data",), (n,), devices=jax.devices()[:n])
+
+
+def _filled(mesh, n=24, d=16, capacity=64):
+    idx = ShardedMemoryIndex(mesh, dim=d, capacity=capacity, k=5)
+    rng = np.random.RandomState(0)
+    emb = rng.randn(n, d).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    idx.add([f"n{i}" for i in range(n)], emb, "default",
+            saliences=[0.4 + 0.01 * i for i in range(n)])
+    idx.add(["a0", "a1"], rng.randn(2, d).astype(np.float32), "alice")
+    return idx, emb
+
+
+def test_round_trip_same_mesh(tmp_path):
+    mesh = _mesh(8)
+    idx, emb = _filled(mesh)
+    ck = str(tmp_path / "ck")
+    save_sharded_index(idx, ck)
+    idx2 = load_sharded_index(ck, mesh, k=5)
+
+    assert idx2.id_to_row == idx.id_to_row
+    assert idx2._tenants == idx._tenants
+    for q in emb[:5]:
+        assert idx2.search(q, "default") == idx.search(q, "default")
+    # Tenant isolation survives.
+    ids_a, _ = idx2.search(emb[0], "alice")
+    assert all(i.startswith("a") for i in ids_a)
+
+
+def test_portable_across_pod_shapes(tmp_path):
+    """A checkpoint from an 8-way mesh restores onto a 4-way mesh (axis
+    size divides the saved capacity) with identical results."""
+    idx, emb = _filled(_mesh(8), capacity=64)
+    ck = str(tmp_path / "ck")
+    save_sharded_index(idx, ck)
+    idx2 = load_sharded_index(ck, _mesh(4), k=5)
+    assert idx2.n_parts == 4
+    for q in emb[:5]:
+        assert idx2.search(q, "default") == idx.search(q, "default")
+
+
+def test_restored_index_keeps_working(tmp_path):
+    mesh = _mesh(8)
+    idx, emb = _filled(mesh)
+    ck = str(tmp_path / "ck")
+    save_sharded_index(idx, ck)
+    idx2 = load_sharded_index(ck, mesh, k=5)
+
+    idx2.delete(["n0"])
+    assert "n0" not in idx2.id_to_row
+    rng = np.random.RandomState(7)
+    fresh = rng.randn(3, 16).astype(np.float32)
+    idx2.add(["x0", "x1", "x2"], fresh, "default")
+    ids, _ = idx2.search(fresh[0], "default")
+    assert ids[0] == "x0"
+    idx2.decay("default", 0.01)
+
+
+def test_kind_mismatch_rejected(tmp_path):
+    from lazzaro_tpu.core.checkpoint import save_index
+    from lazzaro_tpu.core.index import MemoryIndex
+
+    plain = MemoryIndex(dim=8, capacity=16, edge_capacity=8)
+    ck = str(tmp_path / "plain_ck")
+    save_index(plain, ck)
+    with pytest.raises(ValueError, match="sharded"):
+        load_sharded_index(ck, _mesh(8))
+    # And the plain loader still reads plain checkpoints (helper refactor).
+    assert load_index(ck).capacity == 16
+
+    # Symmetric guard: plain loader rejects sharded checkpoints loudly.
+    idx, _ = _filled(_mesh(8))
+    sck = str(tmp_path / "sharded_ck")
+    save_sharded_index(idx, sck)
+    with pytest.raises(ValueError, match="load_sharded_index"):
+        load_index(sck)
