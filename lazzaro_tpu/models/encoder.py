@@ -262,6 +262,46 @@ class TextEncoder:
         return self.encode_batch([text])[0]
 
 
+def make_encoder_train_step(cfg: EncoderConfig, optimizer,
+                            mesh=None, temperature: float = 0.05):
+    """In-batch-negatives InfoNCE train step (the standard bge/SimCSE
+    recipe): a batch of (query, positive) token-id pairs; each query's
+    positive is the diagonal, every other row is a negative. Returns
+    ``step(params, opt_state, q_ids, p_ids) -> (params, opt_state, loss)``,
+    jitted, with batch data-parallelism over the mesh 'data' axis when one
+    is given. Lets users fine-tune the retrieval encoder on their own
+    memory corpus — a capability the reference cannot have (its embedders
+    are remote APIs, providers.py:36-57)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cls = BertEncoder if cfg.arch == "bert" else Encoder
+    model = cls(cfg)
+
+    def loss_fn(params, q_ids, p_ids):
+        q = model.apply(params, q_ids)        # [B, H], L2-normalized
+        p = model.apply(params, p_ids)
+        logits = (q @ p.T) / temperature      # [B, B]
+        labels = jnp.arange(q.shape[0])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        # Symmetric: query→passage and passage→query.
+        loss_qp = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+        logp_t = jax.nn.log_softmax(logits.T, axis=-1)
+        loss_pq = -jnp.take_along_axis(logp_t, labels[:, None], axis=-1).mean()
+        return (loss_qp + loss_pq) / 2
+
+    def step(params, opt_state, q_ids, p_ids):
+        if mesh is not None:
+            sh = NamedSharding(mesh, P("data", None))
+            q_ids = jax.lax.with_sharding_constraint(q_ids, sh)
+            p_ids = jax.lax.with_sharding_constraint(p_ids, sh)
+        loss, grads = jax.value_and_grad(loss_fn)(params, q_ids, p_ids)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda a, u: a + u, params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
 class HFTokenizerAdapter:
     """Duck-types ``batch_encode`` over a HuggingFace tokenizer so a real
     WordPiece vocab can drive ``TextEncoder`` (``from_hf``)."""
