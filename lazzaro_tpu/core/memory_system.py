@@ -26,6 +26,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from lazzaro_tpu.config import MemoryConfig
@@ -110,9 +111,10 @@ class MemorySystem:
         self.profile = Profile()
         self.mesh = mesh
         self.index = MemoryIndex(dim, capacity=cfg.initial_capacity,
-                                 edge_capacity=cfg.max_edges, mesh=mesh)
+                                 edge_capacity=cfg.max_edges,
+                                 dtype=jnp.dtype(cfg.dtype), mesh=mesh)
 
-        self.query_cache = QueryCache(cfg.cache_size) if enable_caching else None
+        self.query_cache = QueryCache(cfg.cache_size) if self.enable_caching else None
 
         self.short_term_memory: List[Dict] = []
         self.conversation_history: List[Dict] = []
@@ -125,7 +127,7 @@ class MemorySystem:
         # Single-writer ingest: one worker thread + one mutation lock.
         self._mutex = threading.RLock()
         self.background_executor = (ThreadPoolExecutor(max_workers=1)
-                                    if enable_async else None)
+                                    if self.enable_async else None)
 
         self.metrics = {
             "embedding_calls": 0,

@@ -64,3 +64,15 @@ def test_memory_system_init_flags(tmp_db):
     assert ms.max_buffer_size == 7
     assert ms.vector_store is ms.store  # back-compat alias
     ms.close()
+
+
+def test_default_construction_enables_cache_and_async(tmp_db):
+    from lazzaro_tpu import MemorySystem
+    ms = MemorySystem(db_dir=tmp_db, load_from_disk=False, verbose=False)
+    try:
+        # config defaults say caching+async are on; the constructor must
+        # honor them when the kwargs are left at None
+        assert ms.query_cache is not None
+        assert ms.background_executor is not None
+    finally:
+        ms.close()

@@ -1,0 +1,67 @@
+"""MemoryConfig.dtype reaches the arena: an orchestrator built with
+dtype="bfloat16" keeps search / dedup / snapshot semantics intact while the
+device embedding matrix is actually bf16 (half the HBM of the f32 default —
+the knob the 1M-node target depends on)."""
+
+import jax.numpy as jnp
+import pytest
+
+from lazzaro_tpu import MemorySystem
+from lazzaro_tpu.config import MemoryConfig
+
+from tests.fakes import MockEmbedder, MockLLM, extraction_response
+
+FACT = {"content": "User loves the Python programming language",
+        "type": "semantic", "salience": 0.9, "topic": "learning"}
+
+
+@pytest.fixture()
+def ms(tmp_db):
+    llm = MockLLM(sniffers={
+        "Extract distinct, atomic facts": extraction_response([FACT]),
+    }, response="chat reply")
+    system = MemorySystem(
+        enable_async=False,
+        auto_consolidate=False,
+        load_from_disk=False,
+        db_dir=tmp_db,
+        llm_provider=llm,
+        embedding_provider=MockEmbedder(),
+        config=MemoryConfig(dtype="bfloat16"),
+        verbose=False,
+    )
+    yield system
+    system.close()
+
+
+def test_arena_is_bf16(ms):
+    assert ms.index.state.emb.dtype == jnp.bfloat16
+
+
+def test_search_and_dedup_semantics_survive_bf16(ms):
+    ms.start_conversation()
+    ms.add_to_short_term("I really love Python!", "episodic", 0.7)
+    ms.end_conversation()
+
+    # duplicate conversation: the 0.95 dedup gate must still merge in bf16
+    ms.start_conversation()
+    ms.add_to_short_term("Did I mention I love Python?", "episodic", 0.7)
+    ms.end_conversation()
+
+    nodes, _ = ms.buffer.size()
+    assert nodes == 1
+
+    hits = ms.search_memories("python")
+    assert [n.content for n in hits] == [FACT["content"]]
+
+
+def test_bf16_snapshot_roundtrip(ms, tmp_path):
+    ms.start_conversation()
+    ms.add_to_short_term("I really love Python!", "episodic", 0.7)
+    ms.end_conversation()
+    snap = str(tmp_path / "snap")
+    ms.save_snapshot(snap)
+
+    ms.load_snapshot(snap)
+    assert ms.index.state.emb.dtype == jnp.bfloat16
+    assert [n.content for n in ms.search_memories("python")] == [FACT["content"]]
