@@ -272,6 +272,29 @@ class MemoryIndex:
             jnp.float32((now if now is not None else time.time()) - self.epoch),
             jnp.float32(boost))
 
+    def restore_access(self, ids: Sequence[str], access_counts: Sequence[int],
+                       last_accessed: Sequence[float]) -> None:
+        """Put persisted access history back onto freshly-added arena rows
+        (``add`` zeroes it for new inserts)."""
+        rows, acs, las = [], [], []
+        for i, ac, la in zip(ids, access_counts, last_accessed):
+            r = self.id_to_row.get(i)
+            if r is not None:
+                rows.append(r)
+                acs.append(int(ac))
+                las.append(float(la) - self.epoch)
+        if not rows:
+            return
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
+        b = len(padded)
+        ac_arr = np.zeros((b,), np.int32)
+        ac_arr[:len(acs)] = acs
+        la_arr = np.zeros((b,), np.float32)
+        la_arr[:len(las)] = las
+        self.state = S.arena_restore_access(
+            self.state, jnp.asarray(padded), jnp.asarray(ac_arr),
+            jnp.asarray(la_arr))
+
     def merge_touch(self, ids: Sequence[str], candidate_saliences: Sequence[float],
                     now: Optional[float] = None) -> None:
         """Dedup-merge: salience=max(old, candidate), access+1, refresh."""
@@ -395,6 +418,29 @@ class MemoryIndex:
             "access_count": np.asarray(self.state.access_count),
         }
 
+    def pull_numeric_rows(self, rows: Sequence[int]) -> Dict[str, np.ndarray]:
+        """Selective variant of ``pull_numeric``: gather only the given arena
+        rows (the incremental-persistence path syncs dirty rows, not the
+        whole 1M-row arena)."""
+        r = jnp.asarray(np.asarray(rows, np.int32))
+        return {
+            "salience": np.asarray(self.state.salience[r]),
+            "last_accessed": np.asarray(self.state.last_accessed[r]) + self.epoch,
+            "access_count": np.asarray(self.state.access_count[r]),
+        }
+
+    def edge_weights_for(self, keys: Sequence[Tuple[str, str]]
+                         ) -> Dict[Tuple[str, str], Tuple[float, int]]:
+        """Selective variant of ``edge_weights``: (weight, co) for the given
+        edge keys only — one small device gather instead of an O(E) pull."""
+        present = [(k, self.edge_slots[k]) for k in keys if k in self.edge_slots]
+        if not present:
+            return {}
+        slots = jnp.asarray(np.asarray([s for _, s in present], np.int32))
+        w = np.asarray(self.edge_state.weight[slots])
+        co = np.asarray(self.edge_state.co[slots])
+        return {k: (float(w[i]), int(co[i])) for i, (k, _) in enumerate(present)}
+
     # ---------------------------------------------------------------- edges
     def _alloc_edge_slots(self, n: int) -> List[int]:
         while len(self._free_edge_slots) < n:
@@ -407,22 +453,23 @@ class MemoryIndex:
     def add_edges(self, triples: Sequence[Tuple[str, str, float]], tenant: str,
                   reinforce: float = 0.1, now: Optional[float] = None) -> None:
         """(src_id, tgt_id, weight) batch. Existing edges are reinforced
-        (+0.1 capped, co+1); new ones inserted."""
+        (+0.1 capped, co+1); new ones inserted. A key repeated WITHIN the
+        batch inserts once then reinforces (the scatter accumulates duplicate
+        slots), matching what sequential singleton calls would do."""
         now = (now if now is not None else time.time()) - self.epoch
         new, existing = [], []
+        pending = set()
         for src, tgt, w in triples:
             if src not in self.id_to_row or tgt not in self.id_to_row:
                 continue
             key = (src, tgt)
             if key in self.edge_slots:
                 existing.append(self.edge_slots[key])
+            elif key in pending:
+                existing.append(key)        # slot resolved after the insert
             else:
+                pending.add(key)
                 new.append((key, w))
-        if existing:
-            padded = S.pad_rows(np.asarray(existing, np.int32), self.edge_state.capacity)
-            self.edge_state = S.edges_reinforce(
-                self.edge_state, jnp.asarray(padded),
-                jnp.float32(reinforce), jnp.float32(now))
         if new:
             slots = self._alloc_edge_slots(len(new))
             for (key, _), slot in zip(new, slots):
@@ -444,6 +491,13 @@ class MemoryIndex:
                 jnp.asarray(tgt_r), jnp.asarray(w),
                 jnp.ones((b,), jnp.int32), jnp.float32(now),
                 jnp.int32(self.tenant_id(tenant)), jnp.asarray(live))
+        if existing:
+            slots = [self.edge_slots[s] if isinstance(s, tuple) else s
+                     for s in existing]
+            padded = S.pad_rows(np.asarray(slots, np.int32), self.edge_state.capacity)
+            self.edge_state = S.edges_reinforce(
+                self.edge_state, jnp.asarray(padded),
+                jnp.float32(reinforce), jnp.float32(now))
 
     def prune_edges(self, tenant: str, threshold: float) -> List[Tuple[str, str]]:
         tid = self._tenants.get(tenant)

@@ -41,6 +41,11 @@ from lazzaro_tpu.models.graph import Edge, Node
 
 
 class MemorySystem:
+    # Above this many arena rows, per-conversation host syncs become
+    # selective (dirty rows only) and the full sweep is reserved for
+    # explicit display/export/snapshot surfaces.
+    _SYNC_FULL_MAX = 20_000
+
     def __init__(
         self,
         enable_sharding: Optional[bool] = None,
@@ -123,6 +128,23 @@ class MemorySystem:
         self.node_counter = 0
         self.consolidation_queue: List[Dict] = []
         self._inflight_batches: List[Dict] = []   # popped but not yet durable
+
+        # Incremental persistence state. Mutation paths record which node
+        # ids / edge keys changed since the last save; saves then upsert only
+        # those rows as delta segments instead of rewriting the user's whole
+        # table (the reference rewrites everything per conversation,
+        # memory_system.py:1275-1302). Uniform decay is never written
+        # per-row: ``_decay_pass`` counts sweeps, rows are stamped with the
+        # pass they were written at, and loads replay the difference in
+        # closed form (s' = floor + (s-floor)(1-rate)^k).
+        self._supports_incremental = (
+            hasattr(self.store, "save_sys_meta")
+            and hasattr(self.store, "get_nodes_columns"))
+        self._store_synced = False     # False ⇒ next save does a full rewrite
+        self._decay_pass = 0
+        self._dirty_nodes: Set[str] = set()
+        self._dirty_edges: Set[Tuple[str, str]] = set()
+        self._deleted_edge_ids: Set[str] = set()
 
         # Single-writer ingest: one worker thread + one mutation lock.
         self._mutex = threading.RLock()
@@ -281,9 +303,38 @@ class MemorySystem:
             [node.shard_key or "default"], self.user_id,
             [node.is_super_node])
 
-    def _sync_from_arena(self) -> None:
-        """One bulk device→host pull; refresh mutable numerics on host nodes
-        and edges so the structural record matches the arena."""
+    def _sync_from_arena(self, node_ids: Optional[Set[str]] = None,
+                         edge_keys: Optional[Set[Tuple[str, str]]] = None) -> None:
+        """Refresh mutable numerics on host nodes/edges from the arena.
+
+        With no arguments this is the full bulk pull (display/export/JSON
+        snapshot surfaces want every host copy fresh). With ``node_ids`` /
+        ``edge_keys`` it gathers just those rows — the incremental save path
+        at 1M-node scale, where a full host sweep per conversation would
+        dominate the save."""
+        if node_ids is not None:
+            pairs = []
+            for nid in node_ids:
+                row = self.index.id_to_row.get(self._q(nid))
+                if row is not None:
+                    pairs.append((nid, row))
+            if pairs:
+                cols = self.index.pull_numeric_rows([r for _, r in pairs])
+                for i, (nid, _row) in enumerate(pairs):
+                    node = self.buffer.get_node(nid)
+                    if node is None:
+                        continue
+                    node.salience = float(cols["salience"][i])
+                    node.last_accessed = float(cols["last_accessed"][i])
+                    node.access_count = int(cols["access_count"][i])
+            keys = {(self._q(s), self._q(t)) for s, t in (edge_keys or set())}
+            for (qsrc, qtgt), (w, co) in self.index.edge_weights_for(sorted(keys)).items():
+                edge = self._find_edge((qsrc.partition(":")[2],
+                                        qtgt.partition(":")[2]))
+                if edge is not None:
+                    edge.weight = w
+                    edge.co_occurrence = co
+            return
         cols = self.index.pull_numeric()
         for qid, row in self.index.id_to_row.items():
             user, _, nid = qid.partition(":")
@@ -306,6 +357,33 @@ class MemorySystem:
                     edge.weight = w
                     edge.co_occurrence = co
                     break
+
+    # ------------------------------------------------------- dirty tracking
+    def _mark_dirty(self, *node_ids: str) -> None:
+        self._dirty_nodes.update(node_ids)
+
+    def _mark_edge_dirty(self, key: Tuple[str, str]) -> None:
+        # Delete-then-recreate within one interval needs no tombstone
+        # cancellation: the save flushes tombstones BEFORE upserts, so the
+        # re-created row wins, while a pruned edge of a *different*
+        # edge_type on the same key stays deleted.
+        self._dirty_edges.add(key)
+
+    def _find_edge(self, key: Tuple[str, str]) -> Optional[Edge]:
+        for shard in self.shards.values():
+            edge = shard.edges.get(key)
+            if edge is not None:
+                return edge
+        return None
+
+    @staticmethod
+    def _store_edge_id(edge: Edge) -> str:
+        """Matches ArrowStore's derived edge id (src|tgt|type)."""
+        return f"{edge.source}|{edge.target}|{edge.edge_type}"
+
+    def _mark_edge_deleted(self, edge: Edge) -> None:
+        self._deleted_edge_ids.add(self._store_edge_id(edge))
+        self._dirty_edges.discard((edge.source, edge.target))
 
     # --------------------------------------------------------------- session
     def start_conversation(self) -> str:
@@ -379,11 +457,17 @@ class MemorySystem:
         with self._mutex:
             self.index.decay(self.user_id, self.config.decay_rate,
                              self.config.salience_floor)
+            self._decay_pass += 1
             if self.auto_prune:
                 pruned = self._prune_weak_edges(self.prune_threshold)
                 if pruned > 0:
                     results.append(f"✓ Auto-pruned {pruned} weak edges")
-            self._sync_from_arena()
+            # Small graphs keep every host copy exactly fresh (parity
+            # surfaces read node.salience directly); at scale the dirty rows
+            # are synced inside the save itself and clean rows are
+            # reconstructed on load by the closed-form decay replay.
+            if len(self.index) <= self._SYNC_FULL_MAX:
+                self._sync_from_arena()
         results.append("✓ Applied temporal decay")
 
         self._enforce_buffer_limit()
@@ -391,7 +475,7 @@ class MemorySystem:
 
         if self.auto_consolidate and self.conversation_count % self.consolidate_every == 0:
             self._log(f"🔄 Auto-consolidation triggered (every {self.consolidate_every} conversations)...")
-            results.append(self.run_consolidation())
+            results.append(self.run_consolidation(persist=False))
 
         self.short_term_memory = []
         self.conversation_history = []
@@ -406,7 +490,9 @@ class MemorySystem:
             src = qsrc.partition(":")[2]
             tgt = qtgt.partition(":")[2]
             for shard in self.shards.values():
-                if (src, tgt) in shard.edges:
+                edge = shard.edges.get((src, tgt))
+                if edge is not None:
+                    self._mark_edge_deleted(edge)
                     del shard.edges[(src, tgt)]
                     count += 1
                     break
@@ -500,6 +586,7 @@ class MemorySystem:
                     self.index.update_access(
                         [self._q(n) for n in access_ids],
                         boost=self.config.access_salience_boost)
+                    self._mark_dirty(*access_ids)
                 for nid in access_ids:
                     self.buffer.update_access(nid, self.config.access_salience_boost)
             if memory_texts:
@@ -582,6 +669,7 @@ class MemorySystem:
         with self._mutex:
             self.index.boost([self._q(n) for n in to_boost],
                              self.config.neighbor_salience_boost, now)
+            self._mark_dirty(*to_boost)
         count = 0
         for nid in to_boost:
             node = self.buffer.get_node(nid)
@@ -653,8 +741,13 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         embeddings = self._batch_embed(contents)
 
         with self._mutex:
-            new_nodes: List[Tuple[str, str]] = []
-            new_nodes_data: List[Dict] = []
+            # Stage valid facts, then resolve near-duplicates with two
+            # batched similarity ops instead of one device probe per fact:
+            # (a) ONE arena top-1 search for the whole batch (pre-batch
+            #     graph — the same visibility the reference's LanceDB probe
+            #     has, since its batch insert also lands after the loop);
+            # (b) one host gram matrix for duplicates WITHIN the batch.
+            staged: List[Tuple[Dict, str, np.ndarray]] = []
             ei = 0
             for mem in memories:
                 content = mem.get("content", "")
@@ -664,51 +757,110 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 ei += 1
                 if len(content) < 5:
                     continue
+                staged.append((mem, content, np.asarray(new_emb, np.float32)))
 
+            probe: List[Tuple[Optional[str], float]] = [(None, 0.0)] * len(staged)
+            probeable = [i for i, (_, _, e) in enumerate(staged)
+                         if e.size == self.embed_dim]
+            if probeable:
+                qs = np.stack([staged[i][2] for i in probeable])
+                res = self.index.search_batch(qs, self.user_id, k=1,
+                                              super_filter=-1)
+                for i, (ids, scores) in zip(probeable, res):
+                    if ids:
+                        probe[i] = (ids[0].partition(":")[2], scores[0])
+            intra = None
+            if len(probeable) >= 2:
+                M = np.stack([staged[i][2] for i in probeable])
+                norms = np.linalg.norm(M, axis=1, keepdims=True)
+                norms[norms == 0] = 1.0
+                M = M / norms
+                intra = M @ M.T
+            pos_in_probeable = {i: j for j, i in enumerate(probeable)}
+
+            new_nodes: List[Tuple[str, str]] = []
+            new_nodes_data: List[Dict] = []
+            created: List[Node] = []
+            created_embs: List[np.ndarray] = []
+            merge_ids: List[str] = []
+            merge_sals: List[float] = []
+            fact_target: List[Optional[str]] = []  # node id each fact resolved to
+            for fi, (mem, content, new_emb) in enumerate(staged):
                 shard_key = mem.get("topic") or self._infer_shard_key(content)
                 if shard_key == "other":
                     shard_key = self._infer_shard_key(content)
                 shard = self._get_or_create_shard(shard_key)
 
-                # Dedup probe: nearest neighbor, cosine > 0.95 ⇒ merge
-                existing_node = None
-                if len(new_emb):
-                    ids, scores = self.index.search(
-                        np.asarray(new_emb, np.float32), self.user_id, k=1,
-                        super_filter=-1)
-                    if ids and scores[0] > self.config.dedup_similarity:
-                        existing_node = self.buffer.get_node(ids[0].partition(":")[2])
+                # Best match: pre-batch arena probe vs earlier-in-batch fact.
+                target_id, best = probe[fi]
+                if intra is not None and fi in pos_in_probeable:
+                    row = pos_in_probeable[fi]
+                    for col in range(row):
+                        t = fact_target[probeable[col]]
+                        sim = float(intra[row, col])
+                        if t is not None and sim > best:
+                            target_id, best = t, sim
+                existing_node = (self.buffer.get_node(target_id)
+                                 if target_id is not None
+                                 and best > self.config.dedup_similarity
+                                 else None)
 
                 if existing_node is not None:
                     cand_sal = float(mem.get("salience", 0.5))
-                    self.index.merge_touch([self._q(existing_node.id)], [cand_sal])
                     existing_node.salience = max(existing_node.salience, cand_sal)
                     existing_node.last_accessed = time.time()
                     existing_node.access_count += 1
+                    merge_ids.append(existing_node.id)
+                    merge_sals.append(cand_sal)
+                    self._mark_dirty(existing_node.id)
+                    fact_target.append(existing_node.id)
                     self._log(f"   (Merged semantic duplicate into {existing_node.id})")
                     continue
 
                 node_id = self._generate_node_id()
+                # The arena owns the vector (embedding=None on the host);
+                # keeping a Python float-list per node is what made 1M-node
+                # host graphs impossible. Persistence gathers on demand.
                 node = Node(
                     id=node_id,
                     content=content,
-                    embedding=new_emb,
+                    embedding=None,
                     type=mem.get("type", "semantic"),
                     salience=float(mem.get("salience", 0.5)),
                     shard_key=shard_key,
                 )
                 shard.add_node(node)
-                self._index_add_node(node)
+                created.append(node)
+                created_embs.append(new_emb)
+                fact_target.append(node_id)
                 new_nodes.append((node_id, shard_key))
                 new_nodes_data.append({
                     "id": node_id,
                     "content": content,
-                    "embedding": list(map(float, new_emb)),
+                    "embedding": [float(x) for x in new_emb],
                     "type": node.type,
                     "salience": node.salience,
                     "shard_key": node.shard_key,
                     "timestamp": node.timestamp,
+                    "decay_pass": self._decay_pass,
                 })
+
+            # ONE arena scatter for every new node, ONE touch for all merges.
+            arena_new = [(n, e) for n, e in zip(created, created_embs)
+                         if e.size == self.embed_dim]
+            if arena_new:
+                self.index.add(
+                    [self._q(n.id) for n, _ in arena_new],
+                    np.stack([e for _, e in arena_new]),
+                    [n.salience for n, _ in arena_new],
+                    [n.timestamp for n, _ in arena_new],
+                    [n.type for n, _ in arena_new],
+                    [n.shard_key or "default" for n, _ in arena_new],
+                    self.user_id,
+                    [n.is_super_node for n, _ in arena_new])
+            if merge_ids:
+                self.index.merge_touch([self._q(i) for i in merge_ids],
+                                       merge_sals)
 
             if new_nodes_data:
                 self.store.add_nodes(new_nodes_data, user_id=self.user_id)
@@ -750,16 +902,28 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
 
     def _add_edge(self, edge: Edge) -> None:
         """Insert into both the host shard record and the edge arena."""
-        shard = None
-        for s in self.shards.values():
-            if edge.source in s.nodes:
-                shard = s
-                break
-        if shard is None:
-            shard = self._get_or_create_shard("default")
-        shard.add_edge(edge, reinforce=self.config.edge_reinforce)
-        self.index.add_edges([(self._q(edge.source), self._q(edge.target), edge.weight)],
-                             self.user_id, reinforce=self.config.edge_reinforce)
+        self._add_edges_batch([edge])
+
+    def _add_edges_batch(self, edges: List[Edge]) -> None:
+        """Host bookkeeping per edge + ONE device scatter for the whole batch
+        (a consolidation creates O(new_facts) links; per-edge dispatches are
+        what made the reference's ingest loop host-bound)."""
+        if not edges:
+            return
+        triples = []
+        for edge in edges:
+            shard = None
+            for s in self.shards.values():
+                if edge.source in s.nodes:
+                    shard = s
+                    break
+            if shard is None:
+                shard = self._get_or_create_shard("default")
+            shard.add_edge(edge, reinforce=self.config.edge_reinforce)
+            triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
+            self._mark_edge_dirty((edge.source, edge.target))
+        self.index.add_edges(triples, self.user_id,
+                             reinforce=self.config.edge_reinforce)
 
     def _link_within_shards(self, new_nodes: List[Tuple[str, str]]) -> None:
         """Chain consecutive new nodes (w=0.5) + top-3 same-shard cosine>0.5
@@ -769,14 +933,16 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         for node_id, shard_key in new_nodes:
             by_shard.setdefault(shard_key, []).append(node_id)
 
+        batch: List[Edge] = []
         for shard_key, node_ids in by_shard.items():
             if len(node_ids) >= 2:
                 for a, b in zip(node_ids, node_ids[1:]):
-                    self._add_edge(Edge(source=a, target=b,
-                                        weight=self.config.chain_link_weight))
+                    batch.append(Edge(source=a, target=b,
+                                      weight=self.config.chain_link_weight))
 
         all_new = [nid for nid, _ in new_nodes]
         if not all_new:
+            self._add_edges_batch(batch)
             return
         cands = self.index.link_candidates(
             [self._q(n) for n in all_new], self.user_id,
@@ -785,9 +951,10 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             nid = qid.partition(":")[2]
             for qcand, sim in pairs:
                 if sim > self.config.link_gate:
-                    self._add_edge(Edge(source=nid,
-                                        target=qcand.partition(":")[2],
-                                        weight=sim * self.config.link_weight_scale))
+                    batch.append(Edge(source=nid,
+                                      target=qcand.partition(":")[2],
+                                      weight=sim * self.config.link_weight_scale))
+        self._add_edges_batch(batch)
 
     def _link_to_existing_memories(self, new_nodes: List[Tuple[str, str]]) -> None:
         """Top-3 cross-links across ALL existing memories (any shard), gate
@@ -798,19 +965,23 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         cands = self.index.link_candidates(
             [self._q(n) for n, _ in new_nodes], self.user_id,
             k=self.config.cross_link_top_k, shard_mode=0)
-        links_created = 0
+        batch: List[Edge] = []
+        staged: Set[Tuple[str, str]] = set()
         for qid, pairs in cands.items():
             nid = qid.partition(":")[2]
             for qcand, sim in pairs:
                 if sim <= self.config.link_gate:
                     continue
                 cand = qcand.partition(":")[2]
-                exists = any((nid, cand) in s.edges or (cand, nid) in s.edges
-                             for s in self.shards.values())
+                exists = ((nid, cand) in staged or (cand, nid) in staged
+                          or any((nid, cand) in s.edges or (cand, nid) in s.edges
+                                 for s in self.shards.values()))
                 if not exists:
-                    self._add_edge(Edge(source=nid, target=cand,
-                                        weight=sim * self.config.link_weight_scale))
-                    links_created += 1
+                    batch.append(Edge(source=nid, target=cand,
+                                      weight=sim * self.config.link_weight_scale))
+                    staged.add((nid, cand))
+        self._add_edges_batch(batch)
+        links_created = len(batch)
         if links_created:
             self._log(f"✓ Created {links_created} cross-conversation links")
 
@@ -843,6 +1014,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             node.parent_id = super_id
         self.super_nodes[super_id] = super_node
         self._index_add_node(super_node)
+        self._mark_dirty(super_id, *(n.id for n in nodes))
         self._log(f"  ✓ Created super-node {super_id} with {len(nodes)} children")
 
     # -------------------------------------------------------------- forgetting
@@ -868,8 +1040,10 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                     for s in self.shards.values():
                         for key in [k for k in s.edges
                                     if k[0] == nid or k[1] == nid]:
+                            self._mark_edge_deleted(s.edges[key])
                             del s.edges[key]
                     removed_ids.append(nid)
+                    self._dirty_nodes.discard(nid)
             if removed_ids:
                 self.index.delete([self._q(n) for n in removed_ids])
                 self.store.delete_nodes(removed_ids, user_id=self.user_id)
@@ -880,7 +1054,8 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
 
     # ------------------------------------------------------ deep consolidation
     def run_consolidation(self, weight_threshold: float = 0.6,
-                          merge_similar: bool = True) -> str:
+                          merge_similar: bool = True,
+                          persist: bool = True) -> str:
         results = []
         self._log("🔄 Running consolidation...")
 
@@ -922,6 +1097,11 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
 
         if not results:
             results.append("✓ No consolidation actions needed")
+        elif persist:
+            # Standalone callers (CLI /consolidate, dashboard POST) get the
+            # merged rows and profile updates made durable immediately; the
+            # end_conversation path saves right after and passes persist=False.
+            self._save_to_persistence()
         return "\n".join(results)
 
     def _extract_profile_from_component(self, component: Set[str]) -> str:
@@ -1006,30 +1186,28 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                             rewires.append(((src, tgt), (src, keep_id)))
                     for old_key, new_key in rewires:
                         edge = shard.edges.pop(old_key)
+                        self._mark_edge_deleted(edge)
                         edge.source, edge.target = new_key
                         if new_key[0] != new_key[1]:
                             shard.edges[new_key] = edge
                             self.index.add_edges(
                                 [(self._q(new_key[0]), self._q(new_key[1]), edge.weight)],
                                 self.user_id)
+                            self._mark_edge_dirty(new_key)
                     if merge_id in shard.nodes:
                         del shard.nodes[merge_id]
 
                 self.index.merge_touch([qkeep], [node1.salience])
                 self.index.delete([qmerge])
                 absorbed.add(merge_id)
+                self._dirty_nodes.discard(merge_id)
                 merged_count += 1
-
-                self.store.delete_nodes([merge_id], user_id=self.user_id)
-                self.store.add_nodes([{
-                    "id": keep_id,
-                    "content": node1.content,
-                    "embedding": self._node_embedding(node1) or [],
-                    "type": node1.type,
-                    "salience": node1.salience,
-                    "shard_key": node1.shard_key,
-                    "timestamp": node1.timestamp,
-                }], user_id=self.user_id)
+                # keep_id goes dirty: the merged content plus the arena's
+                # merge_touch result (max salience, access+1) reach the
+                # store at the save that follows this consolidation.
+                self._mark_dirty(keep_id)
+            if absorbed:
+                self.store.delete_nodes(sorted(absorbed), user_id=self.user_id)
             if merged_count and self.query_cache:
                 self.query_cache.invalidate_results()
             return merged_count
@@ -1136,43 +1314,133 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             dicts[i]["embedding"] = [float(x) for x in e]
 
     def _save_to_persistence(self) -> None:
-        """Full rewrite of the user's durable rows (parity with
-        memory_system.py:1275-1302: delete-all + re-insert). Nodes whose
-        host embedding is unmaterialized get theirs from the arena in one
-        bulk gather. ``buffer.nodes`` already merges super-nodes in."""
-        with self._mutex:
-            self._sync_from_arena()
-            all_nodes = list(self.buffer.nodes.values())
-            nodes_data = [self._node_row(n) for n in all_nodes]
-            self._bulk_fill_embeddings(nodes_data, [n.id for n in all_nodes])
-            edges_data = []
-            for shard in self.shards.values():
-                for edge in shard.edges.values():
-                    edges_data.append({
-                        "source_id": edge.source,
-                        "target_id": edge.target,
-                        "weight": edge.weight,
-                        "edge_type": edge.edge_type,
-                        "co_occurrence": edge.co_occurrence,
-                        "last_updated": edge.last_updated,
-                    })
-            self.store.delete_nodes([], user_id=self.user_id)
-            if nodes_data:
-                self.store.add_nodes(nodes_data, user_id=self.user_id)
-            self.store.delete_edges([], user_id=self.user_id)
-            if edges_data:
-                self.store.add_edges(edges_data, user_id=self.user_id)
-            self.store.save_profile(self.profile.to_dict(), user_id=self.user_id)
-            self._last_version = self.store.get_latest_version()
-            self._log(f"💾 Saved {len(nodes_data)} nodes, {len(edges_data)} edges")
+        """Persist the user's durable rows.
 
-    @staticmethod
-    def _node_row(node: Node) -> Dict[str, Any]:
-        emb = node.embedding if node.embedding is not None else []
+        Incremental path (segmented stores): upsert only rows dirtied since
+        the last save, flush edge tombstones, and record the decay-pass
+        counter — a conversation's save cost is proportional to what the
+        conversation touched, not graph size. Fallback path (injected/
+        protocol-parity stores, or before the first sync): the reference's
+        full delete-all + re-insert (memory_system.py:1275-1302)."""
+        with self._mutex:
+            if self._supports_incremental and self._store_synced:
+                self._save_incremental()
+            else:
+                self._save_full()
+            self._last_version = self.store.get_latest_version()
+
+    def _save_incremental(self) -> None:
+        self._sync_from_arena(node_ids=set(self._dirty_nodes),
+                              edge_keys=set(self._dirty_edges))
+        nodes = []
+        for nid in sorted(self._dirty_nodes):
+            node = self.buffer.get_node(nid)
+            if node is not None:
+                nodes.append(node)
+        # Dirty rows carry embedding=None unless the host holds a real copy:
+        # the store preserves each row's stored vector, so no arena gather
+        # (and no f32→arena-dtype degradation) happens here.
+        rows = [self._node_row(n) for n in nodes]
+        if rows:
+            self.store.add_nodes(rows, user_id=self.user_id)
+        # Tombstones flush BEFORE upserts: segments merge last-wins, so an
+        # edge deleted and re-created within one save interval must end with
+        # its upsert as the final word.
+        if self._deleted_edge_ids:
+            self.store.delete_edges(sorted(self._deleted_edge_ids),
+                                    user_id=self.user_id)
+        edge_rows = []
+        for key in sorted(self._dirty_edges):
+            edge = self._find_edge(key)
+            if edge is not None:
+                edge_rows.append(self._edge_row(edge))
+        if edge_rows:
+            self.store.add_edges(edge_rows, user_id=self.user_id)
+        self.store.save_profile(self.profile.to_dict(), user_id=self.user_id)
+        self.store.save_sys_meta({"decay_pass": self._decay_pass,
+                                  "node_counter": self.node_counter},
+                                 user_id=self.user_id)
+        self._dirty_nodes.clear()
+        self._dirty_edges.clear()
+        self._deleted_edge_ids.clear()
+        self._log(f"💾 Saved {len(rows)} nodes, {len(edge_rows)} edges (delta)")
+
+    def _save_full(self) -> None:
+        """Delete-all + re-insert (parity with memory_system.py:1275-1302).
+        Nodes whose host embedding is unmaterialized get theirs from the
+        arena in one bulk gather. ``buffer.nodes`` merges super-nodes in."""
+        self._sync_from_arena()
+        all_nodes = list(self.buffer.nodes.values())
+        nodes_data = [self._node_row(n) for n in all_nodes]
+        # The delete-all below destroys the stored rows, so vectors must be
+        # materialized first: prefer the store's pristine float32 copy, fall
+        # back to an arena gather for rows the store never held.
+        self._preserve_stored_embeddings(nodes_data)
+        self._bulk_fill_embeddings(nodes_data, [n.id for n in all_nodes])
+        edges_data = [self._edge_row(edge)
+                      for shard in self.shards.values()
+                      for edge in shard.edges.values()]
+        self.store.delete_nodes([], user_id=self.user_id)
+        if nodes_data:
+            self.store.add_nodes(nodes_data, user_id=self.user_id)
+        self.store.delete_edges([], user_id=self.user_id)
+        if edges_data:
+            self.store.add_edges(edges_data, user_id=self.user_id)
+        self.store.save_profile(self.profile.to_dict(), user_id=self.user_id)
+        if self._supports_incremental:
+            self.store.save_sys_meta({"decay_pass": self._decay_pass,
+                                      "node_counter": self.node_counter},
+                                     user_id=self.user_id)
+            self._store_synced = True
+        self._dirty_nodes.clear()
+        self._dirty_edges.clear()
+        self._deleted_edge_ids.clear()
+        self._log(f"💾 Saved {len(nodes_data)} nodes, {len(edges_data)} edges")
+
+    def _preserve_stored_embeddings(self, rows: List[Dict[str, Any]]) -> None:
+        """Backfill empty 'embedding' entries from the store's current rows
+        (vectors that live neither on the host nor in the arena)."""
+        missing = {r["id"] for r in rows if not r.get("embedding")}
+        if not missing or not hasattr(self.store, "get_nodes_columns"):
+            return
+        try:
+            cols = self.store.get_nodes_columns(self.user_id)
+        except Exception:
+            return
+        if cols is None:
+            return
+        ragged = cols.get("ragged_embeddings", {})
+        byid: Dict[str, List[float]] = {}
+        for i, rid in enumerate(cols["id"]):
+            if rid not in missing:
+                continue
+            if cols["has_embedding"][i]:
+                byid[rid] = cols["embedding"][i].tolist()
+            elif i in ragged:
+                byid[rid] = ragged[i].tolist()
+        for r in rows:
+            if not r.get("embedding") and r["id"] in byid:
+                r["embedding"] = byid[r["id"]]
+
+    def _edge_row(self, edge: Edge) -> Dict[str, Any]:
+        return {
+            "source_id": edge.source,
+            "target_id": edge.target,
+            "weight": edge.weight,
+            "edge_type": edge.edge_type,
+            "co_occurrence": edge.co_occurrence,
+            "last_updated": edge.last_updated,
+            "decay_pass": self._decay_pass,
+        }
+
+    def _node_row(self, node: Node) -> Dict[str, Any]:
+        # embedding None = "no new vector": the segmented store keeps the
+        # pristine stored one (never the arena's normalized/quantized copy).
+        emb = node.embedding
         return {
             "id": node.id,
             "content": node.content,
-            "embedding": [float(x) for x in emb],
+            "embedding": None if emb is None else [float(x) for x in emb],
             "type": node.type,
             "timestamp": node.timestamp,
             "access_count": node.access_count,
@@ -1182,6 +1450,9 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             "child_ids": list(node.child_ids),
             "parent_id": node.parent_id,
             "shard_key": node.shard_key,
+            # Stamp: which decay sweep these numerics are current as of —
+            # loads replay (current_pass - stamp) sweeps in closed form.
+            "decay_pass": self._decay_pass,
         }
 
     def _load_from_persistence(self) -> None:
@@ -1192,76 +1463,200 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 self.index.delete(stale)
             self.shards.clear()
             self.super_nodes.clear()
+            self._dirty_nodes.clear()
+            self._dirty_edges.clear()
+            self._deleted_edge_ids.clear()
+            meta = (self.store.load_sys_meta(self.user_id)
+                    if self._supports_incremental else {})
+            self._decay_pass = int(meta.get("decay_pass", 0))
 
-            rows = self.store.get_nodes(user_id=self.user_id)
-            max_counter = 0
-            batch: List[Node] = []
-            for r in rows:
-                node = Node(
-                    id=r["id"],
-                    content=r.get("content", ""),
-                    embedding=r.get("embedding") or None,
-                    type=r.get("type", "semantic"),
-                    timestamp=r.get("timestamp", time.time()),
-                    access_count=int(r.get("access_count", 0)),
-                    last_accessed=r.get("last_accessed", time.time()),
-                    salience=float(r.get("salience", 0.5)),
-                    is_super_node=bool(r.get("is_super_node", False)),
-                    child_ids=list(r.get("child_ids") or []),
-                    parent_id=r.get("parent_id"),
-                    shard_key=r.get("shard_key") or "default",
-                )
-                if node.is_super_node:
-                    self.super_nodes[node.id] = node
-                else:
-                    self._get_or_create_shard(node.shard_key).add_node(node)
-                if node.embedding is not None and len(node.embedding) == self.embed_dim:
-                    batch.append(node)
-                if node.id.startswith("node_"):
-                    try:
-                        max_counter = max(max_counter, int(node.id[5:]))
-                    except ValueError:
-                        pass
-
-            if batch:
-                self.index.add(
-                    [self._q(n.id) for n in batch],
-                    np.asarray([n.embedding for n in batch], np.float32),
-                    [n.salience for n in batch],
-                    [n.timestamp for n in batch],
-                    [n.type for n in batch],
-                    [n.shard_key or "default" for n in batch],
-                    self.user_id,
-                    [n.is_super_node for n in batch])
-
-            edge_rows = self.store.get_edges(user_id=self.user_id)
-            triples = []
-            for r in edge_rows:
-                edge = Edge(
-                    source=r.get("source_id") or r.get("source"),
-                    target=r.get("target_id") or r.get("target"),
-                    weight=float(r.get("weight", 0.5)),
-                    edge_type=r.get("edge_type", "relates_to"),
-                    co_occurrence=int(r.get("co_occurrence", 1)),
-                    last_updated=r.get("last_updated", time.time()),
-                )
-                owner = None
-                for shard in self.shards.values():
-                    if edge.source in shard.nodes:
-                        owner = shard
-                        break
-                (owner or self._get_or_create_shard("default")).edges[edge.key] = edge
-                triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
-            if triples:
-                self.index.add_edges(triples, self.user_id)
+            if self._supports_incremental:
+                self._load_columnar()
+            else:
+                self._load_rows()
 
             prof = self.store.load_profile(user_id=self.user_id)
             self.profile = Profile.from_dict(prof) if prof else Profile()
 
-            self.node_counter = max(self.node_counter, max_counter)
+            self.node_counter = max(self.node_counter,
+                                    int(meta.get("node_counter", 0)))
             self._last_version = self.store.get_latest_version()
+            self._store_synced = True
             if self.query_cache:
                 self.query_cache.invalidate_results()
+
+    def _restore_counter(self, node_id: str) -> None:
+        if node_id.startswith("node_"):
+            try:
+                self.node_counter = max(self.node_counter, int(node_id[5:]))
+            except ValueError:
+                pass
+
+    def _load_columnar(self) -> None:
+        """Bulk columnar restore: embeddings go host→arena as ONE matrix,
+        host nodes materialize WITHOUT per-node vectors, and clean rows'
+        salience / edge weights are reconstructed by replaying the uniform
+        decay sweeps they missed since their stamp (closed form — the store
+        never rewrites rows just because a sweep ran)."""
+        cols = self.store.get_nodes_columns(self.user_id)
+        if cols is None:
+            return
+        rate = self.config.decay_rate
+        floor = self.config.salience_floor
+        missed = np.maximum(self._decay_pass - cols["decay_pass"], 0)
+        sal = floor + (cols["salience"] - floor) * (1.0 - rate) ** missed
+        ids = cols["id"]
+        contents = cols["content"]
+        types = cols["type"]
+        shard_keys = cols["shard_key"]
+        parents = cols["parent_id"]
+        child_json = cols["child_ids"]
+        ts = cols["timestamp"]
+        la = cols["last_accessed"]
+        ac = cols["access_count"]
+        is_super = cols["is_super_node"]
+        ragged = cols.get("ragged_embeddings", {})
+        for i in range(len(ids)):
+            node = Node(
+                id=ids[i],
+                content=contents[i] or "",
+                # Arena-authoritative (None) for modal-dimension rows; rows
+                # stored at another dimension keep their host copy so a
+                # later upsert can't destroy the vector.
+                embedding=(ragged[i].tolist() if i in ragged else None),
+                type=types[i] or "semantic",
+                timestamp=float(ts[i]),
+                access_count=int(ac[i]),
+                last_accessed=float(la[i]),
+                salience=float(sal[i]),
+                is_super_node=bool(is_super[i]),
+                child_ids=(json.loads(child_json[i])
+                           if child_json[i] and child_json[i] != "[]" else []),
+                parent_id=parents[i] or None,
+                shard_key=shard_keys[i] or "default",
+            )
+            if node.is_super_node:
+                self.super_nodes[node.id] = node
+            else:
+                self._get_or_create_shard(node.shard_key).add_node(node)
+            self._restore_counter(node.id)
+
+        matrix = cols["embedding"]
+        ok = cols["has_embedding"]
+        if matrix.shape[1] != self.embed_dim:
+            # Store's modal dimension differs from the current embedder:
+            # only rows that happen to match the embedder dimension are
+            # servable from the arena (the rest stay host-resident).
+            idx = np.asarray(sorted(i for i, v in ragged.items()
+                                    if v.size == self.embed_dim), np.int64)
+            emb_rows = (np.stack([ragged[int(i)] for i in idx])
+                        if idx.size else np.zeros((0, self.embed_dim), np.float32))
+        else:
+            idx = np.nonzero(ok)[0]
+            emb_rows = matrix[idx]
+        if idx.size:
+            qids = [self._q(ids[i]) for i in idx]
+            self.index.add(
+                qids,
+                emb_rows,
+                sal[idx],
+                ts[idx],
+                [types[i] or "semantic" for i in idx],
+                [shard_keys[i] or "default" for i in idx],
+                self.user_id,
+                is_super[idx])
+            self.index.restore_access(qids, ac[idx], la[idx])
+
+        ecols = self.store.get_edges_columns(self.user_id)
+        if ecols is None:
+            return
+        missed_e = np.maximum(self._decay_pass - ecols["decay_pass"], 0)
+        weights = ecols["weight"] * (1.0 - rate) ** missed_e
+        node_shard = {}
+        for i in range(len(ids)):
+            if not is_super[i]:
+                node_shard[ids[i]] = shard_keys[i] or "default"
+        srcs = ecols["source_id"]
+        tgts = ecols["target_id"]
+        ets = ecols["edge_type"]
+        cos = ecols["co_occurrence"]
+        lus = ecols["last_updated"]
+        triples = []
+        for i in range(len(srcs)):
+            edge = Edge(source=srcs[i], target=tgts[i], weight=float(weights[i]),
+                        edge_type=ets[i] or "relates_to",
+                        co_occurrence=int(cos[i]), last_updated=float(lus[i]))
+            owner = self.shards.get(node_shard.get(edge.source, "default"))
+            if owner is None:
+                owner = self._get_or_create_shard("default")
+            owner.edges[edge.key] = edge
+            triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
+        if triples:
+            self.index.add_edges(triples, self.user_id)
+
+    def _load_rows(self) -> None:
+        """Row-dict restore for protocol-parity stores without the columnar
+        API (mirrors reference _load_from_persistence :1304-1410)."""
+        rows = self.store.get_nodes(user_id=self.user_id)
+        batch: List[Node] = []
+        for r in rows:
+            node = Node(
+                id=r["id"],
+                content=r.get("content", ""),
+                embedding=r.get("embedding") or None,
+                type=r.get("type", "semantic"),
+                timestamp=r.get("timestamp", time.time()),
+                access_count=int(r.get("access_count", 0)),
+                last_accessed=r.get("last_accessed", time.time()),
+                salience=float(r.get("salience", 0.5)),
+                is_super_node=bool(r.get("is_super_node", False)),
+                child_ids=list(r.get("child_ids") or []),
+                parent_id=r.get("parent_id"),
+                shard_key=r.get("shard_key") or "default",
+            )
+            if node.is_super_node:
+                self.super_nodes[node.id] = node
+            else:
+                self._get_or_create_shard(node.shard_key).add_node(node)
+            if node.embedding is not None and len(node.embedding) == self.embed_dim:
+                batch.append(node)
+            self._restore_counter(node.id)
+
+        if batch:
+            qids = [self._q(n.id) for n in batch]
+            self.index.add(
+                qids,
+                np.asarray([n.embedding for n in batch], np.float32),
+                [n.salience for n in batch],
+                [n.timestamp for n in batch],
+                [n.type for n in batch],
+                [n.shard_key or "default" for n in batch],
+                self.user_id,
+                [n.is_super_node for n in batch])
+            self.index.restore_access(qids,
+                                      [n.access_count for n in batch],
+                                      [n.last_accessed for n in batch])
+
+        edge_rows = self.store.get_edges(user_id=self.user_id)
+        triples = []
+        for r in edge_rows:
+            edge = Edge(
+                source=r.get("source_id") or r.get("source"),
+                target=r.get("target_id") or r.get("target"),
+                weight=float(r.get("weight", 0.5)),
+                edge_type=r.get("edge_type", "relates_to"),
+                co_occurrence=int(r.get("co_occurrence", 1)),
+                last_updated=r.get("last_updated", time.time()),
+            )
+            owner = None
+            for shard in self.shards.values():
+                if edge.source in shard.nodes:
+                    owner = shard
+                    break
+            (owner or self._get_or_create_shard("default")).edges[edge.key] = edge
+            triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
+        if triples:
+            self.index.add_edges(triples, self.user_id)
 
     def check_for_updates(self) -> bool:
         try:
@@ -1389,6 +1784,12 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             for key, val in host.get("settings", {}).items():
                 if hasattr(self, key):
                     setattr(self, key, val)
+            # The restored graph no longer matches the store's rows; the
+            # next save must be a full rewrite, not a delta.
+            self._store_synced = False
+            self._dirty_nodes.clear()
+            self._dirty_edges.clear()
+            self._deleted_edge_ids.clear()
             if self.query_cache:
                 self.query_cache.invalidate_results()
         # Reopen the WAL for the (possibly different) restored user —
@@ -1486,6 +1887,11 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             for key, val in state.get("settings", {}).items():
                 if hasattr(self, key):
                     setattr(self, key, val)
+            # Imported graph diverges from the store; force a full rewrite.
+            self._store_synced = False
+            self._dirty_nodes.clear()
+            self._dirty_edges.clear()
+            self._deleted_edge_ids.clear()
         return f"✓ State loaded from {filename}"
 
     # --------------------------------------------------------- export/insights

@@ -263,6 +263,19 @@ def arena_set_parentage(state: ArenaState, rows: jax.Array, is_super: jax.Array)
 
 
 @jax.jit
+def arena_restore_access(state: ArenaState, rows: jax.Array,
+                         access_count: jax.Array,
+                         last_accessed: jax.Array) -> ArenaState:
+    """Reload path: ``arena_add`` zeroes access history for fresh inserts;
+    restored rows get their persisted counters back so importance-ranked
+    eviction keeps favoring heavily-used memories across restarts."""
+    return state.replace(
+        access_count=state.access_count.at[rows].set(access_count),
+        last_accessed=state.last_accessed.at[rows].set(last_accessed),
+    )
+
+
+@jax.jit
 def arena_decay(state: ArenaState, tenant: jax.Array, rate: jax.Array,
                 floor: jax.Array) -> ArenaState:
     """Asymptotic salience decay toward ``floor``:  s' = floor + (s-floor)(1-rate).
