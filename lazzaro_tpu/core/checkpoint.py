@@ -60,9 +60,37 @@ def _device(a: np.ndarray, tag: str):
     return jnp.asarray(a)
 
 
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def _write_versioned(ckpt_dir: str, arrays: Dict[str, np.ndarray],
                      meta: Dict) -> None:
-    """Stage arrays.npz + meta.json into a new version dir, flip CURRENT."""
+    """Stage arrays.npz + meta.json into a new version dir, flip CURRENT.
+
+    Multi-host: only process 0 touches the filesystem. Every process already
+    holds the full arrays (the collective allgather in ``_host`` runs on all
+    of them, BEFORE this call), so gating here means N processes on a shared
+    filesystem don't race each other's staging dirs and CURRENT flips. All
+    ranks then barrier so no rank can read-back before the snapshot exists."""
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        _ckpt_barrier()
+        return
+    try:
+        _write_versioned_rank0(ckpt_dir, arrays, meta)
+    finally:
+        # The barrier runs even when the write fails (ENOSPC/EIO): the other
+        # ranks are already waiting in it, and skipping it would turn a write
+        # error on rank 0 into a whole-pod hang.
+        _ckpt_barrier()
+
+
+def _write_versioned_rank0(ckpt_dir: str, arrays: Dict[str, np.ndarray],
+                           meta: Dict) -> None:
     os.makedirs(ckpt_dir, exist_ok=True)
     cur = _read_current(ckpt_dir)
     next_n = int(cur[1:]) + 1 if cur else 1
@@ -74,7 +102,15 @@ def _write_versioned(ckpt_dir: str, arrays: Dict[str, np.ndarray],
         np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # rename alone doesn't make the payload durable: fsync the staged
+        # files and both directories around the rename, or a power cut can
+        # leave CURRENT pointing at a version whose npz is garbage.
+        _fsync_path(os.path.join(tmp, "arrays.npz"))
+        _fsync_path(tmp)
         os.replace(tmp, os.path.join(ckpt_dir, vname))
+        _fsync_path(ckpt_dir)
     except BaseException:
         import shutil
         shutil.rmtree(tmp, ignore_errors=True)
@@ -83,7 +119,10 @@ def _write_versioned(ckpt_dir: str, arrays: Dict[str, np.ndarray],
     try:
         with os.fdopen(fd, "w") as f:
             f.write(vname)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(ptr_tmp, _current_path(ckpt_dir))
+        _fsync_path(ckpt_dir)
     except BaseException:
         if os.path.exists(ptr_tmp):
             os.unlink(ptr_tmp)
@@ -92,6 +131,15 @@ def _write_versioned(ckpt_dir: str, arrays: Dict[str, np.ndarray],
     for entry in os.listdir(ckpt_dir):
         if entry != vname and (entry.startswith("v") or entry.startswith(".stage-")):
             shutil.rmtree(os.path.join(ckpt_dir, entry), ignore_errors=True)
+
+
+def _ckpt_barrier() -> None:
+    """Cross-process rendezvous after a gated write: every rank leaves
+    save_index only once rank 0's CURRENT flip is durable, so a save →
+    immediate load on any rank never sees a missing/stale snapshot."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("lazzaro_ckpt_write")
 
 
 def _read_versioned(ckpt_dir: str):

@@ -26,6 +26,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -34,7 +35,8 @@ from lazzaro_tpu.core.buffer_graph import BufferGraph
 from lazzaro_tpu.core.index import MemoryIndex
 from lazzaro_tpu.core.memory_shard import MemoryShard
 from lazzaro_tpu.core.profile import Profile
-from lazzaro_tpu.core.providers import HashingEmbedder, HeuristicLLM, infer_topic
+from lazzaro_tpu.core.providers import (HashingEmbedder, HeuristicLLM,
+                                        _extract_json_object, infer_topic)
 from lazzaro_tpu.core.query_cache import QueryCache
 from lazzaro_tpu.core.store import ArrowStore
 from lazzaro_tpu.models.graph import Edge, Node
@@ -112,6 +114,14 @@ class MemorySystem:
 
         self.shards: Dict[str, MemoryShard] = {}
         self.super_nodes: Dict[str, Node] = {}
+        # O(1) placement caches: edge_key → shard_key and node_id → shard_key.
+        # Self-healing — entries are validated on read and rebuilt on miss, so
+        # a mutation path that forgets to update them costs one repair scan,
+        # never correctness. Kills the per-edge×per-shard scans that crept
+        # toward the reference's O(E·S) habits (_find_edge, _add_edges_batch,
+        # _save_incremental) as shard count grows monthly.
+        self._edge_shard: Dict[Tuple[str, str], str] = {}
+        self._node_shard_cache: Dict[str, str] = {}
         self.buffer = BufferGraph(self.shards, self.super_nodes)
         self.profile = Profile()
         self.mesh = mesh
@@ -351,12 +361,10 @@ class MemorySystem:
             if user != self.user_id:
                 continue
             tgt = qtgt.partition(":")[2]
-            for shard in self.shards.values():
-                edge = shard.edges.get((src, tgt))
-                if edge is not None:
-                    edge.weight = w
-                    edge.co_occurrence = co
-                    break
+            edge = self._find_edge((src, tgt))
+            if edge is not None:
+                edge.weight = w
+                edge.co_occurrence = co
 
     # ------------------------------------------------------- dirty tracking
     def _mark_dirty(self, *node_ids: str) -> None:
@@ -369,10 +377,33 @@ class MemorySystem:
         # edge_type on the same key stays deleted.
         self._dirty_edges.add(key)
 
+    def _shard_of_node(self, node_id: str) -> Optional[MemoryShard]:
+        """O(1) owner-shard lookup through the placement cache; falls back to
+        one repair scan on a stale/missing entry."""
+        sk = self._node_shard_cache.get(node_id)
+        if sk is not None:
+            shard = self.shards.get(sk)
+            if shard is not None and node_id in shard.nodes:
+                return shard
+            del self._node_shard_cache[node_id]
+        for sk, shard in self.shards.items():
+            if node_id in shard.nodes:
+                self._node_shard_cache[node_id] = sk
+                return shard
+        return None
+
     def _find_edge(self, key: Tuple[str, str]) -> Optional[Edge]:
-        for shard in self.shards.values():
+        sk = self._edge_shard.get(key)
+        if sk is not None:
+            shard = self.shards.get(sk)
+            edge = shard.edges.get(key) if shard is not None else None
+            if edge is not None:
+                return edge
+            del self._edge_shard[key]
+        for sk, shard in self.shards.items():
             edge = shard.edges.get(key)
             if edge is not None:
+                self._edge_shard[key] = sk
                 return edge
         return None
 
@@ -487,15 +518,12 @@ class MemorySystem:
         removed = self.index.prune_edges(self.user_id, threshold)
         count = 0
         for qsrc, qtgt in removed:
-            src = qsrc.partition(":")[2]
-            tgt = qtgt.partition(":")[2]
-            for shard in self.shards.values():
-                edge = shard.edges.get((src, tgt))
-                if edge is not None:
-                    self._mark_edge_deleted(edge)
-                    del shard.edges[(src, tgt)]
-                    count += 1
-                    break
+            key = (qsrc.partition(":")[2], qtgt.partition(":")[2])
+            edge = self._find_edge(key)
+            if edge is not None:
+                self._mark_edge_deleted(edge)
+                del self.shards[self._edge_shard.pop(key)].edges[key]
+                count += 1
         if self.query_cache:
             self.query_cache.invalidate_results()
         return count
@@ -719,9 +747,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             response_format={"type": "json_object"})
 
         try:
-            if "```json" in response:
-                response = response.split("```json")[1].split("```")[0].strip()
-            data = json.loads(response)
+            data = json.loads(_extract_json_object(response))
             if isinstance(data, dict):
                 memories = data.get("memories", [])
             elif isinstance(data, list):
@@ -912,16 +938,19 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             return
         triples = []
         for edge in edges:
-            shard = None
-            for s in self.shards.values():
-                if edge.source in s.nodes:
-                    shard = s
-                    break
-            if shard is None:
-                shard = self._get_or_create_shard("default")
+            key = (edge.source, edge.target)
+            # Existing edge: reinforce it where it lives. New edge: dispatch
+            # to the source node's shard (O(1) via the placement caches).
+            sk = self._edge_shard.get(key)
+            shard = self.shards.get(sk) if sk is not None else None
+            if shard is None or key not in shard.edges:
+                shard = self._shard_of_node(edge.source)
+                if shard is None:
+                    shard = self._get_or_create_shard("default")
             shard.add_edge(edge, reinforce=self.config.edge_reinforce)
+            self._edge_shard[key] = shard.shard_key
             triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
-            self._mark_edge_dirty((edge.source, edge.target))
+            self._mark_edge_dirty(key)
         self.index.add_edges(triples, self.user_id,
                              reinforce=self.config.edge_reinforce)
 
@@ -1034,6 +1063,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 shard = self.shards.get(node.shard_key)
                 if shard and nid in shard.nodes:
                     del shard.nodes[nid]
+                    self._node_shard_cache.pop(nid, None)
                     # cross-links live in the SOURCE node's shard, so scan all
                     # shards — not just the evictee's own (the reference only
                     # cleans the home shard, leaving dangling edges).
@@ -1042,6 +1072,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                                     if k[0] == nid or k[1] == nid]:
                             self._mark_edge_deleted(s.edges[key])
                             del s.edges[key]
+                            self._edge_shard.pop(key, None)
                     removed_ids.append(nid)
                     self._dirty_nodes.discard(nid)
             if removed_ids:
@@ -1128,9 +1159,10 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
              {"role": "user", "content": prompt}],
             response_format={"type": "json_object"})
         try:
-            if "```json" in response:
-                response = response.split("```json")[1].split("```")[0].strip()
-            data = json.loads(response)
+            data = json.loads(_extract_json_object(response))
+            if not isinstance(data, dict):
+                # a top-level array/scalar parses but has no domains
+                return "Failed to extract profile"
             updated_any = False
             for domain, insight in data.items():
                 if domain in self.profile.data and insight:
@@ -1186,16 +1218,19 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                             rewires.append(((src, tgt), (src, keep_id)))
                     for old_key, new_key in rewires:
                         edge = shard.edges.pop(old_key)
+                        self._edge_shard.pop(old_key, None)
                         self._mark_edge_deleted(edge)
                         edge.source, edge.target = new_key
                         if new_key[0] != new_key[1]:
                             shard.edges[new_key] = edge
+                            self._edge_shard[new_key] = shard.shard_key
                             self.index.add_edges(
                                 [(self._q(new_key[0]), self._q(new_key[1]), edge.weight)],
                                 self.user_id)
                             self._mark_edge_dirty(new_key)
                     if merge_id in shard.nodes:
                         del shard.nodes[merge_id]
+                        self._node_shard_cache.pop(merge_id, None)
 
                 self.index.merge_touch([qkeep], [node1.salience])
                 self.index.delete([qmerge])
@@ -1463,6 +1498,8 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 self.index.delete(stale)
             self.shards.clear()
             self.super_nodes.clear()
+            self._edge_shard.clear()
+            self._node_shard_cache.clear()
             self._dirty_nodes.clear()
             self._dirty_edges.clear()
             self._deleted_edge_ids.clear()
@@ -1590,6 +1627,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             if owner is None:
                 owner = self._get_or_create_shard("default")
             owner.edges[edge.key] = edge
+            self._edge_shard[edge.key] = owner.shard_key
             triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
         if triples:
             self.index.add_edges(triples, self.user_id)
@@ -1648,12 +1686,11 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 co_occurrence=int(r.get("co_occurrence", 1)),
                 last_updated=r.get("last_updated", time.time()),
             )
-            owner = None
-            for shard in self.shards.values():
-                if edge.source in shard.nodes:
-                    owner = shard
-                    break
-            (owner or self._get_or_create_shard("default")).edges[edge.key] = edge
+            owner = self._shard_of_node(edge.source)
+            if owner is None:
+                owner = self._get_or_create_shard("default")
+            owner.edges[edge.key] = edge
+            self._edge_shard[edge.key] = owner.shard_key
             triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
         if triples:
             self.index.add_edges(triples, self.user_id)
@@ -1686,8 +1723,6 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         self._drain_background()
         with self._mutex:
             self._sync_from_arena()
-            os.makedirs(snapshot_dir, exist_ok=True)
-            ckpt.save_index(self.index, os.path.join(snapshot_dir, "index"))
 
             def slim(node: Node) -> Dict[str, Any]:
                 d = node.to_dict()
@@ -1715,8 +1750,16 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                     "max_buffer_size": self.max_buffer_size,
                 },
             }
-            _atomic_write(os.path.join(snapshot_dir, "host.json"),
-                          json.dumps(host).encode())
+            # Multi-host: only rank 0 writes host.json (N ranks would race
+            # last-writer-wins on a shared filesystem and could pair rank-k
+            # host state with rank-0's index). host.json goes FIRST so that
+            # save_index's internal all-rank barrier is the last sync point
+            # — once any rank returns, both files are durably in place.
+            if jax.process_count() == 1 or jax.process_index() == 0:
+                os.makedirs(snapshot_dir, exist_ok=True)
+                _atomic_write(os.path.join(snapshot_dir, "host.json"),
+                              json.dumps(host).encode())
+            ckpt.save_index(self.index, os.path.join(snapshot_dir, "index"))
         return f"✓ Snapshot saved to {snapshot_dir}"
 
     def load_snapshot(self, snapshot_dir: str) -> str:
@@ -1758,6 +1801,8 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             self.user_id = host.get("user_id", self.user_id)
             self.shards.clear()
             self.super_nodes.clear()
+            self._edge_shard.clear()
+            self._node_shard_cache.clear()
             # Pre-restore session state is meaningless against the new graph.
             self.conversation_active = False
             self.short_term_memory.clear()
@@ -1773,6 +1818,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                     shard.add_node(node)
                 for edge in edges:
                     shard.edges[edge.key] = edge
+                    self._edge_shard[edge.key] = shard_key
             for node in staged_supers:
                 self.super_nodes[node.id] = node
             profile_data = host.get("profile", {})
@@ -1846,6 +1892,8 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 self.index.delete(stale)
             self.shards.clear()
             self.super_nodes.clear()
+            self._edge_shard.clear()
+            self._node_shard_cache.clear()
 
             batch: List[Node] = []
             for shard_key, shard_data in state.get("shards", {}).items():
@@ -1858,6 +1906,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 for ed in shard_data.get("edges", []):
                     edge = Edge.from_dict(ed)
                     shard.edges[edge.key] = edge
+                    self._edge_shard[edge.key] = shard_key
             for nd in state.get("super_nodes", []):
                 node = Node.from_dict(nd)
                 self.super_nodes[node.id] = node

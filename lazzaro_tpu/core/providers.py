@@ -20,6 +20,68 @@ import numpy as np
 
 from lazzaro_tpu.models.tokenizer import HashTokenizer
 
+
+def _balanced_block(text: str, start: int) -> Optional[str]:
+    """The balanced {...} or [...] block opening at ``start`` (delimiter-
+    counted, string-aware), or None if it never closes."""
+    open_c = text[start]
+    close_c = "}" if open_c == "{" else "]"
+    depth, in_str, esc = 0, False, False
+    for i in range(start, len(text)):
+        c = text[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = False
+        elif c == '"':
+            in_str = True
+        elif c == open_c:
+            depth += 1
+        elif c == close_c:
+            depth -= 1
+            if depth == 0:
+                return text[start:i + 1]
+    return None
+
+
+def _extract_json_object(text: str, max_candidates: int = 20) -> str:
+    """Best-effort JSON extraction from free-form model output: prefer a
+    ``` fence whose content actually parses, else the first balanced
+    {...}/[...] block in the text that parses (so a pseudo-code fence with
+    braces can't eat a trailing real object), else the first balanced block,
+    else the raw text — keeping the caller's own JSON error handling as the
+    single point of failure."""
+    fenced = re.search(r"```(?:json)?\s*(.*?)```", text, re.DOTALL)
+    if fenced:
+        inner = fenced.group(1)
+        m = re.search(r"[{\[]", inner)
+        if m:
+            block = _balanced_block(inner, m.start())
+            if block is not None:
+                try:
+                    json.loads(block)
+                    return block
+                except ValueError:
+                    pass
+    first_block = None
+    for n, m in enumerate(re.finditer(r"[{\[]", text)):
+        if n >= max_candidates:
+            break
+        block = _balanced_block(text, m.start())
+        if block is None:
+            continue
+        if first_block is None:
+            first_block = block
+        try:
+            json.loads(block)
+            return block
+        except ValueError:
+            continue
+    return first_block if first_block is not None else text.strip()
+
 # ---------------------------------------------------------------------------
 # Embedding providers
 # ---------------------------------------------------------------------------
@@ -210,12 +272,27 @@ class OnDeviceLLM:
 
     def completion(self, messages: List[Dict[str, str]],
                    response_format: Optional[Dict] = None) -> str:
-        prompt = self._render(messages)
         if response_format and response_format.get("type") == "json_object":
-            return self.lm.generate_json(prompt,
-                                         max_new_tokens=self.max_new_tokens,
-                                         temperature=self.temperature)
-        return self.lm.generate(prompt,
+            from lazzaro_tpu.models.tokenizer import ByteTokenizer
+            if isinstance(self.lm.tokenizer, ByteTokenizer):
+                return self.lm.generate_json(self._render(messages),
+                                             max_new_tokens=self.max_new_tokens,
+                                             temperature=self.temperature)
+            # HF/subword tokenizer: the byte-level JSON grammar automaton
+            # can't mask subword logits, so fall back to free-text decoding
+            # plus fence/JSON extraction (the reference's own json path,
+            # memory_system.py:684-703) instead of crashing the provider.
+            # The instruction goes in as a system turn BEFORE the final
+            # "Assistant:" cue — appended after it, the model would treat
+            # the directive as its own already-generated text.
+            json_prompt = self._render(
+                messages + [{"role": "system",
+                             "content": "Respond with a single JSON object only."}])
+            text = self.lm.generate(json_prompt,
+                                    max_new_tokens=self.max_new_tokens,
+                                    temperature=self.temperature)
+            return _extract_json_object(text)
+        return self.lm.generate(self._render(messages),
                                 max_new_tokens=self.max_new_tokens,
                                 temperature=self.temperature)
 

@@ -100,9 +100,13 @@ class _ResilientBase:
                 self._bump("primary_calls")
                 try:
                     result = attempt()
+                    # degraded() itself can raise on a malformed primary
+                    # result (wrong type/shape); that is a primary failure,
+                    # not an escape hatch out of the never-crash contract.
+                    ok = result is not None and not degraded(result)
                 except Exception:
-                    result = None
-                if result is not None and not degraded(result):
+                    ok = False
+                if ok:
                     self.breaker.record_success()
                     return result
                 self._bump("primary_failures")
@@ -153,9 +157,22 @@ class ResilientLLM(_ResilientBase):
                 first = None
                 stream = iter(())
             if first is not None:
-                self.breaker.record_success()
-                yield first
-                yield from stream
+                # A failure AFTER the first chunk can't be restarted (tokens
+                # already reached the caller) but must still be visible to
+                # the breaker, or a provider that always dies mid-stream
+                # never trips it. A caller closing a healthy stream early
+                # (GeneratorExit) is a success, not a failure.
+                try:
+                    yield first
+                    yield from stream
+                except GeneratorExit:
+                    self.breaker.record_success()
+                    raise
+                except Exception:
+                    self._bump("primary_failures")
+                    self.breaker.record_failure()
+                else:
+                    self.breaker.record_success()
                 return
             self._bump("primary_failures")
             self.breaker.record_failure()

@@ -419,8 +419,12 @@ class ArrowStore:
             emb = emb.astype(np.float32).tolist()
         elif emb is not None:
             emb = [float(x) for x in emb]
-        # None/empty reaches the segment as "no new vector" and the merge
-        # inherits the stored one (_merge_rows).
+        if not emb:
+            # None/empty reaches the segment as NULL = "no new vector"; the
+            # merge inherits the stored vector (_merge_read). An explicit
+            # empty list would instead *destroy* it under the merge contract,
+            # so normalize both spellings of "nothing" to NULL.
+            emb = None
         return {
             "id": n["id"],
             "user_id": user_id,

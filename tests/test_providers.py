@@ -82,3 +82,60 @@ def test_chat_stream_yields_info_then_tokens(tmp_db):
     text = "".join(e["content"] for e in events if e["type"] == "token")
     assert text == "streamed response"
     ms.close()
+
+
+def test_ondevice_llm_json_mode_with_subword_tokenizer():
+    """json_object mode with an HF/subword tokenizer must fall back to
+    free-text + JSON extraction instead of crashing on the byte-grammar
+    requirement (advisor r1: providers.py:215)."""
+    from lazzaro_tpu.core.providers import OnDeviceLLM, _extract_json_object
+
+    class SubwordTok:          # not a ByteTokenizer
+        eos_id = 2
+
+    class StubLM:
+        tokenizer = SubwordTok()
+
+        def generate(self, prompt, max_new_tokens=128, temperature=0.0):
+            return 'Sure thing!\n```json\n{"memories": [{"a": 1}]}\n```\ndone'
+
+        def generate_json(self, *a, **k):
+            raise ValueError("generate_json requires the byte tokenizer")
+
+    llm = OnDeviceLLM(lm=StubLM())
+    out = llm.completion([{"role": "user", "content": "extract"}],
+                         response_format={"type": "json_object"})
+    assert json.loads(out) == {"memories": [{"a": 1}]}
+
+    # Extractor edge cases: bare object amid prose, nested braces in strings.
+    assert json.loads(_extract_json_object('noise {"k": "a}b{c"} tail')) == \
+        {"k": "a}b{c"}
+    assert _extract_json_object("no json here") == "no json here"
+
+
+def test_extract_json_skips_non_json_fence():
+    from lazzaro_tpu.core.providers import _extract_json_object
+    out = _extract_json_object('```\npseudo code\n```\n{"memories": [1]}')
+    assert json.loads(out) == {"memories": [1]}
+
+
+def test_extract_json_prefers_parseable_block():
+    from lazzaro_tpu.core.providers import _extract_json_object
+    # Pseudo-code fence WITH braces must not eat the trailing real object.
+    out = _extract_json_object('```\nif x { return y }\n```\n{"memories": [1]}')
+    assert json.loads(out) == {"memories": [1]}
+    # Top-level arrays extract whole, not their first inner object.
+    out = _extract_json_object('here: [{"a": 1}, {"b": 2}] done')
+    assert json.loads(out) == [{"a": 1}, {"b": 2}]
+
+
+def test_profile_extraction_survives_array_response(tmp_db):
+    class ArrayLLM:
+        def completion(self, messages, response_format=None):
+            return '["preferences", "not a dict"]'
+
+    ms = MemorySystem(enable_async=False, db_dir=tmp_db, verbose=False,
+                      load_from_disk=False, llm_provider=ArrayLLM())
+    out = ms._extract_profile_from_contents(["likes climbing"])
+    assert "Failed" in out
+    ms.close()

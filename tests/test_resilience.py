@@ -173,3 +173,83 @@ def test_memory_system_with_resilient_providers(tmp_path):
     assert any("data engineer" in h for h in hits)
     assert flaky_llm.health()["fallback_calls"] > 0
     ms.close()
+
+
+def test_degraded_raising_stays_inside_wrapper():
+    """A malformed primary result that makes the degraded() check itself
+    raise must count as a primary failure and land on the fallback, not
+    escape the never-crash contract (advisor r1: resilience.py:105-113)."""
+    class MalformedEmbedder:
+        dim = 8
+
+        def embed(self, text):
+            return "not a vector"          # np.asarray(..., float32) raises
+
+        def batch_embed(self, texts):
+            return "not a matrix"
+
+    emb = ResilientEmbedder(MalformedEmbedder(), max_retries=0)
+    out = emb.embed("hello")
+    assert len(out) == 8 and any(abs(x) > 0 for x in out)
+    h = emb.health()
+    assert h["primary_failures"] == 1
+    assert h["fallback_calls"] == 1
+
+
+def test_mid_stream_failure_counted_by_breaker():
+    """A stream dying AFTER the first chunk can't be restarted, but it must
+    still be visible to the breaker (advisor r1: resilience.py:150-160)."""
+    class MidStreamDeath:
+        def completion(self, messages, response_format=None):
+            return "fallback text"
+
+        def completion_stream(self, messages, response_format=None):
+            yield "first chunk "
+            yield "second chunk "
+            raise ConnectionError("died mid-stream")
+
+    clock = FakeClock()
+    llm = ResilientLLM(MidStreamDeath(), breaker_threshold=2, clock=clock)
+    for _ in range(2):
+        chunks = list(llm.completion_stream(MSG))
+        assert chunks[:2] == ["first chunk ", "second chunk "]
+    h = llm.health()
+    assert h["primary_failures"] == 2
+    assert llm.breaker.state == "open"
+    # While open, streaming goes straight to the fallback.
+    out = "".join(llm.completion_stream(MSG))
+    assert "first chunk" not in out
+
+
+def test_clean_stream_closes_breaker():
+    class GoodStream:
+        def completion(self, messages, response_format=None):
+            return "ok"
+
+        def completion_stream(self, messages, response_format=None):
+            yield "a"
+            yield "b"
+
+    llm = ResilientLLM(GoodStream(), breaker_threshold=2)
+    llm.breaker.consecutive_failures = 1
+    assert list(llm.completion_stream(MSG)) == ["a", "b"]
+    assert llm.breaker.consecutive_failures == 0
+
+
+def test_early_closed_healthy_stream_counts_as_success():
+    """A caller abandoning a healthy stream (GeneratorExit) must reset the
+    breaker, not leave failures pending."""
+    class GoodStream:
+        def completion(self, messages, response_format=None):
+            return "ok"
+
+        def completion_stream(self, messages, response_format=None):
+            for t in ["a", "b", "c", "d"]:
+                yield t
+
+    llm = ResilientLLM(GoodStream(), breaker_threshold=3)
+    llm.breaker.consecutive_failures = 2
+    gen = llm.completion_stream(MSG)
+    assert next(gen) == "a"
+    gen.close()
+    assert llm.breaker.consecutive_failures == 0
