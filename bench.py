@@ -1,98 +1,213 @@
-"""Benchmark: p50 search_memories latency on a 1M-node memory graph (1 chip),
-plus ingest throughput — BASELINE.json's headline metric surface.
+"""Benchmark: BASELINE.md's metric surface, measured through the orchestrator.
 
-The reference's implicit bar is the ⚡ <100 ms retrieval tier
-(memory_system.py:332-337) and "sub-millisecond" LanceDB ANN claims (PKG-INFO)
-on CPU; here the whole 1M×768 bf16 index lives in HBM and a search is one
-masked matvec + top-k on the MXU.
+Builds a 1M-node graph by driving `MemorySystem.end_conversation` — the FULL
+ingest pipeline (LLM extract → batch embed → batched dedup probe → arena
+insert → link matmuls → delta-segment save), then measures:
 
-Prints ONE JSON line:
-  {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": 100/p50, ...}
+  headline : p50 `MemorySystem.search_memories()` latency at 1M nodes
+             (query embed → arena top-k → id decode → host node fetch →
+             neighbor boost bookkeeping — the reference's "p50
+             search_memories()" surface, memory_system.py:262-351)
+  extra    : ingest_pipeline_memories_per_sec_per_chip — end-to-end
+             `end_conversation` throughput (memory_system.py:651-785 analog)
+  extra    : raw kernel numbers under HONEST names (arena_search_p50_ms is
+             a bare matvec+top-k; arena_scatter_rows_per_sec is a scatter,
+             NOT ingest).
+
+The extraction LLM is a canned-payload queue (zero egress, deterministic);
+every other stage is the production code path. Reference bar: the ⚡ <100 ms
+retrieval tier (memory_system.py:332-337) on CPU+LanceDB.
+
+Prints ONE JSON line. Env overrides for smoke runs: BENCH_N, BENCH_DIM.
 """
 
 import json
+import os
 import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from lazzaro_tpu import MemorySystem
+from lazzaro_tpu.config import MemoryConfig
 from lazzaro_tpu.core import state as S
 
-N = 1_000_000
-DIM = 768
-K = 10
-WARMUP = 5
+N = int(os.environ.get("BENCH_N", 1_000_000))
+DIM = int(os.environ.get("BENCH_DIM", 768))
+FACTS_PER_CONV = min(5_000, N)
+CONVS = max(1, N // FACTS_PER_CONV)
+TOTAL = FACTS_PER_CONV * CONVS
+K_WARM = 5
 QUERIES = 50
 
 
-def main():
-    dev = jax.devices()[0]
-    cap = N
+def _fact_vec(idx: int) -> np.ndarray:
+    rng = np.random.default_rng(idx)
+    v = rng.standard_normal(DIM).astype(np.float32)
+    return v / np.linalg.norm(v)
 
-    # Build the arena directly on device (no 3 GB host transfer): random
-    # normal embeddings, normalized — bf16 rows, one tenant, all alive.
+
+class BulkEmbedder:
+    """Deterministic unit vectors keyed by the fact index in the text
+    ("fact <i>: ..."), so bench queries can dial up exact hits."""
+
+    dim = DIM
+
+    def _vec(self, text: str) -> np.ndarray:
+        if text.startswith("fact"):
+            idx = int(text.split(":")[0].split()[-1])
+        else:
+            idx = abs(hash(text)) % (1 << 31)
+        return _fact_vec(idx)
+
+    def embed(self, text):
+        return self._vec(text).tolist()
+
+    def batch_embed(self, texts):
+        return [self._vec(t).tolist() for t in texts]
+
+
+class QueueLLM:
+    """Pops one canned extraction payload per completion call — the LLM stage
+    is deterministic; everything downstream is the production pipeline."""
+
+    def __init__(self, payloads):
+        self.payloads = list(payloads)
+
+    def completion(self, messages, response_format=None):
+        return self.payloads.pop(0) if self.payloads else json.dumps({"memories": []})
+
+    def completion_stream(self, messages, response_format=None):
+        yield self.completion(messages, response_format)
+
+
+def _payload(conv: int) -> str:
+    base = conv * FACTS_PER_CONV
+    return json.dumps({"memories": [
+        {"content": f"fact {base + i}: user detail number {base + i}",
+         "type": "semantic", "salience": 0.6, "topic": "work"}
+        for i in range(FACTS_PER_CONV)]})
+
+
+def build_system(db_dir: str) -> MemorySystem:
+    return MemorySystem(
+        enable_async=False,
+        enable_hierarchy=False,
+        auto_consolidate=False,
+        load_from_disk=False,
+        max_buffer_size=TOTAL * 2,
+        db_dir=db_dir,
+        llm_provider=QueueLLM([_payload(c) for c in range(CONVS)]),
+        embedding_provider=BulkEmbedder(),
+        config=MemoryConfig(
+            dtype="bfloat16",
+            journal=False,
+            initial_capacity=TOTAL + 64,
+            max_edges=2 * TOTAL + 64,
+        ),
+        verbose=False,
+    )
+
+
+def bench_kernels(dev):
+    """Raw kernel reference numbers (honest labels: NOT the system metrics)."""
+    cap = N
     key = jax.random.PRNGKey(0)
-    emb = jax.random.normal(key, (cap + 1, DIM), jnp.bfloat16)
-    emb = S.normalize(emb)
+    emb = S.normalize(jax.random.normal(key, (cap + 1, DIM), jnp.bfloat16))
+    zeros_i = jnp.zeros((cap + 1,), jnp.int32)
     arena = S.ArenaState(
         emb=emb,
         salience=jnp.full((cap + 1,), 0.5, jnp.float32),
         timestamp=jnp.zeros((cap + 1,), jnp.float32),
         last_accessed=jnp.zeros((cap + 1,), jnp.float32),
-        access_count=jnp.zeros((cap + 1,), jnp.int32),
-        type_id=jnp.zeros((cap + 1,), jnp.int32),
-        shard_id=jnp.zeros((cap + 1,), jnp.int32),
-        tenant_id=jnp.zeros((cap + 1,), jnp.int32),
+        access_count=zeros_i, type_id=zeros_i, shard_id=zeros_i,
+        tenant_id=zeros_i,
         alive=jnp.ones((cap + 1,), bool).at[cap].set(False),
         is_super=jnp.zeros((cap + 1,), bool),
     )
     jax.block_until_ready(arena.emb)
-
-    qkey = jax.random.PRNGKey(7)
-    queries = jax.random.normal(qkey, (WARMUP + QUERIES, DIM), jnp.float32)
-
+    queries = jax.random.normal(jax.random.PRNGKey(7), (K_WARM + QUERIES, DIM),
+                                jnp.float32)
     tenant = jnp.int32(0)
-    for i in range(WARMUP):
-        s, r = S.arena_search(arena, queries[i], tenant, K)
+    for i in range(K_WARM):
+        _, r = S.arena_search(arena, queries[i], tenant, 10)
         jax.block_until_ready(r)
-
     lat = []
-    for i in range(WARMUP, WARMUP + QUERIES):
+    for i in range(K_WARM, K_WARM + QUERIES):
         t0 = time.perf_counter()
-        s, r = S.arena_search(arena, queries[i], tenant, K)
+        _, r = S.arena_search(arena, queries[i], tenant, 10)
         jax.block_until_ready(r)
         lat.append((time.perf_counter() - t0) * 1e3)
-    p50 = float(np.percentile(lat, 50))
-    p95 = float(np.percentile(lat, 95))
 
-    # Fleet serving: batched top-k, 64 queries per dispatch.
-    QB = 64
-    bq = jax.random.normal(jax.random.PRNGKey(11), (QB, DIM), jnp.float32)
-    s, r = S.arena_search(arena, bq, tenant, K)       # compile
-    jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    reps_q = 20
-    for _ in range(reps_q):
-        s, r = S.arena_search(arena, bq, tenant, K)
-    jax.block_until_ready(r)
-    batch_qps = reps_q * QB / (time.perf_counter() - t0)
-
-    # Ingest throughput: batched arena_add of 1024 memories at a time.
     B = 1024
     add_emb = jax.random.normal(jax.random.PRNGKey(3), (B, DIM), jnp.float32)
     rows = jnp.arange(B, dtype=jnp.int32)
     args = (jnp.full((B,), 0.5), jnp.zeros((B,)), jnp.zeros((B,), jnp.int32),
             jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
             jnp.zeros((B,), bool))
-    a2 = S.arena_add(arena, rows, add_emb, *args)   # compile
+    a2 = S.arena_add(arena, rows, add_emb, *args)
     jax.block_until_ready(a2.emb)
     t0 = time.perf_counter()
     reps = 20
     for _ in range(reps):
         a2 = S.arena_add(a2, rows, add_emb, *args)
     jax.block_until_ready(a2.emb)
-    ingest_per_s = reps * B / (time.perf_counter() - t0)
+    scatter_rows = reps * B / (time.perf_counter() - t0)
+    del arena, a2, emb
+    return float(np.percentile(lat, 50)), scatter_rows
+
+
+def main():
+    dev = jax.devices()[0]
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix="lz_bench_")
+
+    # --- ingest: the full end_conversation pipeline at TOTAL facts --------
+    ms = build_system(os.path.join(workdir, "db"))
+    t_ingest = 0.0
+    for c in range(CONVS):
+        ms.start_conversation()
+        ms.add_to_short_term(f"conversation {c} transcript", "episodic", 0.7)
+        t0 = time.perf_counter()
+        ms.end_conversation()
+        t_ingest += time.perf_counter() - t0
+    nodes, edges = ms.buffer.size()
+    edges_linked = ms.metrics.get("edges_linked", 0)
+    ingest_per_s = nodes / t_ingest
+
+    # --- headline: search_memories p50/p95 through the orchestrator ------
+    rng = np.random.default_rng(99)
+    probe = rng.integers(0, TOTAL, size=K_WARM + QUERIES)
+    for i in range(K_WARM):
+        ms.search_memories(f"fact {probe[i]}: user detail number {probe[i]}")
+    lat = []
+    hits_ok = 0
+    for i in range(K_WARM, K_WARM + QUERIES):
+        q = f"fact {probe[i]}: user detail number {probe[i]}"
+        t0 = time.perf_counter()
+        hits = ms.search_memories(q)
+        lat.append((time.perf_counter() - t0) * 1e3)
+        if hits and hits[0].content.startswith(f"fact {probe[i]}:"):
+            hits_ok += 1
+    p50 = float(np.percentile(lat, 50))
+    p95 = float(np.percentile(lat, 95))
+
+    # --- fleet serving: batched query path through the orchestrator ------
+    batch_qps = None
+    if hasattr(ms, "search_memories_batch"):
+        qb = [f"fact {j}: user detail number {j}"
+              for j in rng.integers(0, TOTAL, size=64)]
+        ms.search_memories_batch(qb)          # compile
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            ms.search_memories_batch(qb)
+        batch_qps = reps * len(qb) / (time.perf_counter() - t0)
+
+    ms.close()
+
+    kernel_p50, scatter_rows = bench_kernels(dev)
 
     print(json.dumps({
         "metric": "search_memories_p50_latency_1M_nodes",
@@ -101,11 +216,20 @@ def main():
         "vs_baseline": round(100.0 / p50, 2),   # reference bar: <100ms ⚡ tier
         "extra": {
             "p95_ms": round(p95, 4),
-            "batched_search_qps_64": round(batch_qps, 1),
-            "ingest_memories_per_sec_per_chip": round(ingest_per_s, 1),
-            "index_nodes": N,
+            "exact_hit_rate": round(hits_ok / QUERIES, 3),
+            "ingest_pipeline_memories_per_sec_per_chip": round(ingest_per_s, 1),
+            "ingest_total_s": round(t_ingest, 1),
+            "graph_nodes": nodes,
+            "graph_edges_live": edges,     # chain links decay+prune away (parity)
+            "edges_linked_total": edges_linked,
+            "batched_search_qps_64": (round(batch_qps, 1)
+                                      if batch_qps is not None else None),
+            # raw kernels, honest names — NOT the system metrics:
+            "arena_search_p50_ms": round(kernel_p50, 4),
+            "arena_scatter_rows_per_sec": round(scatter_rows, 1),
             "dim": DIM,
             "dtype": "bfloat16",
+            "llm_stage": "queued-canned (deterministic, zero-egress)",
             "device": str(dev),
         },
     }))

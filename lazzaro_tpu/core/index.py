@@ -242,11 +242,16 @@ class MemoryIndex:
         if tid is None:
             return empty_results(nq)
         k_eff = min(k, self.state.capacity)
-        scores, rows = S.arena_search(
-            self.state, jnp.asarray(pad_to_pow2(queries)), jnp.int32(tid),
-            k_eff, super_filter)
-        return decode_topk(np.asarray(scores)[:nq], np.asarray(rows)[:nq],
-                           self.row_to_id, S.NEG_INF)
+        out: List[Tuple[List[str], List[float]]] = []
+        for start in range(0, nq, self._QUERY_CHUNK):
+            chunk = queries[start:start + self._QUERY_CHUNK]
+            scores, rows = S.arena_search(
+                self.state, jnp.asarray(pad_to_pow2(chunk)), jnp.int32(tid),
+                k_eff, super_filter)
+            n = chunk.shape[0]
+            out.extend(decode_topk(np.asarray(scores)[:n], np.asarray(rows)[:n],
+                                   self.row_to_id, S.NEG_INF))
+        return out
 
     # ------------------------------------------------------- numeric sweeps
     def update_access(self, ids: Sequence[str], boost: float = 0.05,
@@ -343,32 +348,43 @@ class MemoryIndex:
                 out.append((node_id, float(imp)))
         return out[:k]
 
+    # Query rows per link/search dispatch: the [chunk, capacity] f32 score
+    # matrix is the HBM high-water mark (512×1M×4B ≈ 2 GB transient beside a
+    # 1.5 GB bf16 arena on a 16 GB chip). Chunking changes wall-clock ~zero:
+    # each chunk is still MXU-bound matmul + top_k.
+    _QUERY_CHUNK = 512
+
     def link_candidates(self, new_ids: Sequence[str], tenant: str, k: int = 3,
                         shard_mode: int = 0) -> Dict[str, List[Tuple[str, float]]]:
-        """Per new node: top-k (existing_id, cosine) candidates — one matmul."""
+        """Per new node: top-k (existing_id, cosine) candidates — batched
+        matmuls, chunked so the score matrix stays HBM-bounded at 1M rows."""
         rows = [self.id_to_row[i] for i in new_ids if i in self.id_to_row]
         if not rows:
             return {}
         tid = self._tenants.get(tenant)
         if tid is None:
             return {}
-        padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
-        scores, cand = S.arena_link_candidates(
-            self.state, jnp.asarray(padded), jnp.int32(tid),
-            min(k, self.state.capacity), shard_mode)
-        scores = np.asarray(scores)
-        cand = np.asarray(cand)
+        all_rows = np.asarray(rows, np.int32)
+        excl = jnp.asarray(S.pad_rows(all_rows, self.state.capacity))
         out: Dict[str, List[Tuple[str, float]]] = {}
-        for bi, node_row in enumerate(rows):
-            node_id = self.row_to_id[node_row]
-            pairs = []
-            for s, c in zip(scores[bi], cand[bi]):
-                if s <= S.NEG_INF / 2:
-                    continue
-                cid = self.row_to_id.get(int(c))
-                if cid is not None:
-                    pairs.append((cid, float(s)))
-            out[node_id] = pairs
+        for start in range(0, len(rows), self._QUERY_CHUNK):
+            chunk = all_rows[start:start + self._QUERY_CHUNK]
+            padded = S.pad_rows(chunk, self.state.capacity)
+            scores, cand = S.arena_link_candidates(
+                self.state, jnp.asarray(padded), excl, jnp.int32(tid),
+                min(k, self.state.capacity), shard_mode)
+            scores = np.asarray(scores)
+            cand = np.asarray(cand)
+            for bi, node_row in enumerate(chunk.tolist()):
+                node_id = self.row_to_id[node_row]
+                pairs = []
+                for s, c in zip(scores[bi], cand[bi]):
+                    if s <= S.NEG_INF / 2:
+                        continue
+                    cid = self.row_to_id.get(int(c))
+                    if cid is not None:
+                        pairs.append((cid, float(s)))
+                out[node_id] = pairs
         return out
 
     def merge_candidates(self, tenant: str, threshold: float = 0.95

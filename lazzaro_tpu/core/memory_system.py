@@ -164,6 +164,7 @@ class MemorySystem:
         self.metrics = {
             "embedding_calls": 0,
             "llm_calls": 0,
+            "edges_linked": 0,
             "retrieval_times": [],
             "consolidation_times": [],
         }
@@ -765,6 +766,14 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         self._log(f"✓ Extracted {len(memories)} memory candidates")
         contents = [m.get("content", "") for m in memories if m.get("content")]
         embeddings = self._batch_embed(contents)
+        try:
+            # one bulk list→array conversion for the whole batch (per-fact
+            # np.asarray over float lists was ~30% of ingest host time)
+            emb_rows = np.asarray(embeddings, np.float32)
+            if emb_rows.ndim != 2:
+                raise ValueError
+        except (ValueError, TypeError):        # ragged/failed rows: per-item
+            emb_rows = None
 
         with self._mutex:
             # Stage valid facts, then resolve near-duplicates with two
@@ -775,15 +784,20 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             # (b) one host gram matrix for duplicates WITHIN the batch.
             staged: List[Tuple[Dict, str, np.ndarray]] = []
             ei = 0
+            empty = np.empty((0,), np.float32)
             for mem in memories:
                 content = mem.get("content", "")
                 if not content:
                     continue
-                new_emb = embeddings[ei] if ei < len(embeddings) else []
+                if ei < len(embeddings):
+                    new_emb = (emb_rows[ei] if emb_rows is not None
+                               else np.asarray(embeddings[ei], np.float32))
+                else:
+                    new_emb = empty
                 ei += 1
                 if len(content) < 5:
                     continue
-                staged.append((mem, content, np.asarray(new_emb, np.float32)))
+                staged.append((mem, content, new_emb))
 
             probe: List[Tuple[Optional[str], float]] = [(None, 0.0)] * len(staged)
             probeable = [i for i, (_, _, e) in enumerate(staged)
@@ -795,13 +809,19 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 for i, (ids, scores) in zip(probeable, res):
                     if ids:
                         probe[i] = (ids[0].partition(":")[2], scores[0])
-            intra = None
+            intra_best_col = intra_best_sim = None
             if len(probeable) >= 2:
                 M = np.stack([staged[i][2] for i in probeable])
                 norms = np.linalg.norm(M, axis=1, keepdims=True)
                 norms[norms == 0] = 1.0
                 M = M / norms
                 intra = M @ M.T
+                # Per row, the best match among EARLIER batch rows — one
+                # vectorized masked argmax instead of an O(B²) Python scan.
+                n_p = len(probeable)
+                tril = np.where(np.tri(n_p, k=-1, dtype=bool), intra, -np.inf)
+                intra_best_col = np.argmax(tril, axis=1)
+                intra_best_sim = tril[np.arange(n_p), intra_best_col]
             pos_in_probeable = {i: j for j, i in enumerate(probeable)}
 
             new_nodes: List[Tuple[str, str]] = []
@@ -819,12 +839,12 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
 
                 # Best match: pre-batch arena probe vs earlier-in-batch fact.
                 target_id, best = probe[fi]
-                if intra is not None and fi in pos_in_probeable:
+                if intra_best_sim is not None and fi in pos_in_probeable:
                     row = pos_in_probeable[fi]
-                    for col in range(row):
-                        t = fact_target[probeable[col]]
-                        sim = float(intra[row, col])
-                        if t is not None and sim > best:
+                    sim = float(intra_best_sim[row])
+                    if sim > best:
+                        t = fact_target[probeable[int(intra_best_col[row])]]
+                        if t is not None:
                             target_id, best = t, sim
                 existing_node = (self.buffer.get_node(target_id)
                                  if target_id is not None
@@ -863,7 +883,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 new_nodes_data.append({
                     "id": node_id,
                     "content": content,
-                    "embedding": [float(x) for x in new_emb],
+                    "embedding": new_emb.tolist(),
                     "type": node.type,
                     "salience": node.salience,
                     "shard_key": node.shard_key,
@@ -951,6 +971,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             self._edge_shard[key] = shard.shard_key
             triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
             self._mark_edge_dirty(key)
+        self.metrics["edges_linked"] += len(edges)
         self.index.add_edges(triples, self.user_id,
                              reinforce=self.config.edge_reinforce)
 

@@ -54,6 +54,11 @@ def _extract_json_object(text: str, max_candidates: int = 20) -> str:
     braces can't eat a trailing real object), else the first balanced block,
     else the raw text — keeping the caller's own JSON error handling as the
     single point of failure."""
+    try:
+        json.loads(text)          # already-valid JSON: no scanning needed
+        return text
+    except ValueError:
+        pass
     fenced = re.search(r"```(?:json)?\s*(.*?)```", text, re.DOTALL)
     if fenced:
         inner = fenced.group(1)

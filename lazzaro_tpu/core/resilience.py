@@ -171,6 +171,10 @@ class ResilientLLM(_ResilientBase):
                 except Exception:
                     self._bump("primary_failures")
                     self.breaker.record_failure()
+                    # Re-raise: swallowing would hand the caller silently
+                    # truncated output indistinguishable from a complete
+                    # response (the pre-wrapper behavior also propagated).
+                    raise
                 else:
                     self.breaker.record_success()
                 return

@@ -321,19 +321,24 @@ def arena_search(
 @functools.partial(jax.jit, static_argnames=("k", "shard_mode"))
 def arena_link_candidates(
     state: ArenaState,
-    new_rows: jax.Array,   # [B] i32 rows of newly added nodes
+    new_rows: jax.Array,   # [B] i32 rows to find candidates FOR (query chunk)
+    excl_rows: jax.Array,  # [E] i32 rows excluded as candidates (ALL new rows)
     tenant: jax.Array,
     k: int,
     shard_mode: int = 0,   # 0: any shard, 1: same shard only, -1: other shards only
 ) -> Tuple[jax.Array, jax.Array]:
     """For each new node, top-k most similar existing nodes (excluding self and
     other new rows). One batched matmul replaces reference hot loops #2/#3
-    (``memory_system.py:797-836`` within-shard, ``:838-891`` cross-shard)."""
+    (``memory_system.py:797-836`` within-shard, ``:838-891`` cross-shard).
+
+    ``new_rows`` may be a CHUNK of the full batch (the [B, cap+1] score matrix
+    is what bounds HBM at 1M rows); ``excl_rows`` always carries every new row
+    so chunking never lets one new node surface as another's candidate."""
     q = state.emb[new_rows]                       # [B, d]
     scores = (q @ state.emb.T).astype(jnp.float32)  # [B, cap+1]
     mask = state.alive & (state.tenant_id == tenant) & ~state.is_super
     # exclude the new rows themselves from candidates
-    excl = jnp.zeros((state.emb.shape[0],), bool).at[new_rows].set(True)
+    excl = jnp.zeros((state.emb.shape[0],), bool).at[excl_rows].set(True)
     mask = mask & ~excl
     full_mask = mask[None, :]
     if shard_mode != 0:

@@ -1,0 +1,104 @@
+"""Worker for the two-process `jax.distributed` smoke test.
+
+Run by tests/test_distributed.py as a subprocess pair:
+
+    python distributed_worker.py <process_id> <num_processes> <coord_port>
+
+Each process brings 4 virtual CPU devices (8 global), calls
+``jax.distributed.initialize``, builds ``make_hybrid_mesh``, and drives the
+two multi-host paths SURVEY §2.3 requires: the sharded top-k collective and
+a data-parallel encoder train step. Prints one "DIST_OK ..." line on
+success; any assertion kills the pair.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs
+    assert jax.device_count() == 4 * nprocs
+    assert len(jax.local_devices()) == 4
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from lazzaro_tpu.parallel.mesh import make_hybrid_mesh
+    from lazzaro_tpu.ops.topk import make_sharded_topk
+
+    # CPU exposes no slice topology → one size-1 DCN axis over a single
+    # 8-wide ICI group; consumers never special-case slice count.
+    mesh = make_hybrid_mesh(("data",), (4 * nprocs,))
+    assert mesh.shape["slice"] == 1 and mesh.shape["data"] == 4 * nprocs
+
+    # ---- sharded top-k across both processes ----------------------------
+    N, D, K = 512, 32, 8
+    rng = np.random.default_rng(0)           # same data on every process
+    emb = rng.standard_normal((N, D)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    mask = np.ones((N,), bool)
+    mask[::7] = False
+    query = rng.standard_normal((3, D)).astype(np.float32)
+
+    mat_sh = NamedSharding(mesh, P("data", None))
+    row_sh = NamedSharding(mesh, P("data"))
+    emb_g = jax.make_array_from_callback(
+        emb.shape, mat_sh, lambda idx: emb[idx])
+    mask_g = jax.make_array_from_callback(
+        mask.shape, row_sh, lambda idx: mask[idx])
+
+    search = make_sharded_topk(mesh, axis="data", k=K)
+    scores, rows = search(emb_g, mask_g, query)
+    scores = np.asarray(scores)              # out_specs replicated → local
+    rows = np.asarray(rows)
+
+    ref = (query @ emb.T)
+    ref[:, ~mask] = -np.inf
+    ref_rows = np.argsort(-ref, axis=1)[:, :K]
+    ref_scores = np.take_along_axis(ref, ref_rows, axis=1)
+    assert np.allclose(np.sort(scores, axis=1),
+                       np.sort(ref_scores, axis=1), atol=1e-5), "top-k scores"
+    assert (np.sort(rows, axis=1) == np.sort(ref_rows, axis=1)).all(), "top-k rows"
+
+    # ---- data-parallel encoder train step over the 2-process mesh -------
+    import optax
+    from lazzaro_tpu.models.encoder import (EncoderConfig, TextEncoder,
+                                            make_encoder_train_step)
+
+    cfg = EncoderConfig.tiny()
+    enc = TextEncoder(cfg, seed=0)           # same seed → replicated params
+    opt = optax.adam(1e-3)
+    # DP over the hybrid mesh's ICI axis: works because the step only names
+    # the 'data' axis and the size-1 'slice' axis shards nothing.
+    step = make_encoder_train_step(cfg, opt, mesh=mesh)
+    texts = [f"sentence number {i} about topic {i % 4}" for i in range(8)]
+    para = [f"a paraphrase {i} of topic {i % 4}" for i in range(8)]
+    q_ids = jnp.asarray(enc.tokenizer.batch_encode(texts, cfg.max_len), jnp.int32)
+    p_ids = jnp.asarray(enc.tokenizer.batch_encode(para, cfg.max_len), jnp.int32)
+    params, opt_state = enc.params, opt.init(enc.params)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, q_ids, p_ids)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    print(f"DIST_OK pid={pid} topk=pass loss0={losses[0]:.6f} "
+          f"loss2={losses[-1]:.6f}", flush=True)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -203,3 +203,25 @@ def test_integrations_module_guarded_imports():
     # langgraph/adk integrations have no hard deps → always exported
     assert "LazzaroLangGraph" in integ.__all__
     assert "LazzaroADKPlugin" in integ.__all__
+
+
+def test_dashboard_search_and_inspector_markup(dashboard):
+    """The explorer's interactive affordances (parity with reference
+    templates/index.html:105-110 search, :312-322 match+centerAt+zoom,
+    :233-251/:363 node-click inspector) are present and wired."""
+    base, _ = dashboard
+    _, html = _get(base, "/")
+    # search input wired to the match flow
+    assert 'id="search"' in html
+    assert "Search memories..." in html
+    assert "searchNodes" in html
+    # match + centerAt + zoom (3.5x, 1s — same targets as the reference)
+    assert "centerAt(" in html
+    assert "3.5" in html
+    # click-to-inspect inspector panel with the reference's fields + neighbors
+    assert 'id="inspector"' in html
+    assert "selectNode" in html
+    assert 'addEventListener("click"' in html
+    for field in ("ins-content", "ins-salience", "ins-access", "ins-shard",
+                  "ins-neighbors"):
+        assert field in html

@@ -197,8 +197,9 @@ def test_degraded_raising_stays_inside_wrapper():
 
 
 def test_mid_stream_failure_counted_by_breaker():
-    """A stream dying AFTER the first chunk can't be restarted, but it must
-    still be visible to the breaker (advisor r1: resilience.py:150-160)."""
+    """A stream dying AFTER the first chunk can't be restarted; it must be
+    visible to the breaker (advisor r1: resilience.py:150-160) AND to the
+    caller — swallowing it would disguise truncated output as complete."""
     class MidStreamDeath:
         def completion(self, messages, response_format=None):
             return "fallback text"
@@ -211,8 +212,11 @@ def test_mid_stream_failure_counted_by_breaker():
     clock = FakeClock()
     llm = ResilientLLM(MidStreamDeath(), breaker_threshold=2, clock=clock)
     for _ in range(2):
-        chunks = list(llm.completion_stream(MSG))
-        assert chunks[:2] == ["first chunk ", "second chunk "]
+        chunks = []
+        with pytest.raises(ConnectionError):
+            for c in llm.completion_stream(MSG):
+                chunks.append(c)
+        assert chunks == ["first chunk ", "second chunk "]
     h = llm.health()
     assert h["primary_failures"] == 2
     assert llm.breaker.state == "open"
