@@ -111,34 +111,40 @@ def build_system(db_dir: str) -> MemorySystem:
 
 
 def bench_kernels(dev):
-    """Raw kernel reference numbers (honest labels: NOT the system metrics)."""
-    cap = N
+    """Raw kernel reference numbers (honest labels: NOT the system metrics).
+    A/Bs the XLA one-matmul top-k against the blocked Pallas kernel that
+    ``arena_search`` auto-dispatches to on block-aligned TPU arenas."""
+    n_rows = -(-(N + 1) // S.TOPK_BLOCK) * S.TOPK_BLOCK  # arena alignment rule
     key = jax.random.PRNGKey(0)
-    emb = S.normalize(jax.random.normal(key, (cap + 1, DIM), jnp.bfloat16))
-    zeros_i = jnp.zeros((cap + 1,), jnp.int32)
+    emb = S.normalize(jax.random.normal(key, (n_rows, DIM), jnp.bfloat16))
+    zeros_i = jnp.zeros((n_rows,), jnp.int32)
     arena = S.ArenaState(
         emb=emb,
-        salience=jnp.full((cap + 1,), 0.5, jnp.float32),
-        timestamp=jnp.zeros((cap + 1,), jnp.float32),
-        last_accessed=jnp.zeros((cap + 1,), jnp.float32),
+        salience=jnp.full((n_rows,), 0.5, jnp.float32),
+        timestamp=jnp.zeros((n_rows,), jnp.float32),
+        last_accessed=jnp.zeros((n_rows,), jnp.float32),
         access_count=zeros_i, type_id=zeros_i, shard_id=zeros_i,
         tenant_id=zeros_i,
-        alive=jnp.ones((cap + 1,), bool).at[cap].set(False),
-        is_super=jnp.zeros((cap + 1,), bool),
+        alive=jnp.ones((n_rows,), bool).at[N:].set(False),
+        is_super=jnp.zeros((n_rows,), bool),
     )
     jax.block_until_ready(arena.emb)
     queries = jax.random.normal(jax.random.PRNGKey(7), (K_WARM + QUERIES, DIM),
                                 jnp.float32)
     tenant = jnp.int32(0)
-    for i in range(K_WARM):
-        _, r = S.arena_search(arena, queries[i], tenant, 10)
-        jax.block_until_ready(r)
-    lat = []
-    for i in range(K_WARM, K_WARM + QUERIES):
-        t0 = time.perf_counter()
-        _, r = S.arena_search(arena, queries[i], tenant, 10)
-        jax.block_until_ready(r)
-        lat.append((time.perf_counter() - t0) * 1e3)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    lat_by_impl = {}
+    for impl in (("xla", "pallas") if on_tpu else ("xla",)):
+        for i in range(K_WARM):
+            _, r = S.arena_search(arena, queries[i], tenant, 10, impl=impl)
+            jax.block_until_ready(r)
+        lat_by_impl[impl] = []
+        for i in range(K_WARM, K_WARM + QUERIES):
+            t0 = time.perf_counter()
+            _, r = S.arena_search(arena, queries[i], tenant, 10, impl=impl)
+            jax.block_until_ready(r)
+            lat_by_impl[impl].append((time.perf_counter() - t0) * 1e3)
+    lat = lat_by_impl.get("pallas", lat_by_impl["xla"])
 
     B = 1024
     add_emb = jax.random.normal(jax.random.PRNGKey(3), (B, DIM), jnp.float32)
@@ -155,7 +161,8 @@ def bench_kernels(dev):
     jax.block_until_ready(a2.emb)
     scatter_rows = reps * B / (time.perf_counter() - t0)
     del arena, a2, emb
-    return float(np.percentile(lat, 50)), scatter_rows
+    p50s = {impl: float(np.percentile(l, 50)) for impl, l in lat_by_impl.items()}
+    return p50s, scatter_rows
 
 
 def main():
@@ -207,7 +214,7 @@ def main():
 
     ms.close()
 
-    kernel_p50, scatter_rows = bench_kernels(dev)
+    kernel_p50s, scatter_rows = bench_kernels(dev)
 
     print(json.dumps({
         "metric": "search_memories_p50_latency_1M_nodes",
@@ -225,7 +232,10 @@ def main():
             "batched_search_qps_64": (round(batch_qps, 1)
                                       if batch_qps is not None else None),
             # raw kernels, honest names — NOT the system metrics:
-            "arena_search_p50_ms": round(kernel_p50, 4),
+            "arena_search_xla_p50_ms": round(kernel_p50s["xla"], 4),
+            "arena_search_pallas_p50_ms": (
+                round(kernel_p50s["pallas"], 4)
+                if "pallas" in kernel_p50s else None),
             "arena_scatter_rows_per_sec": round(scatter_rows, 1),
             "dim": DIM,
             "dtype": "bfloat16",

@@ -65,19 +65,21 @@ class MemoryIndex:
 
     # -------------------------------------------------------------- sharding
     def _round_capacity(self, capacity: int) -> int:
-        """Row counts include the +1 sentinel; under a mesh the TOTAL must
-        divide evenly across the axis, so round capacity+1 up."""
-        if self._n_parts <= 1:
-            return capacity
+        """Row counts include the +1 sentinel. Two alignment rules, both
+        satisfied by rounding capacity+1 up: TOPK_BLOCK multiples let
+        ``arena_search`` take the blocked Pallas top-k without ever padding
+        the embedding matrix (extra rows are ordinary free capacity), and
+        under a mesh the TOTAL must divide evenly across the axis."""
         total = capacity + 1
-        total = -(-total // self._n_parts) * self._n_parts
+        if total >= S.TOPK_BLOCK:
+            total = -(-total // S.TOPK_BLOCK) * S.TOPK_BLOCK
+        if self._n_parts > 1:
+            total = -(-total // self._n_parts) * self._n_parts
         return total - 1
 
     def _grown_capacity(self, old_capacity: int) -> int:
-        """Doubling that preserves mesh divisibility of capacity+1."""
-        if self._n_parts <= 1:
-            return old_capacity * 2
-        return (old_capacity + 1) * 2 - 1
+        """Doubling that preserves block and mesh alignment of capacity+1."""
+        return self._round_capacity((old_capacity + 1) * 2 - 1)
 
     def _reshard(self, pytree):
         """Constrain every column to its row sharding (the only 2-D leaf,
@@ -247,7 +249,9 @@ class MemoryIndex:
             chunk = queries[start:start + self._QUERY_CHUNK]
             scores, rows = S.arena_search(
                 self.state, jnp.asarray(pad_to_pow2(chunk)), jnp.int32(tid),
-                k_eff, super_filter)
+                k_eff, super_filter,
+                # pallas_call has no GSPMD rule — sharded arenas stay on XLA
+                impl="xla" if self.mesh is not None else "auto")
             n = chunk.shape[0]
             out.extend(decode_topk(np.asarray(scores)[:n], np.asarray(rows)[:n],
                                    self.row_to_id, S.NEG_INF))

@@ -102,3 +102,27 @@ def masked_topk_auto(emb, madd, queries, k=10, block_rows=4096):
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     return pallas_masked_topk(emb, madd, queries, k=k, block_rows=block_rows,
                               interpret=not on_tpu)
+
+
+# One embedding block's VMEM budget: blocks are double-buffered and the
+# scoped-vmem ceiling is 16 MB, so ~6 MB per block leaves room for the
+# [Q, blk] f32 score tile and outputs (blk=8192 at d=768 OOMs — measured).
+_BLOCK_BYTES = 6 * 1024 * 1024
+
+
+def masked_topk_arena(emb: jax.Array, mask: jax.Array, queries: jax.Array,
+                      k: int = 10) -> Tuple[jax.Array, jax.Array]:
+    """The ``arena_search`` serving path: boolean mask → additive mask, block
+    size fitted to VMEM for the embedding dtype/width. Requires
+    ``emb.shape[0] %% block == 0`` — arenas allocate row counts in
+    ``state.TOPK_BLOCK`` multiples precisely so no padded copy of the matrix
+    is ever made here."""
+    n, d = emb.shape
+    blk = 4096
+    while blk > 512 and blk * d * emb.dtype.itemsize > _BLOCK_BYTES:
+        blk //= 2
+    assert n % blk == 0, f"arena rows {n} not a multiple of block {blk}"
+    madd = jnp.where(mask, 0.0, NEG).astype(jnp.float32)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    return pallas_masked_topk(emb, madd, queries.astype(emb.dtype),
+                              k=k, block_rows=blk, interpret=not on_tpu)

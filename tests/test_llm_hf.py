@@ -114,3 +114,81 @@ def test_from_hf_rejects_non_gemma():
     bert = transformers.BertModel(cfg)
     with pytest.raises(ValueError, match="gemma"):
         LanguageModel.from_hf(bert)
+
+
+# ---------------------------------------------------------------- Gemma-2
+
+
+@pytest.fixture(scope="module")
+def hf_gemma2():
+    """Random-init local Gemma-2 with every family feature on: softcapping,
+    sandwich norms, alternating local/global attention, query_pre_attn_scalar.
+    4 layers so BOTH sliding (0,2) and global (1,3) layers are exercised;
+    sliding_window=8 < seq length so the window actually masks."""
+    cfg = transformers.Gemma2Config(
+        vocab_size=VOCAB, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64, rope_theta=10000.0,
+        attention_bias=False, hidden_activation="gelu_pytorch_tanh",
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        sliding_window=8, query_pre_attn_scalar=16,
+        pad_token_id=0, bos_token_id=2, eos_token_id=1)
+    torch.manual_seed(0)
+    model = transformers.Gemma2ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_gemma2_logits_match_hf(hf_gemma2):
+    lm = LanguageModel.from_hf(hf_gemma2, max_seq=64)
+    assert lm.cfg.attn_softcap == 50.0 and lm.cfg.final_softcap == 30.0
+    assert lm.cfg.post_norms and lm.cfg.sliding_window == 8
+    rng = np.random.RandomState(0)
+    T = 20                                   # > sliding_window: window bites
+    ids = rng.randint(3, VOCAB, (2, T))
+    with torch.no_grad():
+        ref = hf_gemma2(input_ids=torch.tensor(ids)).logits.numpy()
+    positions = np.broadcast_to(np.arange(T)[None, :], (2, T))
+    ours, _ = lm.model.apply({"params": lm.params},
+                             jnp.asarray(ids), jnp.asarray(positions))
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=1e-4, rtol=1e-4)
+
+
+def test_gemma2_greedy_continuation_matches_hf(hf_gemma2):
+    """KV-cache decode (with the sliding-window mask applied against cache
+    positions) chains identically to HF's full re-forward."""
+    lm = LanguageModel.from_hf(hf_gemma2, max_seq=64)
+    rng = np.random.RandomState(1)
+    ids = list(rng.randint(3, VOCAB, (10,)))
+
+    hf_ids = list(ids)
+    with torch.no_grad():
+        for _ in range(8):
+            logits = hf_gemma2(input_ids=torch.tensor([hf_ids])).logits
+            hf_ids.append(int(logits[0, -1].argmax()))
+
+    tokens = jnp.asarray([ids], jnp.int32)
+    positions = jnp.arange(len(ids))[None, :]
+    caches = lm._empty_cache(1)
+    logits, caches = lm._prefill(lm.params, tokens, positions, caches)
+    ours = list(ids)
+    pos = len(ids)
+    for _ in range(8):
+        nxt = int(np.asarray(logits[0]).argmax())
+        ours.append(nxt)
+        logits, caches = lm._decode_one(
+            lm.params, jnp.asarray([nxt], jnp.int32),
+            jnp.asarray([pos], jnp.int32), caches)
+        pos += 1
+    assert ours == hf_ids
+
+
+def test_gemma1_still_rejected_families(hf_model):
+    class FakeCfg:
+        model_type = "llama"
+
+    class FakeModel:
+        config = FakeCfg()
+
+    with pytest.raises(ValueError, match="gemma"):
+        LanguageModel.from_hf(FakeModel())
