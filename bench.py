@@ -144,7 +144,6 @@ def bench_kernels(dev):
             _, r = S.arena_search(arena, queries[i], tenant, 10, impl=impl)
             jax.block_until_ready(r)
             lat_by_impl[impl].append((time.perf_counter() - t0) * 1e3)
-    lat = lat_by_impl.get("pallas", lat_by_impl["xla"])
 
     B = 1024
     add_emb = jax.random.normal(jax.random.PRNGKey(3), (B, DIM), jnp.float32)

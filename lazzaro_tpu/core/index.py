@@ -51,7 +51,7 @@ class MemoryIndex:
         # precision (raw unix seconds ~1.7e9 would quantize to ~2 minutes).
         self.epoch = float(epoch if epoch is not None else time.time())
         capacity = self._round_capacity(capacity)
-        edge_capacity = self._round_capacity(edge_capacity)
+        edge_capacity = self._round_capacity(edge_capacity, block=False)
         self.state = S.init_arena(capacity, dim, dtype)
         self.edge_state = S.init_edges(edge_capacity)
         self._free_rows: List[int] = list(range(capacity - 1, -1, -1))
@@ -64,22 +64,23 @@ class MemoryIndex:
         self.tenant_nodes: Dict[str, set] = {}
 
     # -------------------------------------------------------------- sharding
-    def _round_capacity(self, capacity: int) -> int:
+    def _round_capacity(self, capacity: int, block: bool = True) -> int:
         """Row counts include the +1 sentinel. Two alignment rules, both
         satisfied by rounding capacity+1 up: TOPK_BLOCK multiples let
         ``arena_search`` take the blocked Pallas top-k without ever padding
-        the embedding matrix (extra rows are ordinary free capacity), and
+        the embedding matrix (extra rows are ordinary free capacity;
+        node arena only — edges never go through the blocked kernel), and
         under a mesh the TOTAL must divide evenly across the axis."""
         total = capacity + 1
-        if total >= S.TOPK_BLOCK:
+        if block and total >= S.TOPK_BLOCK:
             total = -(-total // S.TOPK_BLOCK) * S.TOPK_BLOCK
         if self._n_parts > 1:
             total = -(-total // self._n_parts) * self._n_parts
         return total - 1
 
-    def _grown_capacity(self, old_capacity: int) -> int:
+    def _grown_capacity(self, old_capacity: int, block: bool = True) -> int:
         """Doubling that preserves block and mesh alignment of capacity+1."""
-        return self._round_capacity((old_capacity + 1) * 2 - 1)
+        return self._round_capacity((old_capacity + 1) * 2 - 1, block=block)
 
     def _reshard(self, pytree):
         """Constrain every column to its row sharding (the only 2-D leaf,
@@ -465,7 +466,7 @@ class MemoryIndex:
     def _alloc_edge_slots(self, n: int) -> List[int]:
         while len(self._free_edge_slots) < n:
             old = self.edge_state.capacity
-            new = self._grown_capacity(old)
+            new = self._grown_capacity(old, block=False)
             self.edge_state = S.grow_edges(self.edge_state, new)
             self._free_edge_slots = list(range(new - 1, old - 1, -1)) + self._free_edge_slots
         return [self._free_edge_slots.pop() for _ in range(n)]

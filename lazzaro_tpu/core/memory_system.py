@@ -880,16 +880,18 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 created_embs.append(new_emb)
                 fact_target.append(node_id)
                 new_nodes.append((node_id, shard_key))
-                new_nodes_data.append({
-                    "id": node_id,
-                    "content": content,
-                    "embedding": new_emb.tolist(),
-                    "type": node.type,
-                    "salience": node.salience,
-                    "shard_key": node.shard_key,
-                    "timestamp": node.timestamp,
-                    "decay_pass": self._decay_pass,
-                })
+                if new_emb.size != self.embed_dim:
+                    # wrong-dim/missing vector: the rare irregular row goes
+                    # through the dict path (vector omitted = NULL)
+                    new_nodes_data.append({
+                        "id": node_id,
+                        "content": content,
+                        "type": node.type,
+                        "salience": node.salience,
+                        "shard_key": node.shard_key,
+                        "timestamp": node.timestamp,
+                        "decay_pass": self._decay_pass,
+                    })
 
             # ONE arena scatter for every new node, ONE touch for all merges.
             arena_new = [(n, e) for n, e in zip(created, created_embs)
@@ -908,6 +910,32 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 self.index.merge_touch([self._q(i) for i in merge_ids],
                                        merge_sals)
 
+            # Persist fresh nodes: columnar bulk path when the store has it
+            # (one flat embedding buffer, no per-row dicts) — ingest hot
+            # path; dict rows for protocol-parity stores and irregular rows.
+            # arena_new is exactly the full-dim subset: arena and store can
+            # never disagree about which nodes carry vectors.
+            regular = arena_new
+            if regular:
+                if hasattr(self.store, "add_nodes_columns"):
+                    self.store.add_nodes_columns(
+                        ids=[n.id for n, _ in regular],
+                        contents=[n.content for n, _ in regular],
+                        embeddings=np.stack([e for _, e in regular]),
+                        types=[n.type for n, _ in regular],
+                        saliences=[n.salience for n, _ in regular],
+                        timestamps=[n.timestamp for n, _ in regular],
+                        shard_keys=[n.shard_key or "" for n, _ in regular],
+                        decay_pass=self._decay_pass,
+                        user_id=self.user_id)
+                else:
+                    new_nodes_data.extend({
+                        "id": n.id, "content": n.content,
+                        "embedding": e.tolist(), "type": n.type,
+                        "salience": n.salience, "shard_key": n.shard_key,
+                        "timestamp": n.timestamp,
+                        "decay_pass": self._decay_pass,
+                    } for n, e in regular)
             if new_nodes_data:
                 self.store.add_nodes(new_nodes_data, user_id=self.user_id)
 

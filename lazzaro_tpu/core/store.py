@@ -37,7 +37,7 @@ import os
 import tempfile
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
@@ -456,6 +456,51 @@ class ArrowStore:
         with self._lock:
             self._append_segment("nodes", user_id,
                                  pa.Table.from_pylist(rows, schema=_NODE_SCHEMA))
+
+    def add_nodes_columns(self, ids: Sequence[str], contents: Sequence[str],
+                          embeddings: np.ndarray, types: Sequence[str],
+                          saliences: Sequence[float],
+                          timestamps: Sequence[float],
+                          shard_keys: Sequence[str], decay_pass: int = 0,
+                          user_id: str = "default") -> None:
+        """Columnar bulk insert for the ingest hot path: fresh nodes only
+        (access_count 0, no hierarchy fields). The embedding column is built
+        from ONE flat float32 buffer + offsets instead of n×d Python floats
+        — at 5k × 768 this is the difference between ~1 s and ~50 ms per
+        conversation of store time. Semantics identical to ``add_nodes``
+        with the same field defaults (one delta segment, last-wins)."""
+        n = len(ids)
+        if n == 0:
+            return
+        emb = np.ascontiguousarray(np.asarray(embeddings, np.float32))
+        if emb.ndim != 2 or emb.shape[0] != n:
+            raise ValueError(f"embeddings must be [n, d], got {emb.shape}")
+        d = emb.shape[1]
+        now = time.time()
+        offsets = pa.array(np.arange(0, (n + 1) * d, d, dtype=np.int32),
+                           type=pa.int32())
+        emb_col = pa.ListArray.from_arrays(offsets, pa.array(emb.reshape(-1)))
+        cols = [
+            pa.array(list(ids), pa.string()),
+            pa.array([user_id] * n, pa.string()),
+            pa.array(list(contents), pa.string()),
+            emb_col,
+            pa.array(list(types), pa.string()),
+            pa.array(np.asarray(timestamps, np.float64)),
+            pa.array(np.zeros(n, np.int64)),            # access_count
+            pa.array(np.full(n, now, np.float64)),      # last_accessed
+            pa.array(np.asarray(saliences, np.float64)),
+            pa.array(np.zeros(n, bool)),                # is_super_node
+            pa.array(["[]"] * n, pa.string()),          # child_ids
+            pa.array([""] * n, pa.string()),            # parent_id
+            pa.array(list(shard_keys), pa.string()),
+            pa.array(["{}"] * n, pa.string()),          # metadata
+            pa.array(np.full(n, decay_pass, np.int64)),
+            pa.array(np.zeros(n, bool)),                # _deleted
+        ]
+        t = pa.Table.from_arrays(cols, schema=_NODE_SCHEMA)
+        with self._lock:
+            self._append_segment("nodes", user_id, t)
 
     def get_nodes(self, user_id: str = "default") -> List[Dict[str, Any]]:
         with self._lock:

@@ -110,6 +110,13 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 class Attention(nn.Module):
     cfg: LMConfig
     local: bool = False      # sliding-window layer (Gemma-2 alternation)
+    # Sequence parallelism: when set, cache-less attention runs as ring
+    # attention over ``seq_axis`` (ppermute ring, O(T/n·d) memory per chip),
+    # composed with data parallelism over ``dp_axis``. Long context is a
+    # first-class property of the model, not just a standalone kernel.
+    seq_mesh: Optional[Mesh] = None
+    seq_axis: str = "sp"
+    dp_axis: Optional[str] = "data"
 
     @nn.compact
     def __call__(self, x, positions, cache: Optional[Dict] = None):
@@ -125,12 +132,28 @@ class Attention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
 
+        if cache is None and self.seq_mesh is not None:
+            from lazzaro_tpu.parallel.ring_attention import make_ring_attention
+            ring = make_ring_attention(self.seq_mesh, self.seq_axis,
+                                       batch_axis=self.dp_axis)
+            # K/V go through the ring at Hkv heads; the GQA repeat happens
+            # per block inside the ring, so ppermute traffic and per-chip KV
+            # memory stay O(T/n · Hkv · D), not O(T/n · H · D).
+            out = ring(q, k, v).astype(dt)
+            out = nn.DenseGeneral(cfg.hidden, axis=(-2, -1), use_bias=False,
+                                  dtype=dt, name="o")(out)
+            return out, None
+
         assert cfg.attn_impl in ("xla", "flash", "auto"), \
             f"attn_impl must be 'xla', 'flash' or 'auto', got {cfg.attn_impl!r}"
         impl = cfg.attn_impl
-        if impl == "auto":      # default: fused kernel on TPU, einsum elsewhere
+        if impl == "auto":
+            # In-module fallback for DIRECT Decoder users (the factories
+            # resolve 'auto' mesh-aware via _resolve_attn_impl first, so a
+            # concrete impl arrives here). Mesh-blind, so be conservative:
+            # flash only when the process can't even GSPMD-shard (1 device).
             impl = ("flash" if jax.default_backend() in ("tpu", "axon")
-                    else "xla")
+                    and jax.device_count() == 1 else "xla")
         # The fused kernel covers the standard path; softcapped / windowed /
         # rescaled layers (Gemma-2) take the materialized-scores path.
         flash_ok = (cfg.attn_softcap == 0 and cfg.query_scale == 0
@@ -191,10 +214,16 @@ class MLP(nn.Module):
 class Block(nn.Module):
     cfg: LMConfig
     local: bool = False
+    seq_mesh: Optional[Mesh] = None
+    seq_axis: str = "sp"
+    dp_axis: Optional[str] = "data"
 
     @nn.compact
     def __call__(self, x, positions, cache=None):
-        h, new_cache = Attention(self.cfg, local=self.local, name="attn")(
+        h, new_cache = Attention(self.cfg, local=self.local,
+                                 seq_mesh=self.seq_mesh,
+                                 seq_axis=self.seq_axis,
+                                 dp_axis=self.dp_axis, name="attn")(
             RMSNorm(name="ln1")(x), positions, cache)
         if self.cfg.post_norms:
             # Gemma-2 sandwich norms: normalize each sublayer OUTPUT before
@@ -211,6 +240,9 @@ class Block(nn.Module):
 
 class Decoder(nn.Module):
     cfg: LMConfig
+    seq_mesh: Optional[Mesh] = None
+    seq_axis: str = "sp"
+    dp_axis: Optional[str] = "data"
 
     @nn.compact
     def __call__(self, tokens, positions, caches=None):
@@ -226,8 +258,9 @@ class Decoder(nn.Module):
             # Gemma-2 alternation: EVEN layers slide, odd attend globally
             # (HF Gemma2: is_sliding = not bool(layer_idx % 2)).
             local = cfg.sliding_window > 0 and i % 2 == 0
-            x, nc = Block(cfg, local=local, name=f"block_{i}")(
-                x, positions, cache_i)
+            x, nc = Block(cfg, local=local, seq_mesh=self.seq_mesh,
+                          seq_axis=self.seq_axis, dp_axis=self.dp_axis,
+                          name=f"block_{i}")(x, positions, cache_i)
             new_caches.append(nc)
         x = RMSNorm(name="ln_f")(x)
         logits = (x.astype(jnp.float32) @ emb.T.astype(jnp.float32))
@@ -318,15 +351,10 @@ def _resolve_attn_impl(cfg: LMConfig, mesh: Optional[Mesh]) -> LMConfig:
     return cfg
 
 
-def make_train_step(cfg: LMConfig, optimizer, mesh: Optional[Mesh] = None):
-    """Next-token CE train step. With a mesh: batch over 'data', params over
-    'model' (call ``shard_params`` on params and optimizer state first).
-    attn_impl='flash' (the single-device-TPU 'auto' resolution) now fuses
-    BOTH directions: the VJP recomputes scores blockwise from the stored
-    log-sum-exp, so training peak HBM is O(T·D) — measured 101 MB vs
-    8.7 GB for materialized scores at T=8192 (ops/flash_attention.py)."""
-    cfg = _resolve_attn_impl(cfg, mesh)
-    model = Decoder(cfg)
+def _make_ce_train_step(model: Decoder, optimizer, tok_sharding=None):
+    """Shared next-token-CE step body: one implementation, so the
+    seq-parallel path can never diverge from the oracle it is tested
+    against. ``tok_sharding`` (when given) constrains tokens AND mask."""
 
     def loss_fn(params, tokens, mask):
         B, T = tokens.shape
@@ -340,15 +368,51 @@ def make_train_step(cfg: LMConfig, optimizer, mesh: Optional[Mesh] = None):
         return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
     def train_step(params, opt_state, tokens, mask):
-        if mesh is not None:
-            tokens = jax.lax.with_sharding_constraint(
-                tokens, NamedSharding(mesh, P("data", None)))
+        if tok_sharding is not None:
+            tokens = jax.lax.with_sharding_constraint(tokens, tok_sharding)
+            mask = jax.lax.with_sharding_constraint(mask, tok_sharding)
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
     return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def make_train_step(cfg: LMConfig, optimizer, mesh: Optional[Mesh] = None):
+    """Next-token CE train step. With a mesh: batch over 'data', params over
+    'model' (call ``shard_params`` on params and optimizer state first).
+    attn_impl='flash' (the single-device-TPU 'auto' resolution) fuses
+    BOTH directions: the VJP recomputes scores blockwise from the stored
+    log-sum-exp, so training peak HBM is O(T·D) — measured 101 MB vs
+    8.7 GB for materialized scores at T=8192 (ops/flash_attention.py)."""
+    cfg = _resolve_attn_impl(cfg, mesh)
+    sharding = (NamedSharding(mesh, P("data", None))
+                if mesh is not None else None)
+    return _make_ce_train_step(Decoder(cfg), optimizer, sharding)
+
+
+def make_seq_parallel_train_step(cfg: LMConfig, optimizer, mesh: Mesh,
+                                 seq_axis: str = "sp",
+                                 dp_axis: Optional[str] = "data"):
+    """Long-context train step: activations sharded along TIME over
+    ``seq_axis`` (ring attention via ppermute — per-chip attention memory is
+    O(T/n·d)), composed with batch data-parallelism over ``dp_axis``. This is
+    how sequences far beyond one chip's HBM train: the [B, T] token block is
+    laid out (dp, sp) over the mesh, every elementwise/matmul op partitions
+    along T for free under GSPMD, and only attention pays ring hops on ICI.
+
+    Gemma-2 softcap/sliding-window/rescaled attention is not expressible on
+    the ring kernel yet — rejected explicitly rather than silently wrong."""
+    if cfg.attn_softcap or cfg.sliding_window or cfg.query_scale:
+        raise ValueError(
+            "sequence-parallel training supports standard scaled-dot-product "
+            "attention only (no softcap/sliding-window/query_scale)")
+    if dp_axis is not None and dp_axis not in mesh.axis_names:
+        dp_axis = None
+    model = Decoder(cfg, seq_mesh=mesh, seq_axis=seq_axis, dp_axis=dp_axis)
+    return _make_ce_train_step(model, optimizer,
+                               NamedSharding(mesh, P(dp_axis, seq_axis)))
 
 
 # ---------------------------------------------------------------------------

@@ -26,8 +26,14 @@ NEG = -1e30
 def _block_attn(q, k, v, q_pos, k_pos, m, l, acc, scale):
     """One streaming-softmax accumulation step.
 
-    q [B,Tq,H,D], k/v [B,Tk,H,D], *_pos [Tq]/[Tk] global positions,
-    m/l [B,H,Tq] running max / denominator, acc [B,H,Tq,D]."""
+    q [B,Tq,H,D], k/v [B,Tk,Hkv,D] with H %% Hkv == 0 (the GQA repeat is
+    done HERE, per block, so ring hops move only Hkv heads), *_pos
+    [Tq]/[Tk] global positions, m/l [B,H,Tq] running max / denominator,
+    acc [B,H,Tq,D]."""
+    H, Hkv = q.shape[2], k.shape[2]
+    if H != Hkv:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]  # causal
     scores = jnp.where(mask, scores, NEG)
@@ -41,9 +47,13 @@ def _block_attn(q, k, v, q_pos, k_pos, m, l, acc, scale):
     return m_new, l_new, acc_new
 
 
-def make_ring_attention(mesh: Mesh, axis: str = "sp"):
+def make_ring_attention(mesh: Mesh, axis: str = "sp",
+                        batch_axis: str | None = None):
     """Returns ``attn(q, k, v) -> out`` where q/k/v are [B, T, H, D] sharded
-    along T over ``axis``; output has the same sharding. Causal."""
+    along T over ``axis`` (and along B over ``batch_axis`` when given, so the
+    ring composes with data parallelism inside one mesh); output has the same
+    sharding. Causal; assumes global positions 0..T-1 in contiguous blocks
+    (GSPMD's block partitioning of the T dim)."""
     n = mesh.shape[axis]
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -73,8 +83,8 @@ def make_ring_attention(mesh: Mesh, axis: str = "sp"):
 
     mapped = shard_map(
         local_fn, mesh=mesh,
-        in_specs=(P(None, axis, None, None),) * 3,
-        out_specs=P(None, axis, None, None),
+        in_specs=(P(batch_axis, axis, None, None),) * 3,
+        out_specs=P(batch_axis, axis, None, None),
         check_vma=False,
     )
     return jax.jit(mapped)

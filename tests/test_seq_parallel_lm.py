@@ -1,0 +1,80 @@
+"""Sequence-parallel LM training: ring attention inside the decoder.
+
+Long-context is first-class in the MODEL, not just a standalone kernel
+(SURVEY §2.3 "sequence parallelism"): the train step shards activations
+along time over an 'sp' mesh axis and routes every layer's attention
+through the ppermute ring, composed with data parallelism. The oracle is
+the ordinary single-device train step — same params, same batch, same
+loss and updated params to float tolerance.
+"""
+
+import dataclasses
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lazzaro_tpu.models.llm import (Decoder, LMConfig, make_seq_parallel_train_step,
+                                    make_train_step)
+from lazzaro_tpu.parallel.mesh import make_mesh
+
+CFG = dataclasses.replace(LMConfig.tiny(), max_seq=64)
+
+
+def _setup(T=32, B=4):
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 250, (B, T)),
+                         jnp.int32)
+    mask = jnp.ones_like(tokens)
+    positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+    params = Decoder(CFG).init(jax.random.PRNGKey(0), tokens, positions)["params"]
+    return tokens, mask, params
+
+
+@pytest.mark.parametrize("axes,sizes", [(("sp",), (8,)),
+                                        (("data", "sp"), (2, 4))])
+def test_seq_parallel_matches_single_device(axes, sizes):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh from conftest")
+    tokens, mask, params = _setup()
+    opt = optax.sgd(1e-2)
+
+    mesh = make_mesh(axes, sizes)
+    step_sp = make_seq_parallel_train_step(CFG, opt, mesh)
+    p0 = jax.tree_util.tree_map(jnp.copy, params)
+    p_sp, _, loss_sp = step_sp(p0, opt.init(p0), tokens, mask)
+
+    step_ref = make_train_step(CFG, opt)
+    p1 = jax.tree_util.tree_map(jnp.copy, params)
+    p_ref, _, loss_ref = step_ref(p1, opt.init(p1), tokens, mask)
+
+    assert float(loss_sp) == pytest.approx(float(loss_ref), abs=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-4, rtol=1e-4),
+        p_sp, p_ref)
+
+
+def test_seq_parallel_loss_decreases_over_steps():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh from conftest")
+    tokens, mask, params = _setup()
+    opt = optax.adam(1e-3)
+    mesh = make_mesh(("data", "sp"), (2, 4))
+    step = make_seq_parallel_train_step(CFG, opt, mesh)
+    opt_state = opt.init(params)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens, mask)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_seq_parallel_rejects_gemma2_features():
+    mesh = make_mesh(("sp",), (len(jax.devices()),))
+    bad = dataclasses.replace(CFG, sliding_window=8)
+    with pytest.raises(ValueError, match="sliding"):
+        make_seq_parallel_train_step(bad, optax.sgd(1e-2), mesh)

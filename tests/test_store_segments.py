@@ -198,3 +198,32 @@ def test_get_all_users_with_tricky_names(tmp_path):
     s.add_nodes([_node(1)], user_id="metrics.seg-a")
     s.add_nodes([_node(2)], user_id="default")
     assert s.get_all_users() == ["default", "metrics.seg-a"]
+
+
+def test_columnar_bulk_insert_matches_dict_path(tmp_path):
+    """add_nodes_columns (the ingest hot path: one flat embedding buffer)
+    round-trips identically to add_nodes dict rows."""
+    store = ArrowStore(str(tmp_path / "db"))
+    emb = np.arange(12, dtype=np.float32).reshape(3, 4)
+    store.add_nodes_columns(
+        ids=["a", "b", "c"], contents=["one", "two", "three"],
+        embeddings=emb, types=["semantic", "episodic", "semantic"],
+        saliences=[0.5, 0.6, 0.7], timestamps=[1.0, 2.0, 3.0],
+        shard_keys=["work", "", "health"], decay_pass=4)
+    store.add_nodes([{"id": "d", "content": "four", "embedding": [9.0] * 4,
+                      "type": "semantic", "salience": 0.8, "timestamp": 4.0,
+                      "shard_key": "work", "decay_pass": 4}])
+    rows = {r["id"]: r for r in store.get_nodes()}
+    assert len(rows) == 4
+    assert rows["b"]["type"] == "episodic"
+    assert rows["b"]["embedding"] == [4.0, 5.0, 6.0, 7.0]
+    assert rows["c"]["salience"] == 0.7 and rows["c"]["shard_key"] == "health"
+    assert rows["a"]["decay_pass"] == 4 and rows["a"]["access_count"] == 0
+    # last-wins upsert across the two paths
+    store.add_nodes_columns(ids=["d"], contents=["four v2"],
+                            embeddings=np.full((1, 4), 2.0, np.float32),
+                            types=["semantic"], saliences=[0.9],
+                            timestamps=[5.0], shard_keys=["work"])
+    rows = {r["id"]: r for r in store.get_nodes()}
+    assert rows["d"]["content"] == "four v2" and rows["d"]["salience"] == 0.9
+    store.close()
