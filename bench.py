@@ -23,6 +23,7 @@ Prints ONE JSON line. Env overrides for smoke runs: BENCH_N, BENCH_DIM.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -178,6 +179,11 @@ def main():
         t0 = time.perf_counter()
         ms.end_conversation()
         t_ingest += time.perf_counter() - t0
+        if (c + 1) % 20 == 0 or c + 1 == CONVS:
+            # liveness to stderr only — stdout stays ONE JSON line
+            print(f"[bench] conv {c + 1}/{CONVS}, "
+                  f"{(c + 1) * FACTS_PER_CONV / t_ingest:.0f} facts/s",
+                  file=sys.stderr, flush=True)
     nodes, edges = ms.buffer.size()
     edges_linked = ms.metrics.get("edges_linked", 0)
     ingest_per_s = nodes / t_ingest

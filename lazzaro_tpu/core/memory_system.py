@@ -896,10 +896,13 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             # ONE arena scatter for every new node, ONE touch for all merges.
             arena_new = [(n, e) for n, e in zip(created, created_embs)
                          if e.size == self.embed_dim]
+            # stacked once, shared by the arena scatter AND the store write
+            emb_matrix = (np.stack([e for _, e in arena_new])
+                          if arena_new else None)
             if arena_new:
                 self.index.add(
                     [self._q(n.id) for n, _ in arena_new],
-                    np.stack([e for _, e in arena_new]),
+                    emb_matrix,
                     [n.salience for n, _ in arena_new],
                     [n.timestamp for n, _ in arena_new],
                     [n.type for n, _ in arena_new],
@@ -921,7 +924,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                     self.store.add_nodes_columns(
                         ids=[n.id for n, _ in regular],
                         contents=[n.content for n, _ in regular],
-                        embeddings=np.stack([e for _, e in regular]),
+                        embeddings=emb_matrix,
                         types=[n.type for n, _ in regular],
                         saliences=[n.salience for n, _ in regular],
                         timestamps=[n.timestamp for n, _ in regular],

@@ -133,6 +133,14 @@ class Attention(nn.Module):
         k = rope(k, positions, cfg.rope_theta)
 
         if cache is None and self.seq_mesh is not None:
+            # Same guard as make_seq_parallel_train_step, enforced HERE so a
+            # direct Decoder(cfg, seq_mesh=...) with a Gemma-2 config can
+            # never produce silently wrong logits (ring implements standard
+            # scaled-dot-product attention only).
+            if cfg.attn_softcap or cfg.sliding_window or cfg.query_scale:
+                raise ValueError(
+                    "ring attention supports standard scaled-dot-product "
+                    "attention only (no softcap/sliding-window/query_scale)")
             from lazzaro_tpu.parallel.ring_attention import make_ring_attention
             ring = make_ring_attention(self.seq_mesh, self.seq_axis,
                                        batch_axis=self.dp_axis)
@@ -140,25 +148,8 @@ class Attention(nn.Module):
             # per block inside the ring, so ppermute traffic and per-chip KV
             # memory stay O(T/n · Hkv · D), not O(T/n · H · D).
             out = ring(q, k, v).astype(dt)
-            out = nn.DenseGeneral(cfg.hidden, axis=(-2, -1), use_bias=False,
-                                  dtype=dt, name="o")(out)
-            return out, None
-
-        assert cfg.attn_impl in ("xla", "flash", "auto"), \
-            f"attn_impl must be 'xla', 'flash' or 'auto', got {cfg.attn_impl!r}"
-        impl = cfg.attn_impl
-        if impl == "auto":
-            # In-module fallback for DIRECT Decoder users (the factories
-            # resolve 'auto' mesh-aware via _resolve_attn_impl first, so a
-            # concrete impl arrives here). Mesh-blind, so be conservative:
-            # flash only when the process can't even GSPMD-shard (1 device).
-            impl = ("flash" if jax.default_backend() in ("tpu", "axon")
-                    and jax.device_count() == 1 else "xla")
-        # The fused kernel covers the standard path; softcapped / windowed /
-        # rescaled layers (Gemma-2) take the materialized-scores path.
-        flash_ok = (cfg.attn_softcap == 0 and cfg.query_scale == 0
-                    and not self.local)
-        if cache is None and impl == "flash" and flash_ok:
+            new_cache = None
+        elif cache is None and self._use_flash():
             from lazzaro_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v).astype(dt)   # [B,T,H,D], GQA inside
             new_cache = None
@@ -188,6 +179,23 @@ class Attention(nn.Module):
         out = nn.DenseGeneral(cfg.hidden, axis=(-2, -1), use_bias=False,
                               dtype=dt, name="o")(out)
         return out, new_cache
+
+    def _use_flash(self) -> bool:
+        cfg = self.cfg
+        assert cfg.attn_impl in ("xla", "flash", "auto"), \
+            f"attn_impl must be 'xla', 'flash' or 'auto', got {cfg.attn_impl!r}"
+        impl = cfg.attn_impl
+        if impl == "auto":
+            # In-module fallback for DIRECT Decoder users (the factories
+            # resolve 'auto' mesh-aware via _resolve_attn_impl first, so a
+            # concrete impl arrives here). Mesh-blind, so be conservative:
+            # flash only when the process can't even GSPMD-shard (1 device).
+            impl = ("flash" if jax.default_backend() in ("tpu", "axon")
+                    and jax.device_count() == 1 else "xla")
+        # The fused kernel covers the standard path; softcapped / windowed /
+        # rescaled layers (Gemma-2) take the materialized-scores path.
+        return (impl == "flash" and cfg.attn_softcap == 0
+                and cfg.query_scale == 0 and not self.local)
 
     def _xla_attention(self, q, k_all, v_all, attn_mask):
         """Materialized-scores path: [B,T,H,D] × [B,S,Hkv,D] → [B,T,H,D].

@@ -78,3 +78,19 @@ def test_seq_parallel_rejects_gemma2_features():
     bad = dataclasses.replace(CFG, sliding_window=8)
     with pytest.raises(ValueError, match="sliding"):
         make_seq_parallel_train_step(bad, optax.sgd(1e-2), mesh)
+
+
+def test_ring_branch_rejects_gemma2_in_attention():
+    """The guard lives IN Attention, so a direct Decoder(cfg, seq_mesh=...)
+    with Gemma-2 numerics errors instead of silently dropping softcap/
+    sliding-window."""
+    import jax.numpy as jnp
+
+    mesh = make_mesh(("sp",), (len(jax.devices()),))
+    bad = dataclasses.replace(LMConfig.tiny(), attn_softcap=50.0)
+    model = Decoder(bad, seq_mesh=mesh)
+    T = 8 * len(jax.devices())
+    tokens = jnp.zeros((1, T), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(T)[None], (1, T))
+    with pytest.raises(ValueError, match="ring attention supports"):
+        model.init(jax.random.PRNGKey(0), tokens, pos)
