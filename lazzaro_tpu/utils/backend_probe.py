@@ -1,0 +1,119 @@
+"""Hang-proof JAX backend probing.
+
+Round-3 post-mortem (VERDICT.md "What's weak" #1/#6): a wedged TPU tunnel
+makes ``jax.devices()`` HANG — not raise — so any in-process probe can stall
+a driver hook forever (r03's rc=124) and an ``except`` block never fires.
+Every entry point that might touch a flaky accelerator backend must instead:
+
+1. probe the backend in a **subprocess with a hard timeout** (this module),
+2. on failure, fall back to CPU **before** this process initializes a
+   backend (``force_cpu``), and
+3. still emit its artifact (a JSON line, a dry-run result) so the driver
+   always captures something parseable.
+
+The probe is honest: it runs a real matmul and reads the result back to the
+host. On the tunneled "axon" backend, ``block_until_ready`` acknowledges
+dispatch rather than completion (VERDICT.md weak #2), so device→host readback
+is the only sync primitive trusted anywhere in this codebase's timed or
+health-checked paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, Optional
+
+# Env vars that enable the tunneled TPU plugin; popped to guarantee a pure
+# CPU child/process. Harmless if absent.
+ACCEL_ENV_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+)
+
+_PROBE_CODE = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+ds = jax.devices()
+x = jnp.full((128, 128), 0.5, jnp.bfloat16)
+y = np.asarray(x @ x)          # real compute + forced device->host readback
+assert float(y[0, 0]) == 32.0, float(y[0, 0])   # 128 * 0.5 * 0.5
+print(json.dumps({"ok": True, "platform": jax.default_backend(),
+                  "device_count": len(ds), "device0": str(ds[0])}))
+"""
+
+
+def cpu_env(n_devices: Optional[int] = None,
+            base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """A copy of the environment guaranteed to initialize a CPU-only JAX,
+    optionally with an ``n_devices``-way virtual device topology (the same
+    mesh substrate tests/conftest.py uses)."""
+    env = dict(os.environ if base is None else base)
+    for var in ACCEL_ENV_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (flags
+                            + f" --xla_force_host_platform_device_count={n_devices}")
+    return env
+
+
+def env_forced_cpu_devices() -> int:
+    """Device count knowable from the environment ALONE (zero jax calls):
+    >0 only when JAX_PLATFORMS pins cpu, in which case the forced host
+    device count (default 1) is returned."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms.split(",")[0].strip().lower() != "cpu":
+        return 0
+    # XLA's flag parser is last-occurrence-wins; mirror that when callers
+    # have appended the flag more than once.
+    found = re.findall(r"--xla_force_host_platform_device_count=(\d+)",
+                       os.environ.get("XLA_FLAGS", ""))
+    return int(found[-1]) if found else 1
+
+
+def probe_backend(timeout: float = 90.0,
+                  env: Optional[Dict[str, str]] = None) -> Dict[str, object]:
+    """Initialize the default backend in a SUBPROCESS and run one verified
+    matmul with device→host readback. Returns
+    ``{"ok", "platform", "device_count", "device0", "error"}`` and never
+    blocks longer than ``timeout`` seconds, whatever the backend does."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            env=dict(os.environ) if env is None else env,
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"backend probe timed out after {timeout:.0f}s"}
+    except OSError as e:
+        return {"ok": False, "error": f"probe spawn failed: {e}"}
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+        return {"ok": False,
+                "error": f"probe rc={proc.returncode}: {' | '.join(tail)[:500]}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"ok": False, "error": f"unparseable probe output: {proc.stdout[:200]!r}"}
+
+
+def force_cpu(n_devices: Optional[int] = None) -> None:
+    """Steer THIS process onto the CPU backend. Only effective before the
+    first backend touch (imports are fine; ``jax.devices()`` is not) — call
+    it right after a failed ``probe_backend`` and before any jnp op."""
+    for var in ACCEL_ENV_VARS:
+        os.environ.pop(var, None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        os.environ["XLA_FLAGS"] = (
+            re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+            + f" --xla_force_host_platform_device_count={n_devices}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")

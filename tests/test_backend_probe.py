@@ -1,0 +1,45 @@
+"""Hang-proofing utilities (utils/backend_probe.py).
+
+These guard the round-3 failure mode: a wedged accelerator tunnel that makes
+``jax.devices()`` hang (not raise), so every backend decision must be
+subprocess-probed or env-derived (VERDICT.md weak #1/#6)."""
+
+import os
+
+from lazzaro_tpu.utils import backend_probe as bp
+
+
+def test_env_forced_cpu_devices_parses(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    assert bp.env_forced_cpu_devices() == 8
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert bp.env_forced_cpu_devices() == 1   # cpu pinned, default 1 device
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    assert bp.env_forced_cpu_devices() == 0   # platform not pinned -> unknown
+
+
+def test_cpu_env_strips_accelerator_vars(monkeypatch):
+    monkeypatch.setenv(bp.ACCEL_ENV_VARS[0], "10.0.0.1")
+    env = bp.cpu_env(n_devices=4)
+    assert bp.ACCEL_ENV_VARS[0] not in env
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    # re-deriving with a different count must replace, not append
+    env2 = bp.cpu_env(n_devices=2, base=env)
+    assert env2["XLA_FLAGS"].count("--xla_force_host_platform_device_count") == 1
+    assert "--xla_force_host_platform_device_count=2" in env2["XLA_FLAGS"]
+
+
+def test_probe_backend_ok_on_cpu():
+    res = bp.probe_backend(timeout=120.0, env=bp.cpu_env())
+    assert res["ok"] is True
+    assert res["platform"] == "cpu"
+    assert res["device_count"] >= 1
+
+
+def test_probe_backend_timeout_never_hangs():
+    # A 0.01 s budget cannot complete backend init: must report, not hang.
+    res = bp.probe_backend(timeout=0.01, env=bp.cpu_env())
+    assert res["ok"] is False
+    assert "timed out" in res["error"]
