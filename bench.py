@@ -14,11 +14,28 @@ insert → link matmuls → delta-segment save), then measures:
              a bare matvec+top-k; arena_scatter_rows_per_sec is a scatter,
              NOT ingest).
 
-The extraction LLM is a canned-payload queue (zero egress, deterministic);
-every other stage is the production code path. Reference bar: the ⚡ <100 ms
-retrieval tier (memory_system.py:332-337) on CPU+LanceDB.
+MEASUREMENT HONESTY (round-3 post-mortem, VERDICT.md weak #2): on the
+tunneled "axon" backend, ``jax.block_until_ready`` acknowledges dispatch,
+not completion — it produced physically impossible numbers in r01/r02
+(6.3 TB/s implied HBM reads on a 0.82 TB/s chip). Every timed region here
+therefore ends in a FORCED device→host transfer (``np.asarray`` of the
+result), and the JSON self-reports the implied HBM bandwidth and FLOP/s
+against v5e peaks — any fraction > 1.0 sets ``roofline_suspect`` so an
+impossible number can never be silently graded again.
 
-Prints ONE JSON line. Env overrides for smoke runs: BENCH_N, BENCH_DIM.
+HANG/CRASH HONESTY (VERDICT.md weak #1/#6): the backend is probed in a
+subprocess with a hard timeout before this process touches JAX. If the TPU
+tunnel is wedged, the bench retries once, then falls back to CPU at a
+reduced N — and ALWAYS prints one parseable JSON line (with an "error"
+field on degraded runs) instead of a traceback.
+
+Prints ONE JSON line. Env overrides:
+  BENCH_N / BENCH_DIM        — graph size / embedding dim (smoke runs)
+  BENCH_WORKDIR              — persistent dir: ingest once, re-run search-only
+  BENCH_INGEST_BUDGET_S      — stop ingest early past this budget (default
+                               3000 s) and bench at the size reached
+  BENCH_LLM_LOOP=1           — also measure consolidation with the on-device
+                               LLM (extract → constrained JSON → ingest)
 """
 
 import json
@@ -27,20 +44,62 @@ import sys
 import time
 
 import numpy as np
-import jax
-import jax.numpy as jnp
 
-from lazzaro_tpu import MemorySystem
-from lazzaro_tpu.config import MemoryConfig
-from lazzaro_tpu.core import state as S
+# ---------------------------------------------------------------------------
+# Backend health gate — BEFORE any jax import side effects touch a backend.
+# ---------------------------------------------------------------------------
+from lazzaro_tpu.utils import backend_probe  # noqa: E402  (no backend touch)
 
 N = int(os.environ.get("BENCH_N", 1_000_000))
 DIM = int(os.environ.get("BENCH_DIM", 768))
+INGEST_BUDGET_S = float(os.environ.get("BENCH_INGEST_BUDGET_S", 3000))
+CPU_FALLBACK_N = 100_000
+
+_degraded_error = None
+_health = backend_probe.ensure_healthy_or_cpu(timeout=120.0, retries=1)
+if not _health.get("ok"):
+    _degraded_error = f"tpu_unreachable: {_health.get('error')}"
+    N = min(N, CPU_FALLBACK_N)
+    print(f"[bench] backend unhealthy; falling back to CPU at N={N}",
+          file=sys.stderr, flush=True)
+
+import jax                     # noqa: E402
+import jax.numpy as jnp        # noqa: E402
+
+from lazzaro_tpu import MemorySystem          # noqa: E402
+from lazzaro_tpu.config import MemoryConfig   # noqa: E402
+from lazzaro_tpu.core import state as S       # noqa: E402
+
 FACTS_PER_CONV = min(5_000, N)
 CONVS = max(1, N // FACTS_PER_CONV)
 TOTAL = FACTS_PER_CONV * CONVS
 K_WARM = 5
 QUERIES = 50
+
+# v5e chip peaks (public spec): the denominators of the roofline self-check.
+V5E_HBM_GBPS = 819.0          # ~0.82 TB/s HBM bandwidth
+V5E_BF16_TFLOPS = 197.0       # ~197 TFLOP/s bf16 MXU
+
+
+def _roofline(n_rows: int, dim: int, dtype_bytes: int, ms: float,
+              batch: int = 1, on_tpu: bool = True):
+    """Implied HBM traffic and FLOP rate of one arena scan finishing in
+    ``ms``. A single query must stream the whole [n_rows, dim] arena from
+    HBM (bytes independent of batch — one matmul reads it once) and spend
+    2·n_rows·dim·batch FLOPs. Fractions > 1.0 of chip peak are physically
+    impossible → the number is a measurement artifact, not a result."""
+    sec = ms * 1e-3
+    gbps = n_rows * dim * dtype_bytes / sec / 1e9
+    tflops = 2.0 * n_rows * dim * batch / sec / 1e12
+    out = {
+        "implied_hbm_gbps": round(gbps, 1),
+        "implied_bf16_tflops": round(tflops, 2),
+    }
+    if on_tpu:
+        out["frac_hbm_peak"] = round(gbps / V5E_HBM_GBPS, 3)
+        out["frac_mxu_peak"] = round(tflops / V5E_BF16_TFLOPS, 3)
+        out["suspect"] = bool(gbps > V5E_HBM_GBPS or tflops > V5E_BF16_TFLOPS)
+    return out
 
 
 def _fact_vec(idx: int) -> np.ndarray:
@@ -91,15 +150,20 @@ def _payload(conv: int) -> str:
         for i in range(FACTS_PER_CONV)]})
 
 
-def build_system(db_dir: str) -> MemorySystem:
+def build_system(db_dir: str, load_from_disk: bool = False,
+                 first_conv: int = CONVS) -> MemorySystem:
+    # Queue only the payloads this run will actually extract (resume runs
+    # start at first_conv; pure-reuse runs never call the LLM at all) —
+    # don't spend minutes JSON-encoding 1M canned facts nobody pops.
+    payloads = [_payload(c) for c in range(first_conv, CONVS)]
     return MemorySystem(
         enable_async=False,
         enable_hierarchy=False,
         auto_consolidate=False,
-        load_from_disk=False,
+        load_from_disk=load_from_disk,
         max_buffer_size=TOTAL * 2,
         db_dir=db_dir,
-        llm_provider=QueueLLM([_payload(c) for c in range(CONVS)]),
+        llm_provider=QueueLLM(payloads),
         embedding_provider=BulkEmbedder(),
         config=MemoryConfig(
             dtype="bfloat16",
@@ -111,10 +175,11 @@ def build_system(db_dir: str) -> MemorySystem:
     )
 
 
-def bench_kernels(dev):
+def bench_kernels(on_tpu: bool):
     """Raw kernel reference numbers (honest labels: NOT the system metrics).
     A/Bs the XLA one-matmul top-k against the blocked Pallas kernel that
-    ``arena_search`` auto-dispatches to on block-aligned TPU arenas."""
+    ``arena_search`` auto-dispatches to on block-aligned TPU arenas.
+    Timed regions end in np.asarray — forced device→host readback."""
     n_rows = -(-(N + 1) // S.TOPK_BLOCK) * S.TOPK_BLOCK  # arena alignment rule
     key = jax.random.PRNGKey(0)
     emb = S.normalize(jax.random.normal(key, (n_rows, DIM), jnp.bfloat16))
@@ -129,22 +194,33 @@ def bench_kernels(dev):
         alive=jnp.ones((n_rows,), bool).at[N:].set(False),
         is_super=jnp.zeros((n_rows,), bool),
     )
-    jax.block_until_ready(arena.emb)
+    np.asarray(arena.emb[:2])            # materialize before timing
     queries = jax.random.normal(jax.random.PRNGKey(7), (K_WARM + QUERIES, DIM),
                                 jnp.float32)
     tenant = jnp.int32(0)
-    on_tpu = jax.default_backend() in ("tpu", "axon")
     lat_by_impl = {}
     for impl in (("xla", "pallas") if on_tpu else ("xla",)):
         for i in range(K_WARM):
             _, r = S.arena_search(arena, queries[i], tenant, 10, impl=impl)
-            jax.block_until_ready(r)
+            np.asarray(r)
         lat_by_impl[impl] = []
         for i in range(K_WARM, K_WARM + QUERIES):
             t0 = time.perf_counter()
             _, r = S.arena_search(arena, queries[i], tenant, 10, impl=impl)
-            jax.block_until_ready(r)
+            np.asarray(r)                # forced device→host sync in timed region
             lat_by_impl[impl].append((time.perf_counter() - t0) * 1e3)
+
+    # Batched (64-query) arena scan: one matmul amortizes the HBM stream.
+    qb = jax.random.normal(jax.random.PRNGKey(9), (64, DIM), jnp.float32)
+    for _ in range(3):
+        _, r = S.arena_search(arena, qb, tenant, 10)
+        np.asarray(r)
+    t0 = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        _, r = S.arena_search(arena, qb, tenant, 10)
+        np.asarray(r)
+    batch64_ms = (time.perf_counter() - t0) * 1e3 / reps
 
     B = 1024
     add_emb = jax.random.normal(jax.random.PRNGKey(3), (B, DIM), jnp.float32)
@@ -153,44 +229,166 @@ def bench_kernels(dev):
             jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
             jnp.zeros((B,), bool))
     a2 = S.arena_add(arena, rows, add_emb, *args)
-    jax.block_until_ready(a2.emb)
+    np.asarray(a2.emb[:2])
     t0 = time.perf_counter()
     reps = 20
     for _ in range(reps):
         a2 = S.arena_add(a2, rows, add_emb, *args)
-    jax.block_until_ready(a2.emb)
+    np.asarray(a2.emb[:2])               # forced sync closes the timed region
     scatter_rows = reps * B / (time.perf_counter() - t0)
     del arena, a2, emb
     p50s = {impl: float(np.percentile(l, 50)) for impl, l in lat_by_impl.items()}
-    return p50s, scatter_rows
+    return p50s, batch64_ms, n_rows, scatter_rows
+
+
+def bench_llm_loop(on_tpu: bool):
+    """Consolidation with the LLM stage ON-DEVICE: extract facts from a
+    transcript with the in-tree decoder via grammar-constrained JSON
+    (models/llm.py generate_json), then run the production ingest. Reports
+    facts/sec with the LLM in the loop — BASELINE.md's north-star stage
+    (reference analog memory_system.py:651-785, where this is an API call)."""
+    import tempfile
+    from lazzaro_tpu.core.providers import OnDeviceLLM
+    from lazzaro_tpu.models.llm import LanguageModel, LMConfig
+
+    geometry = os.environ.get("BENCH_LLM_GEOMETRY",
+                              "base2b" if on_tpu else "small")
+    cfg = getattr(LMConfig, geometry)()
+    lm = LanguageModel(cfg, seed=0)
+
+    # Raw constrained-decode rate of the extraction call (prefill+decode),
+    # timed to the finished host-side string — an honest device sync.
+    prompt = ("System: Extract memories as JSON.\nUser: I work on TPU "
+              "systems, live in Lisbon, and my dog is named Mika.\nAssistant:")
+    t0 = time.perf_counter()
+    doc = lm.generate_json(prompt, max_new_tokens=64)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        doc = lm.generate_json(prompt, max_new_tokens=64)
+    decode_tok_s = reps * 64 / (time.perf_counter() - t0)
+    try:
+        json.loads(doc)
+        json_valid = True
+    except ValueError:
+        json_valid = False
+
+    llm = OnDeviceLLM(lm=lm, max_new_tokens=192)
+    with tempfile.TemporaryDirectory() as tmp:
+        ms = MemorySystem(
+            enable_async=False, auto_consolidate=False, load_from_disk=False,
+            db_dir=tmp, llm_provider=llm, embedding_provider=BulkEmbedder(),
+            config=MemoryConfig(dtype="bfloat16", journal=False),
+            verbose=False)
+        ms.start_conversation()
+        for i in range(6):
+            ms.add_to_short_term(
+                f"I am user detail {i}: I work on TPU systems and like hiking.",
+                "episodic", 0.7)
+        t0 = time.perf_counter()
+        ms.end_conversation()            # LLM extract → JSON → full ingest
+        dt = time.perf_counter() - t0
+        facts = ms.buffer.size()[0]
+        ms.close()
+    return {"geometry": geometry, "json_valid": json_valid,
+            "constrained_decode_tok_per_sec": round(decode_tok_s, 1),
+            "first_call_compile_s": round(compile_s, 1),
+            "facts_extracted": int(facts),
+            "llm_loop_facts_per_sec": round(facts / dt, 3) if facts else 0.0,
+            "llm_loop_total_s": round(dt, 2)}
 
 
 def main():
+    t_start = time.perf_counter()
     dev = jax.devices()[0]
-    import tempfile
-    workdir = tempfile.mkdtemp(prefix="lz_bench_")
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    workdir = os.environ.get("BENCH_WORKDIR")
+    if workdir:
+        os.makedirs(workdir, exist_ok=True)
+    else:
+        import tempfile
+        workdir = tempfile.mkdtemp(prefix="lz_bench_")
+    # Per-(size, dim) db + progress marker: a degraded/smaller run can never
+    # clobber the expensive 1M artifact (r4 review), and the marker records
+    # convs_done after EVERY conversation so an interrupted or
+    # budget-truncated ingest RESUMES instead of restarting (each
+    # end_conversation already delta-saved the graph).
+    db_dir = os.path.join(workdir, f"db_{TOTAL}_{DIM}")
+    marker = os.path.join(workdir, f"INGESTED_{TOTAL}_{DIM}")
+    persist = bool(os.environ.get("BENCH_WORKDIR"))
+
+    def write_marker(convs_done, t_ingest, edges_linked_cum):
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"convs_done": convs_done,
+                       "t_ingest": round(t_ingest, 3),
+                       "edges_linked": edges_linked_cum}, f)
+        os.replace(tmp, marker)
 
     # --- ingest: the full end_conversation pipeline at TOTAL facts --------
-    ms = build_system(os.path.join(workdir, "db"))
-    t_ingest = 0.0
-    for c in range(CONVS):
+    ingest_truncated = False
+    prior_edges_linked = 0
+    saved = {}
+    if os.path.exists(marker):
+        with open(marker) as f:
+            saved = json.load(f)
+    elif os.path.exists(db_dir):
+        # db without a marker = state from a crashed pre-marker run; the
+        # last-wins-by-id merge would silently blend graphs. Start clean.
+        import shutil
+        print(f"[bench] wiping unmarked db_dir {db_dir}", file=sys.stderr,
+              flush=True)
+        shutil.rmtree(db_dir)
+
+    start_conv = min(int(saved.get("convs_done", 0)), CONVS)
+    t_ingest = float(saved.get("t_ingest", 0)) if start_conv else 0.0
+    prior_edges_linked = int(saved.get("edges_linked", 0))
+    if start_conv:
+        print(f"[bench] reusing ingested graph in {db_dir} "
+              f"({start_conv}/{CONVS} convs done)", file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        ms = build_system(db_dir, load_from_disk=True, first_conv=start_conv)
+        print(f"[bench] reload took {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+    else:
+        ms = build_system(db_dir, first_conv=0)
+    convs_done = start_conv
+    t_this_run = 0.0       # the budget bounds THIS process's wall-clock —
+    for c in range(start_conv, CONVS):   # resumes get a fresh budget
         ms.start_conversation()
         ms.add_to_short_term(f"conversation {c} transcript", "episodic", 0.7)
         t0 = time.perf_counter()
         ms.end_conversation()
-        t_ingest += time.perf_counter() - t0
-        if (c + 1) % 20 == 0 or c + 1 == CONVS:
+        dt = time.perf_counter() - t0
+        t_ingest += dt
+        t_this_run += dt
+        convs_done = c + 1
+        if persist:
+            write_marker(convs_done, t_ingest,
+                         ms.metrics.get("edges_linked", 0) + prior_edges_linked)
+        if convs_done % 20 == 0 or convs_done == CONVS:
             # liveness to stderr only — stdout stays ONE JSON line
-            print(f"[bench] conv {c + 1}/{CONVS}, "
-                  f"{(c + 1) * FACTS_PER_CONV / t_ingest:.0f} facts/s",
+            print(f"[bench] conv {convs_done}/{CONVS}, "
+                  f"{convs_done * FACTS_PER_CONV / t_ingest:.0f} facts/s, "
+                  f"{t_ingest:.0f}s elapsed",
                   file=sys.stderr, flush=True)
+        if t_this_run > INGEST_BUDGET_S and convs_done < CONVS:
+            ingest_truncated = True
+            print(f"[bench] ingest budget {INGEST_BUDGET_S:.0f}s exhausted "
+                  f"at {convs_done}/{CONVS} convs — benching at the size "
+                  f"reached (resumable: marker records progress)",
+                  file=sys.stderr, flush=True)
+            break
     nodes, edges = ms.buffer.size()
-    edges_linked = ms.metrics.get("edges_linked", 0)
-    ingest_per_s = nodes / t_ingest
+    edges_linked = ms.metrics.get("edges_linked", 0) + prior_edges_linked
+    ingest_per_s = nodes / t_ingest if t_ingest else None
+    n_facts = convs_done * FACTS_PER_CONV
 
     # --- headline: search_memories p50/p95 through the orchestrator ------
+    t_search_phase = time.perf_counter()
     rng = np.random.default_rng(99)
-    probe = rng.integers(0, TOTAL, size=K_WARM + QUERIES)
+    probe = rng.integers(0, n_facts, size=K_WARM + QUERIES)
     for i in range(K_WARM):
         ms.search_memories(f"fact {probe[i]}: user detail number {probe[i]}")
     lat = []
@@ -198,7 +396,7 @@ def main():
     for i in range(K_WARM, K_WARM + QUERIES):
         q = f"fact {probe[i]}: user detail number {probe[i]}"
         t0 = time.perf_counter()
-        hits = ms.search_memories(q)
+        hits = ms.search_memories(q)     # decodes ids to numpy = real sync
         lat.append((time.perf_counter() - t0) * 1e3)
         if hits and hits[0].content.startswith(f"fact {probe[i]}:"):
             hits_ok += 1
@@ -209,28 +407,58 @@ def main():
     batch_qps = None
     if hasattr(ms, "search_memories_batch"):
         qb = [f"fact {j}: user detail number {j}"
-              for j in rng.integers(0, TOTAL, size=64)]
+              for j in rng.integers(0, n_facts, size=64)]
         ms.search_memories_batch(qb)          # compile
         t0 = time.perf_counter()
         reps = 5
         for _ in range(reps):
-            ms.search_memories_batch(qb)
+            ms.search_memories_batch(qb)      # returns host nodes = real sync
         batch_qps = reps * len(qb) / (time.perf_counter() - t0)
+    t_search_phase = time.perf_counter() - t_search_phase
 
+    # The scan streams the FULL allocated arena (capacity+1 rows), not just
+    # the live nodes — a truncated ingest still pays full-capacity HBM
+    # traffic, and the roofline denominator must reflect that or the
+    # suspect flag understates implied bandwidth (r4 review finding).
+    arena_rows = ms.index.state.emb.shape[0]
     ms.close()
 
-    kernel_p50s, scatter_rows = bench_kernels(dev)
+    t_kernel_phase = time.perf_counter()
+    kernel_p50s, batch64_ms, kernel_rows, scatter_rows = bench_kernels(on_tpu)
+    t_kernel_phase = time.perf_counter() - t_kernel_phase
 
-    print(json.dumps({
-        "metric": "search_memories_p50_latency_1M_nodes",
+    llm_loop = None
+    if os.environ.get("BENCH_LLM_LOOP"):
+        llm_loop = bench_llm_loop(on_tpu)
+
+    # --- roofline self-check: impossible numbers must flag themselves ----
+    rl_headline = _roofline(arena_rows, DIM, 2, p50, 1, on_tpu)
+    rl_xla = _roofline(kernel_rows, DIM, 2, kernel_p50s["xla"], 1, on_tpu)
+    rl = {"headline_search": rl_headline, "arena_search_xla": rl_xla,
+          "arena_search_batch64": _roofline(kernel_rows, DIM, 2, batch64_ms,
+                                            64, on_tpu)}
+    if "pallas" in kernel_p50s:
+        rl["arena_search_pallas"] = _roofline(kernel_rows, DIM, 2,
+                                              kernel_p50s["pallas"], 1, on_tpu)
+    if batch_qps:
+        rl["batched_search_qps_64"] = _roofline(
+            arena_rows, DIM, 2, 64_000.0 / batch_qps, 64, on_tpu)
+    suspect = any(v.get("suspect") for v in rl.values())
+
+    size_tag = "1M" if nodes >= 1_000_000 else f"{nodes // 1000}k"
+    out = {
+        "metric": f"search_memories_p50_latency_{size_tag}_nodes",
         "value": round(p50, 4),
         "unit": "ms",
         "vs_baseline": round(100.0 / p50, 2),   # reference bar: <100ms ⚡ tier
+        "roofline_suspect": suspect,
         "extra": {
             "p95_ms": round(p95, 4),
             "exact_hit_rate": round(hits_ok / QUERIES, 3),
-            "ingest_pipeline_memories_per_sec_per_chip": round(ingest_per_s, 1),
+            "ingest_pipeline_memories_per_sec_per_chip": (
+                round(ingest_per_s, 1) if ingest_per_s else None),
             "ingest_total_s": round(t_ingest, 1),
+            "ingest_truncated_at_budget": ingest_truncated,
             "graph_nodes": nodes,
             "graph_edges_live": edges,     # chain links decay+prune away (parity)
             "edges_linked_total": edges_linked,
@@ -241,14 +469,38 @@ def main():
             "arena_search_pallas_p50_ms": (
                 round(kernel_p50s["pallas"], 4)
                 if "pallas" in kernel_p50s else None),
+            "arena_search_batch64_ms": round(batch64_ms, 4),
             "arena_scatter_rows_per_sec": round(scatter_rows, 1),
+            "roofline": rl,
+            "phase_s": {"ingest": round(t_ingest, 1),
+                        "search": round(t_search_phase, 1),
+                        "kernels": round(t_kernel_phase, 1),
+                        "total_wall": round(time.perf_counter() - t_start, 1)},
+            "llm_loop": llm_loop,
             "dim": DIM,
             "dtype": "bfloat16",
             "llm_stage": "queued-canned (deterministic, zero-egress)",
             "device": str(dev),
         },
-    }))
+    }
+    if _degraded_error:
+        out["error"] = _degraded_error
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # always emit ONE parseable JSON line (weak #6)
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        size_tag = "1M" if TOTAL >= 1_000_000 else f"{TOTAL // 1000}k"
+        out = {
+            "metric": f"search_memories_p50_latency_{size_tag}_nodes",
+            "value": None, "unit": "ms", "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:500],
+        }
+        if _degraded_error:       # separate field: degraded != crashed
+            out["degraded"] = _degraded_error[:500]
+        print(json.dumps(out))
+        sys.exit(0)

@@ -76,17 +76,29 @@ def _write_versioned(ckpt_dir: str, arrays: Dict[str, np.ndarray],
     holds the full arrays (the collective allgather in ``_host`` runs on all
     of them, BEFORE this call), so gating here means N processes on a shared
     filesystem don't race each other's staging dirs and CURRENT flips. All
-    ranks then barrier so no rank can read-back before the snapshot exists."""
+    ranks then barrier so no rank can read-back before the snapshot exists,
+    and rank 0's success/failure is broadcast so a write error (ENOSPC/EIO)
+    raises on EVERY rank — without that, ranks != 0 would return success
+    while rank 0 raised, and the pod would silently diverge on whether the
+    checkpoint exists (r3 advisor finding)."""
     if jax.process_count() > 1 and jax.process_index() != 0:
         _ckpt_barrier()
+        if not _broadcast_ok(True):       # learn rank 0's outcome
+            raise RuntimeError(
+                "checkpoint write failed on process 0; no new version was "
+                "committed (see rank 0's log for the underlying IO error)")
         return
     try:
         _write_versioned_rank0(ckpt_dir, arrays, meta)
-    finally:
-        # The barrier runs even when the write fails (ENOSPC/EIO): the other
-        # ranks are already waiting in it, and skipping it would turn a write
-        # error on rank 0 into a whole-pod hang.
+    except BaseException:
+        # The barrier + outcome broadcast run even when the write fails:
+        # the other ranks are already waiting in them, and skipping either
+        # would turn a write error on rank 0 into a whole-pod hang.
         _ckpt_barrier()
+        _broadcast_ok(False)
+        raise
+    _ckpt_barrier()
+    _broadcast_ok(True)
 
 
 def _write_versioned_rank0(ckpt_dir: str, arrays: Dict[str, np.ndarray],
@@ -133,6 +145,17 @@ def _write_versioned_rank0(ckpt_dir: str, arrays: Dict[str, np.ndarray],
             shutil.rmtree(os.path.join(ckpt_dir, entry), ignore_errors=True)
 
 
+def _broadcast_ok(local_ok: bool) -> bool:
+    """All ranks learn rank 0's write outcome (single-process: identity).
+    The value broadcast is rank 0's — ranks != 0 pass a placeholder."""
+    if jax.process_count() <= 1:
+        return local_ok
+    from jax.experimental import multihost_utils
+    flag = np.asarray(multihost_utils.broadcast_one_to_all(
+        np.asarray(1 if local_ok else 0, np.int32)))
+    return bool(flag)
+
+
 def _ckpt_barrier() -> None:
     """Cross-process rendezvous after a gated write: every rank leaves
     save_index only once rank 0's CURRENT flip is durable, so a save →
@@ -165,7 +188,18 @@ def _read_current(ckpt_dir: str) -> Optional[str]:
         return None
 
 
-def save_index(index: MemoryIndex, ckpt_dir: str) -> None:
+def read_meta(ckpt_dir: str) -> Dict:
+    """The CURRENT version's meta.json alone — cheap pairing/diagnostic
+    reads (e.g. snapshot-id verification) without the array payload."""
+    cur = _read_current(ckpt_dir)
+    if cur is None:
+        raise FileNotFoundError(f"no CURRENT checkpoint in {ckpt_dir}")
+    with open(os.path.join(ckpt_dir, cur, "meta.json")) as f:
+        return json.load(f)
+
+
+def save_index(index: MemoryIndex, ckpt_dir: str,
+               extra_meta: Optional[Dict] = None) -> None:
     """Write a new versioned snapshot under ``ckpt_dir`` and flip the
     ``CURRENT`` pointer file atomically.
 
@@ -200,6 +234,8 @@ def save_index(index: MemoryIndex, ckpt_dir: str) -> None:
         "tenants": index._tenants,
         "shards": index._shards,
     }
+    if extra_meta:
+        meta.update(extra_meta)
     _write_versioned(ckpt_dir, arrays, meta)
 
 

@@ -23,6 +23,8 @@ import numpy as np
 
 from lazzaro_tpu.core import state as S
 from lazzaro_tpu.ops import graphops
+from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
+                                        fetch_packed, pad_to_pow2)
 
 
 class MemoryIndex:
@@ -65,17 +67,25 @@ class MemoryIndex:
 
     # -------------------------------------------------------------- sharding
     def _round_capacity(self, capacity: int, block: bool = True) -> int:
-        """Row counts include the +1 sentinel. Two alignment rules, both
-        satisfied by rounding capacity+1 up: TOPK_BLOCK multiples let
-        ``arena_search`` take the blocked Pallas top-k without ever padding
-        the embedding matrix (extra rows are ordinary free capacity;
+        """Row counts include the +1 sentinel. Two alignment rules, BOTH
+        satisfied by rounding capacity+1 up to a multiple of
+        ``lcm(TOPK_BLOCK, n_parts)`` when both apply: TOPK_BLOCK multiples
+        let ``arena_search`` take the blocked Pallas top-k without ever
+        padding the embedding matrix (extra rows are ordinary free capacity;
         node arena only — edges never go through the blocked kernel), and
-        under a mesh the TOTAL must divide evenly across the axis."""
+        under a mesh the TOTAL must divide evenly across the axis. The lcm
+        (not sequential rounding, which could break block alignment for a
+        part count that doesn't divide the block) keeps both invariants."""
+        import math
+
         total = capacity + 1
+        multiple = 1
         if block and total >= S.TOPK_BLOCK:
-            total = -(-total // S.TOPK_BLOCK) * S.TOPK_BLOCK
+            multiple = S.TOPK_BLOCK
         if self._n_parts > 1:
-            total = -(-total // self._n_parts) * self._n_parts
+            multiple = math.lcm(multiple, self._n_parts)
+        if multiple > 1:
+            total = -(-total // multiple) * multiple
         return total - 1
 
     def _grown_capacity(self, old_capacity: int, block: bool = True) -> int:
@@ -232,8 +242,6 @@ class MemoryIndex:
         TPU serving path for fleets of agents — per-query dispatch amortized
         away). Returns a (ids, scores) pair per query. Q is bucketed to a
         power of two so jit specializations stay bounded."""
-        from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
-                                                pad_to_pow2)
 
         queries = np.asarray(queries, np.float32)
         if queries.ndim == 1:
@@ -245,18 +253,18 @@ class MemoryIndex:
         if tid is None:
             return empty_results(nq)
         k_eff = min(k, self.state.capacity)
-        out: List[Tuple[List[str], List[float]]] = []
-        for start in range(0, nq, self._QUERY_CHUNK):
-            chunk = queries[start:start + self._QUERY_CHUNK]
-            scores, rows = S.arena_search(
-                self.state, jnp.asarray(pad_to_pow2(chunk)), jnp.int32(tid),
-                k_eff, super_filter,
-                # pallas_call has no GSPMD rule — sharded arenas stay on XLA
-                impl="xla" if self.mesh is not None else "auto")
-            n = chunk.shape[0]
-            out.extend(decode_topk(np.asarray(scores)[:n], np.asarray(rows)[:n],
-                                   self.row_to_id, S.NEG_INF))
-        return out
+        # ONE dispatch + ONE readback for the whole fleet: arena_search
+        # streams query chunks through lax.map tiles on device, so host
+        # round trips (~70 ms each on the tunneled backend) don't scale
+        # with the query count.
+        scores, rows = S.arena_search(
+            self.state, jnp.asarray(pad_to_pow2(queries)), jnp.int32(tid),
+            k_eff, super_filter,
+            # pallas_call has no GSPMD rule — sharded arenas stay on XLA
+            impl="xla" if self.mesh is not None else "auto")
+        h_scores, h_rows = fetch_packed(scores, rows)
+        return decode_topk(h_scores[:nq], h_rows[:nq],
+                           self.row_to_id, S.NEG_INF)
 
     # ------------------------------------------------------- numeric sweeps
     def update_access(self, ids: Sequence[str], boost: float = 0.05,
@@ -344,8 +352,9 @@ class MemoryIndex:
             jnp.float32((now if now is not None else time.time()) - self.epoch),
             jnp.float32(weights[0]), jnp.float32(weights[1]), jnp.float32(weights[2]),
             k_bucket)
+        h_imps, h_rows = fetch_packed(imps, rows)      # ONE readback RTT
         out = []
-        for imp, r in zip(np.asarray(imps), np.asarray(rows)):
+        for imp, r in zip(h_imps, h_rows):
             if not np.isfinite(imp):
                 continue
             node_id = self.row_to_id.get(int(r))
@@ -353,16 +362,16 @@ class MemoryIndex:
                 out.append((node_id, float(imp)))
         return out[:k]
 
-    # Query rows per link/search dispatch: the [chunk, capacity] f32 score
-    # matrix is the HBM high-water mark (512×1M×4B ≈ 2 GB transient beside a
-    # 1.5 GB bf16 arena on a 16 GB chip). Chunking changes wall-clock ~zero:
-    # each chunk is still MXU-bound matmul + top_k.
-    _QUERY_CHUNK = 512
-
     def link_candidates(self, new_ids: Sequence[str], tenant: str, k: int = 3,
                         shard_mode: int = 0) -> Dict[str, List[Tuple[str, float]]]:
-        """Per new node: top-k (existing_id, cosine) candidates — batched
-        matmuls, chunked so the score matrix stays HBM-bounded at 1M rows."""
+        """Per new node: top-k (existing_id, cosine) candidates.
+
+        ONE dispatch + ONE readback for the whole batch: the kernel streams
+        [512, capacity] f32 tiles via lax.map (the HBM high-water mark at 1M
+        rows — ~2 GB transient beside a 1.5 GB bf16 arena), and the host pays
+        a single ~70 ms tunnel round trip per conversation instead of one
+        per 512-row chunk (r4 ingest profile: the chunk loop was ~2/3 of
+        end_conversation wall-clock)."""
         rows = [self.id_to_row[i] for i in new_ids if i in self.id_to_row]
         if not rows:
             return {}
@@ -370,26 +379,23 @@ class MemoryIndex:
         if tid is None:
             return {}
         all_rows = np.asarray(rows, np.int32)
-        excl = jnp.asarray(S.pad_rows(all_rows, self.state.capacity))
+        padded = S.pad_rows(all_rows, self.state.capacity)
+        excl = jnp.asarray(padded)
+        scores, cand = S.arena_link_candidates(
+            self.state, jnp.asarray(padded), excl, jnp.int32(tid),
+            min(k, self.state.capacity), shard_mode)
+        scores, cand = fetch_packed(scores, cand)      # ONE readback RTT
         out: Dict[str, List[Tuple[str, float]]] = {}
-        for start in range(0, len(rows), self._QUERY_CHUNK):
-            chunk = all_rows[start:start + self._QUERY_CHUNK]
-            padded = S.pad_rows(chunk, self.state.capacity)
-            scores, cand = S.arena_link_candidates(
-                self.state, jnp.asarray(padded), excl, jnp.int32(tid),
-                min(k, self.state.capacity), shard_mode)
-            scores = np.asarray(scores)
-            cand = np.asarray(cand)
-            for bi, node_row in enumerate(chunk.tolist()):
-                node_id = self.row_to_id[node_row]
-                pairs = []
-                for s, c in zip(scores[bi], cand[bi]):
-                    if s <= S.NEG_INF / 2:
-                        continue
-                    cid = self.row_to_id.get(int(c))
-                    if cid is not None:
-                        pairs.append((cid, float(s)))
-                out[node_id] = pairs
+        for bi, node_row in enumerate(all_rows.tolist()):
+            node_id = self.row_to_id[node_row]
+            pairs = []
+            for s, c in zip(scores[bi], cand[bi]):
+                if s <= S.NEG_INF / 2:
+                    continue
+                cid = self.row_to_id.get(int(c))
+                if cid is not None:
+                    pairs.append((cid, float(s)))
+            out[node_id] = pairs
         return out
 
     def merge_candidates(self, tenant: str, threshold: float = 0.95
@@ -400,12 +406,16 @@ class MemoryIndex:
         if tid is None:
             return []
         mask = self.state.alive & (self.state.tenant_id == jnp.int32(tid)) & ~self.state.is_super
+        # bf16 arena goes in as-is (f32 accumulation happens inside the
+        # matmul); the chunked kernel bounds HBM to one [512, N] tile.
         top_s, top_j = graphops.pairwise_merge_candidates(
-            self.state.emb.astype(jnp.float32), mask, jnp.float32(threshold), k=4)
-        top_s = np.asarray(top_s)
-        top_j = np.asarray(top_j)
+            self.state.emb, mask, jnp.float32(threshold), k=4)
+        top_s, top_j = fetch_packed(top_s, top_j)      # ONE readback RTT
         out = []
-        for i in range(top_j.shape[0]):
+        # Only rows with an above-threshold hit reach Python — at 1M rows
+        # with few duplicates this loop is O(hits), not O(N) (VERDICT r3 #3).
+        hit_rows = np.nonzero((top_j >= 0).any(axis=1))[0]
+        for i in hit_rows.tolist():
             a = self.row_to_id.get(i)
             if a is None:
                 continue
@@ -433,22 +443,22 @@ class MemoryIndex:
     def pull_numeric(self) -> Dict[str, np.ndarray]:
         """One bulk device→host transfer of mutable numeric columns, for
         syncing host Node objects after decay/boost sweeps."""
-        return {
-            "salience": np.asarray(self.state.salience),
-            "last_accessed": np.asarray(self.state.last_accessed) + self.epoch,
-            "access_count": np.asarray(self.state.access_count),
-        }
+        sal, la, ac = fetch_packed(self.state.salience,
+                                   self.state.last_accessed,
+                                   self.state.access_count)
+        return {"salience": sal, "last_accessed": la + self.epoch,
+                "access_count": ac}
 
     def pull_numeric_rows(self, rows: Sequence[int]) -> Dict[str, np.ndarray]:
         """Selective variant of ``pull_numeric``: gather only the given arena
         rows (the incremental-persistence path syncs dirty rows, not the
         whole 1M-row arena)."""
         r = jnp.asarray(np.asarray(rows, np.int32))
-        return {
-            "salience": np.asarray(self.state.salience[r]),
-            "last_accessed": np.asarray(self.state.last_accessed[r]) + self.epoch,
-            "access_count": np.asarray(self.state.access_count[r]),
-        }
+        sal, la, ac = fetch_packed(self.state.salience[r],
+                                   self.state.last_accessed[r],
+                                   self.state.access_count[r])
+        return {"salience": sal, "last_accessed": la + self.epoch,
+                "access_count": ac}
 
     def edge_weights_for(self, keys: Sequence[Tuple[str, str]]
                          ) -> Dict[Tuple[str, str], Tuple[float, int]]:
@@ -458,8 +468,8 @@ class MemoryIndex:
         if not present:
             return {}
         slots = jnp.asarray(np.asarray([s for _, s in present], np.int32))
-        w = np.asarray(self.edge_state.weight[slots])
-        co = np.asarray(self.edge_state.co[slots])
+        w, co = fetch_packed(self.edge_state.weight[slots],
+                             self.edge_state.co[slots])
         return {k: (float(w[i]), int(co[i])) for i, (k, _) in enumerate(present)}
 
     # ---------------------------------------------------------------- edges
@@ -536,8 +546,7 @@ class MemoryIndex:
 
     def edge_weights(self) -> Dict[Tuple[str, str], Tuple[float, int]]:
         """Bulk pull of (weight, co_occurrence) for host Edge sync."""
-        w = np.asarray(self.edge_state.weight)
-        co = np.asarray(self.edge_state.co)
+        w, co = fetch_packed(self.edge_state.weight, self.edge_state.co)
         return {k: (float(w[slot]), int(co[slot])) for k, slot in self.edge_slots.items()}
 
     def components(self) -> List[List[str]]:

@@ -1781,7 +1781,15 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 d.pop("embedding", None)
                 return d
 
+            # One id stamped into BOTH halves: host.json and the index
+            # checkpoint are written separately (never atomic as a pair), so
+            # a crash between the writes leaves a fresh half paired with a
+            # stale one — load_snapshot verifies the ids match and warns
+            # when they don't (r3 advisor finding).
+            import uuid
+            snapshot_id = uuid.uuid4().hex
             host = {
+                "snapshot_id": snapshot_id,
                 "user_id": self.user_id,
                 "shards": {
                     k: {
@@ -1811,7 +1819,8 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 os.makedirs(snapshot_dir, exist_ok=True)
                 _atomic_write(os.path.join(snapshot_dir, "host.json"),
                               json.dumps(host).encode())
-            ckpt.save_index(self.index, os.path.join(snapshot_dir, "index"))
+            ckpt.save_index(self.index, os.path.join(snapshot_dir, "index"),
+                            extra_meta={"snapshot_id": snapshot_id})
         return f"✓ Snapshot saved to {snapshot_dir}"
 
     def load_snapshot(self, snapshot_dir: str) -> str:
@@ -1834,9 +1843,23 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
 
         # Stage EVERYTHING fallibly before touching live state, so a corrupt
         # snapshot can never leave the system half-restored.
+        pair_warning = ""
         try:
             new_index = ckpt.load_index(os.path.join(snapshot_dir, "index"),
                                         mesh=self.mesh)
+            # Pairing check: both halves carry the save's snapshot_id; a
+            # mismatch means a crash landed between the two writes and one
+            # half is stale. Restore proceeds (both halves are individually
+            # consistent) but the caller is warned.
+            sid_host = host.get("snapshot_id")
+            sid_index = ckpt.read_meta(
+                os.path.join(snapshot_dir, "index")).get("snapshot_id")
+            if sid_host and sid_index and sid_host != sid_index:
+                pair_warning = (" ⚠ host.json and index checkpoint carry "
+                                "different snapshot ids — one half is stale "
+                                "(crash between the two writes?)")
+                self._log(f"⚠ snapshot pair mismatch in {snapshot_dir}: "
+                          f"host={sid_host[:8]} index={sid_index[:8]}")
             staged_shards: Dict[str, Tuple[List[Node], List[Edge]]] = {}
             for shard_key, sd in host.get("shards", {}).items():
                 staged_shards[shard_key] = (
@@ -1893,7 +1916,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         # Reopen the WAL for the (possibly different) restored user —
         # mirrors switch_user; replays that user's crashed turns if any.
         self._setup_journal()
-        return f"✓ Snapshot loaded from {snapshot_dir}"
+        return f"✓ Snapshot loaded from {snapshot_dir}{pair_warning}"
 
     def save_state(self, filename: str = "memory_state.json") -> str:
         with self._mutex:

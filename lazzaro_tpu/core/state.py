@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from lazzaro_tpu.ops.chunking import QUERY_CHUNK, chunked_map
+
 NEG_INF = -1e30
 
 TYPE_IDS = {"semantic": 0, "episodic": 1, "procedural": 2}
@@ -335,9 +337,14 @@ def arena_search(
         from lazzaro_tpu.ops.pallas_topk import masked_topk_arena
         top_scores, top_rows = masked_topk_arena(state.emb, mask, q, k)
     else:
-        scores = (q @ state.emb.T).astype(jnp.float32)  # [Q, cap+1]
-        scores = jnp.where(mask[None, :], scores, NEG_INF)
-        top_scores, top_rows = jax.lax.top_k(scores, k)
+        def chunk(q_c):
+            scores = jnp.dot(q_c, state.emb.T,
+                             preferred_element_type=jnp.float32)  # [C, cap+1]
+            return jax.lax.top_k(jnp.where(mask[None, :], scores, NEG_INF), k)
+
+        # Big query fleets stream through [512, cap+1] tiles inside ONE
+        # dispatch (HBM-bounded; one host round trip for the whole batch).
+        top_scores, top_rows = chunked_map(chunk, q)
     if query.ndim == 1:
         return top_scores[0], top_rows[0]
     return top_scores, top_rows
@@ -346,7 +353,7 @@ def arena_search(
 @functools.partial(jax.jit, static_argnames=("k", "shard_mode"))
 def arena_link_candidates(
     state: ArenaState,
-    new_rows: jax.Array,   # [B] i32 rows to find candidates FOR (query chunk)
+    new_rows: jax.Array,   # [B] i32 rows to find candidates FOR (whole batch)
     excl_rows: jax.Array,  # [E] i32 rows excluded as candidates (ALL new rows)
     tenant: jax.Array,
     k: int,
@@ -356,21 +363,27 @@ def arena_link_candidates(
     other new rows). One batched matmul replaces reference hot loops #2/#3
     (``memory_system.py:797-836`` within-shard, ``:838-891`` cross-shard).
 
-    ``new_rows`` may be a CHUNK of the full batch (the [B, cap+1] score matrix
-    is what bounds HBM at 1M rows); ``excl_rows`` always carries every new row
-    so chunking never lets one new node surface as another's candidate."""
-    q = state.emb[new_rows]                       # [B, d]
-    scores = (q @ state.emb.T).astype(jnp.float32)  # [B, cap+1]
+    Batches past QUERY_CHUNK stream through ``lax.map`` in [512, cap+1] f32
+    tiles INSIDE this one dispatch — the tile bounds HBM at 1M rows, and a
+    whole-conversation link batch costs ONE host round trip (the tunneled
+    backend charges ~70 ms per readback, r4 measurement; the old host-side
+    chunk loop paid it per 512 rows)."""
     mask = state.alive & (state.tenant_id == tenant) & ~state.is_super
     # exclude the new rows themselves from candidates
     excl = jnp.zeros((state.emb.shape[0],), bool).at[excl_rows].set(True)
     mask = mask & ~excl
-    full_mask = mask[None, :]
-    if shard_mode != 0:
-        same = state.shard_id[new_rows][:, None] == state.shard_id[None, :]
-        full_mask = full_mask & (same if shard_mode == 1 else ~same)
-    scores = jnp.where(full_mask, scores, NEG_INF)
-    return jax.lax.top_k(scores, k)
+
+    def chunk(rows_c):
+        q = state.emb[rows_c]                     # [C, d]
+        scores = jnp.dot(q, state.emb.T,
+                         preferred_element_type=jnp.float32)  # [C, cap+1]
+        full_mask = mask[None, :]
+        if shard_mode != 0:
+            same = state.shard_id[rows_c][:, None] == state.shard_id[None, :]
+            full_mask = full_mask & (same if shard_mode == 1 else ~same)
+        return jax.lax.top_k(jnp.where(full_mask, scores, NEG_INF), k)
+
+    return chunked_map(chunk, new_rows)
 
 
 @jax.jit

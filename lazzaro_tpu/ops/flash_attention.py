@@ -112,7 +112,10 @@ def _flash_fwd_bhtd(q: jax.Array, k: jax.Array, v: jax.Array,
     """q [B, H, T, D], k/v [B, Hkv, S, D] (pre-transposed; T % blk_q == 0,
     S % blk_k == 0). ``offset`` is the UNPADDED S - T: query row i attends
     absolute keys 0..offset+i (padded tail rows/cols are positionally
-    outside every real window). → ([B, H, T, D] out, [B, H, T] f32 LSE)."""
+    outside every real window). → ([B, H, T, D] out, [B, H, T, LANES] f32
+    LSE). The LSE is logically per-row ([B, H, T]) but stored broadcast
+    across the 128 lanes so it stays (8, 128)-tileable on TPU — residual
+    memory is T*128 f32 per head, 128× a per-row scalar would cost."""
     B, H, T, D = q.shape
     _, Hkv, S, _ = k.shape
     assert H % Hkv == 0, f"heads {H} not a multiple of kv heads {Hkv}"
@@ -258,9 +261,12 @@ def _flash_dkv_kernel(blk_q: int, blk_k: int, nq: int, rep: int,
                    static_argnames=("blk_q", "blk_k", "offset", "interpret"))
 def _flash_bwd_bhtd(q, k, v, o, lse, do, blk_q: int, blk_k: int,
                     offset: int, interpret: bool):
-    """Fused backward: q/o/do [B, H, T, D], k/v [B, Hkv, S, D], lse [B, H, T]
-    → (dq [B, H, T, D], dk [B, Hkv, S, D], dv [B, Hkv, S, D]). Scores are
-    recomputed per block from the stored LSE — no [T, S] HBM tensor."""
+    """Fused backward: q/o/do [B, H, T, D], k/v [B, Hkv, S, D], lse
+    [B, H, T, LANES] (the forward's lanes-broadcast residual; logically
+    per-row) → (dq [B, H, T, D], dk [B, Hkv, S, D], dv [B, Hkv, S, D]).
+    Scores are recomputed per block from the stored LSE — no [T, S] HBM
+    tensor. The delta residual built below is likewise broadcast to
+    [B, H, T, LANES]; each of lse and delta costs T*128 f32 per head."""
     B, H, T, D = q.shape
     _, Hkv, S, _ = k.shape
     rep = H // Hkv

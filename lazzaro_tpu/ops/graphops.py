@@ -14,6 +14,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from lazzaro_tpu.ops.chunking import chunked_map
+
 
 @functools.partial(jax.jit, static_argnames=("num_nodes",))
 def connected_components(
@@ -76,23 +78,35 @@ def component_stats(labels: jax.Array, src: jax.Array, tgt: jax.Array,
     return node_counts, edge_counts, weight_sums
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
 def pairwise_merge_candidates(emb: jax.Array, mask: jax.Array,
                               threshold: jax.Array, k: int = 4,
+                              chunk: int = 512,
                               ) -> Tuple[jax.Array, jax.Array]:
-    """All-pairs near-duplicate detection as one matmul + top-k.
+    """All-pairs near-duplicate detection as chunked matmuls + top-k.
 
     This implements the *intended* semantics of ``_merge_similar_nodes``
     (reference memory_system.py:1065-1120 has an indentation bug that only
     ever merges duplicates of the last node — SURVEY §2.2 says build the
     intended all-pairs version). For each row i, returns up to k rows j > i
-    with cosine(i, j) > threshold; sentinel -1 elsewhere."""
+    with cosine(i, j) > threshold; sentinel -1 elsewhere.
+
+    Scale (VERDICT.md r3 weak #3): the score matrix is never materialized
+    whole — ``chunked_map`` streams [chunk, N] f32 tiles (the shared
+    HBM-bounding scaffold, ops/chunking.py), so 1M-row arenas fit a 16 GB
+    chip where the old one-shot [N, N] needed ~4 TB. Each tile is still one
+    MXU-bound matmul; f32 accumulation via ``preferred_element_type`` keeps
+    bf16 arenas exact enough for 0.95-cosine thresholds."""
     n = emb.shape[0]
-    scores = (emb @ emb.T).astype(jnp.float32)
-    idx = jnp.arange(n)
-    upper = idx[None, :] > idx[:, None]          # only j > i, no self-pairs
-    valid = mask[:, None] & mask[None, :] & upper
-    scores = jnp.where(valid, scores, -jnp.inf)
-    top_s, top_j = jax.lax.top_k(scores, k)
-    top_j = jnp.where(top_s > threshold, top_j, -1)
-    return top_s, top_j
+    col = jnp.arange(n, dtype=jnp.int32)
+
+    def one_chunk(rows):
+        q = emb[rows]
+        scores = jnp.dot(q, emb.T, preferred_element_type=jnp.float32)
+        upper = col[None, :] > rows[:, None]     # only j > i, no self-pairs
+        valid = mask[rows][:, None] & mask[None, :] & upper
+        scores = jnp.where(valid, scores, -jnp.inf)
+        ts, tj = jax.lax.top_k(scores, k)
+        return ts, jnp.where(ts > threshold, tj, -1)
+
+    return chunked_map(one_chunk, col, chunk)

@@ -47,12 +47,11 @@ print(json.dumps({"ok": True, "platform": jax.default_backend(),
 """
 
 
-def cpu_env(n_devices: Optional[int] = None,
-            base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
-    """A copy of the environment guaranteed to initialize a CPU-only JAX,
-    optionally with an ``n_devices``-way virtual device topology (the same
-    mesh substrate tests/conftest.py uses)."""
-    env = dict(os.environ if base is None else base)
+def _steer_cpu(env: Dict[str, str], n_devices: Optional[int]) -> Dict[str, str]:
+    """Single shared mutation: strip the accelerator-plugin vars, pin
+    JAX_PLATFORMS=cpu, and (optionally) force an n-device host topology.
+    Used by both ``cpu_env`` (subprocess copies) and ``force_cpu``
+    (in-place on os.environ) so the two can never drift."""
     for var in ACCEL_ENV_VARS:
         env.pop(var, None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -64,10 +63,24 @@ def cpu_env(n_devices: Optional[int] = None,
     return env
 
 
+def cpu_env(n_devices: Optional[int] = None,
+            base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """A copy of the environment guaranteed to initialize a CPU-only JAX,
+    optionally with an ``n_devices``-way virtual device topology (the same
+    mesh substrate tests/conftest.py uses)."""
+    return _steer_cpu(dict(os.environ if base is None else base), n_devices)
+
+
 def env_forced_cpu_devices() -> int:
     """Device count knowable from the environment ALONE (zero jax calls):
-    >0 only when JAX_PLATFORMS pins cpu, in which case the forced host
-    device count (default 1) is returned."""
+    >0 only when JAX_PLATFORMS pins cpu AND no accelerator-plugin env var
+    is present. The second condition is load-bearing: the tunneled-TPU
+    sitecustomize hook registers its backend whenever its env vars are set,
+    OVERRIDING a shell-level ``JAX_PLATFORMS=cpu`` — trusting the variable
+    alone silently bypassed every probe gate (r4 review finding). Returns
+    the forced host device count (default 1) when genuinely CPU-pinned."""
+    if any(os.environ.get(var) for var in ACCEL_ENV_VARS):
+        return 0
     platforms = os.environ.get("JAX_PLATFORMS", "")
     if platforms.split(",")[0].strip().lower() != "cpu":
         return 0
@@ -103,17 +116,36 @@ def probe_backend(timeout: float = 90.0,
         return {"ok": False, "error": f"unparseable probe output: {proc.stdout[:200]!r}"}
 
 
+def ensure_healthy_or_cpu(timeout: float = 90.0, retries: int = 0,
+                          retry_wait: float = 20.0) -> Dict[str, object]:
+    """The one health-gate policy every entry point shares: no-op when the
+    environment already genuinely forces CPU; otherwise subprocess-probe the
+    default backend (with optional retries) and steer THIS process onto CPU
+    if the accelerator is unhealthy. Returns the final health dict — callers
+    inspect ``ok`` to decide on degraded-mode behavior (bench caps N, the
+    driver hooks log). Centralizing it keeps the 'JAX_PLATFORMS=cpu alone is
+    not proof of CPU' invariant (see env_forced_cpu_devices) in one place."""
+    import time
+
+    if env_forced_cpu_devices() > 0:
+        return {"ok": True, "platform": "cpu", "forced_by_env": True}
+    health = probe_backend(timeout=timeout)
+    for _ in range(retries):
+        if health.get("ok"):
+            break
+        print(f"[backend_probe] probe failed ({health.get('error')}); "
+              f"retrying in {retry_wait:.0f}s", file=sys.stderr, flush=True)
+        time.sleep(retry_wait)
+        health = probe_backend(timeout=timeout)
+    if not health.get("ok"):
+        force_cpu()
+    return health
+
+
 def force_cpu(n_devices: Optional[int] = None) -> None:
     """Steer THIS process onto the CPU backend. Only effective before the
     first backend touch (imports are fine; ``jax.devices()`` is not) — call
     it right after a failed ``probe_backend`` and before any jnp op."""
-    for var in ACCEL_ENV_VARS:
-        os.environ.pop(var, None)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    if n_devices is not None:
-        os.environ["XLA_FLAGS"] = (
-            re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   os.environ.get("XLA_FLAGS", ""))
-            + f" --xla_force_host_platform_device_count={n_devices}")
+    _steer_cpu(os.environ, n_devices)
     import jax
     jax.config.update("jax_platforms", "cpu")

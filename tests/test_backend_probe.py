@@ -10,6 +10,8 @@ from lazzaro_tpu.utils import backend_probe as bp
 
 
 def test_env_forced_cpu_devices_parses(monkeypatch):
+    for var in bp.ACCEL_ENV_VARS:
+        monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     assert bp.env_forced_cpu_devices() == 8
@@ -17,6 +19,17 @@ def test_env_forced_cpu_devices_parses(monkeypatch):
     assert bp.env_forced_cpu_devices() == 1   # cpu pinned, default 1 device
     monkeypatch.setenv("JAX_PLATFORMS", "")
     assert bp.env_forced_cpu_devices() == 0   # platform not pinned -> unknown
+
+
+def test_env_forced_cpu_devices_rejects_live_accel_plugin(monkeypatch):
+    # The tunneled-TPU sitecustomize registers its backend whenever its env
+    # vars are set, OVERRIDING a shell-level JAX_PLATFORMS=cpu — so the env
+    # gate must refuse to call that "CPU-pinned" (r4 review finding: the
+    # bypass defeated every probe gate on this very host).
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    monkeypatch.setenv(bp.ACCEL_ENV_VARS[0], "10.0.0.1")
+    assert bp.env_forced_cpu_devices() == 0
 
 
 def test_cpu_env_strips_accelerator_vars(monkeypatch):

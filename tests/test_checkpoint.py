@@ -182,10 +182,17 @@ def test_nonzero_rank_never_touches_filesystem(tmp_path, monkeypatch):
     _fill(idx, 8)
     ck = tmp_path / "ck"
     monkeypatch.setattr(C, "_ckpt_barrier", lambda: None)   # no real pod here
+    monkeypatch.setattr(C, "_broadcast_ok", lambda ok: True)
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     monkeypatch.setattr(jax, "process_index", lambda: 1)
     C.save_index(idx, str(ck))
     assert not ck.exists()
+    # When rank 0 reports failure, ranks != 0 must raise too instead of
+    # silently returning success (advisor r3: checkpoint.py:84).
+    monkeypatch.setattr(C, "_broadcast_ok", lambda ok: False)
+    with pytest.raises(RuntimeError, match="failed on process 0"):
+        C.save_index(idx, str(ck))
+    monkeypatch.setattr(C, "_broadcast_ok", lambda ok: True)
     monkeypatch.setattr(jax, "process_index", lambda: 0)
     C.save_index(idx, str(ck))
     assert (ck / "CURRENT").exists()

@@ -1,0 +1,75 @@
+"""Deep-consolidation merge at scale (VERDICT r3 weak #3 / next #3).
+
+The old ``pairwise_merge_candidates`` materialized the full [N, N] score
+matrix (~4 TB at 1M rows). The chunked rewrite streams [chunk, N] tiles via
+``lax.map``; these tests pin (a) exact equivalence with a naive all-pairs
+oracle on an awkward (non-multiple-of-chunk) size, and (b) that the merge
+stage completes at 100k rows and finds exactly the planted duplicates —
+the intended `_merge_similar_nodes` semantics (reference
+memory_system.py:1065-1120, minus its last-node-only indentation bug).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lazzaro_tpu.core.index import MemoryIndex
+from lazzaro_tpu.ops.graphops import pairwise_merge_candidates
+
+
+def _naive_pairs(emb: np.ndarray, mask: np.ndarray, threshold: float, k: int):
+    scores = emb @ emb.T
+    n = emb.shape[0]
+    out = set()
+    for i in range(n):
+        if not mask[i]:
+            continue
+        cand = [(scores[i, j], j) for j in range(i + 1, n)
+                if mask[j] and scores[i, j] > threshold]
+        for _, j in sorted(cand, reverse=True)[:k]:
+            out.add((i, j))
+    return out
+
+
+def test_chunked_matches_naive_oracle():
+    rng = np.random.default_rng(0)
+    n, d = 1500, 24                       # deliberately not a chunk multiple
+    emb = rng.standard_normal((n, d)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    # plant duplicate clusters across chunk boundaries
+    for a, b in [(3, 700), (511, 512), (1023, 1499), (100, 101)]:
+        emb[b] = emb[a]
+    mask = np.ones(n, bool)
+    mask[100] = False                     # masked rows must never appear
+    ts, tj = pairwise_merge_candidates(
+        jnp.asarray(emb), jnp.asarray(mask), jnp.float32(0.95), k=4, chunk=512)
+    got = {(i, int(j)) for i in range(n) for j in np.asarray(tj)[i] if j >= 0}
+    want = _naive_pairs(emb, mask, 0.95, k=4)
+    assert got == want
+    assert (3, 700) in got and (511, 512) in got and (1023, 1499) in got
+    assert all(100 not in p for p in got)
+
+
+def test_merge_candidates_100k_rows():
+    rng = np.random.default_rng(1)
+    n, d = 100_000, 32
+    emb = rng.standard_normal((n, d)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    planted = [(10, 99_000), (4_095, 4_096), (50_000, 50_001)]
+    for a, b in planted:
+        emb[b] = emb[a]
+
+    idx = MemoryIndex(dim=d, capacity=n + 8)
+    ids = [f"m{i}" for i in range(n)]
+    step = 20_000
+    for s in range(0, n, step):
+        sl = slice(s, s + step)
+        idx.add(ids[sl], emb[sl], [0.5] * step, [1000.0] * step,
+                ["semantic"] * step, ["default"] * step, "u1")
+
+    pairs = idx.merge_candidates("u1", threshold=0.98)
+    got = {tuple(sorted((a, b))) for a, b, _ in pairs}
+    want = {tuple(sorted((f"m{a}", f"m{b}"))) for a, b in planted}
+    assert got == want, f"extra/missing merge pairs: {got ^ want}"
+    for _, _, sim in pairs:
+        assert sim == pytest.approx(1.0, abs=5e-3)

@@ -217,3 +217,26 @@ def test_load_snapshot_missing_dir(tmp_path):
                       verbose=False, load_from_disk=False)
     assert "No snapshot" in ms.load_snapshot(str(tmp_path / "nope"))
     ms.close()
+
+
+def test_snapshot_pair_mismatch_warns(tmp_path):
+    # host.json and the index checkpoint are written separately; a crash
+    # between the writes pairs a fresh half with a stale one. Both halves
+    # carry the save's snapshot_id, and load warns when they disagree.
+    import json, os
+    ms = _seeded_system(str(tmp_path / "db"))
+    snap = str(tmp_path / "snap")
+    ms.save_snapshot(snap)
+    ms.close()
+
+    hj = os.path.join(snap, "host.json")
+    host = json.load(open(hj))
+    assert host["snapshot_id"]
+    host["snapshot_id"] = "deadbeef" * 4       # simulate a stale half
+    json.dump(host, open(hj, "w"))
+
+    ms2 = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db2"),
+                       verbose=False, load_from_disk=False)
+    msg = ms2.load_snapshot(snap)
+    assert "loaded" in msg and "different snapshot ids" in msg
+    ms2.close()
