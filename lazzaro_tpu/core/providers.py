@@ -261,13 +261,20 @@ class OnDeviceLLM:
     default random init free-text output is noise — load an Orbax checkpoint
     for real use; the HeuristicLLM handles structured prompts offline."""
 
-    def __init__(self, lm=None, max_new_tokens: int = 128, temperature: float = 0.0):
+    def __init__(self, lm=None, max_new_tokens: int = 128,
+                 temperature: float = 0.0,
+                 json_scaffold: Optional[str] = None):
         if lm is None:
             from lazzaro_tpu.models.llm import LMConfig, LanguageModel
             lm = LanguageModel(LMConfig.small())
         self.lm = lm
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
+        # Optional schema scaffold for json_object responses: a literal JSON
+        # prefix the constrained decode must start with (e.g.
+        # '{"memories": [{"content": "'), pinning the keys the consumer
+        # parses. See LanguageModel.generate_json(scaffold=...).
+        self.json_scaffold = json_scaffold
 
     def _render(self, messages: List[Dict[str, str]]) -> str:
         # Flatten roles into a plain prompt (the reference's Gemini provider
@@ -282,7 +289,8 @@ class OnDeviceLLM:
             if isinstance(self.lm.tokenizer, ByteTokenizer):
                 return self.lm.generate_json(self._render(messages),
                                              max_new_tokens=self.max_new_tokens,
-                                             temperature=self.temperature)
+                                             temperature=self.temperature,
+                                             scaffold=self.json_scaffold)
             # HF/subword tokenizer: the byte-level JSON grammar automaton
             # can't mask subword logits, so fall back to free-text decoding
             # plus fence/JSON extraction (the reference's own json path,

@@ -302,6 +302,20 @@ def arena_decay(state: ArenaState, tenant: jax.Array, rate: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+@functools.partial(jax.jit, static_argnames=("super_filter",))
+def arena_mask(state: ArenaState, tenant: jax.Array,
+               super_filter: int = 0) -> jax.Array:
+    """The retrieval row mask: alive ∧ tenant ∧ super-node filter. Shared by
+    ``arena_search`` (single-chip) and the shard_map mesh searcher
+    (core/index.py) so tenant-isolation semantics live in one place."""
+    mask = state.alive & (state.tenant_id == tenant)
+    if super_filter == 1:
+        mask = mask & state.is_super
+    elif super_filter == -1:
+        mask = mask & ~state.is_super
+    return mask
+
+
 @functools.partial(jax.jit, static_argnames=("k", "super_filter", "impl"))
 def arena_search(
     state: ArenaState,
@@ -322,11 +336,7 @@ def arena_search(
     with a row-sharded arena must pass ``impl="xla"`` (pallas_call has no
     GSPMD partitioning rule)."""
     q = normalize(jnp.atleast_2d(query)).astype(state.emb.dtype)
-    mask = state.alive & (state.tenant_id == tenant)
-    if super_filter == 1:
-        mask = mask & state.is_super
-    elif super_filter == -1:
-        mask = mask & ~state.is_super
+    mask = arena_mask(state, tenant, super_filter)
     n, nq = state.emb.shape[0], q.shape[0]
     use_pallas = impl == "pallas" or (
         impl == "auto"

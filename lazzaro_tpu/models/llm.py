@@ -540,17 +540,30 @@ class LanguageModel:
             {"params": params}, token[:, None], position[:, None], caches)
         return logits[:, -1], caches
 
-    def _prep_prompt(self, prompt: str, max_new_tokens: int):
+    def _prep_prompt(self, prompt: str, max_new_tokens: int,
+                     extra_ids: tuple = ()):
         """Shared generation preamble: clamp the budget, keep the prompt tail
         that fits (a naive negative slice turns into [-0:] when the budget
         hits zero and silently keeps everything), prefill the KV cache.
+        ``extra_ids`` are teacher-forced tokens appended AFTER the prompt —
+        they ride the same prefill (one dispatch), not per-token decode
+        steps; generate_json uses this for scaffold prefixes.
         Returns (clamped_max_new_tokens, last-position logits, caches, pos)."""
         cfg = self.cfg
-        max_new_tokens = min(max_new_tokens, cfg.max_seq - 2)
-        prompt_budget = cfg.max_seq - 1 - max_new_tokens
+        # extra_ids consume context exactly like generated tokens: clamp the
+        # budget net of them, or a long scaffold could push prompt_budget
+        # negative (silently dropping the whole prompt) or overflow the KV
+        # cache outright.
+        max_new_tokens = min(max_new_tokens, cfg.max_seq - 2 - len(extra_ids))
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"{len(extra_ids)} forced prefix tokens leave no generation "
+                f"budget in max_seq={cfg.max_seq}")
+        prompt_budget = cfg.max_seq - 1 - max_new_tokens - len(extra_ids)
         ids = self.tokenizer.encode(prompt)
         if len(ids) > prompt_budget:
             ids = ids[len(ids) - prompt_budget:]
+        ids = list(ids) + list(extra_ids)
         tokens = jnp.asarray([ids], jnp.int32)
         positions = jnp.arange(len(ids))[None, :]
         caches = self._empty_cache(1)
@@ -625,7 +638,8 @@ class LanguageModel:
 
     def generate_json(self, prompt: str, max_new_tokens: int = 256,
                       temperature: float = 0.0, seed: int = 0,
-                      force_object: bool = True) -> str:
+                      force_object: bool = True,
+                      scaffold: Optional[str] = None) -> str:
         """Grammar-constrained generation: the output is valid JSON by
         construction (any weights, including random). A byte-level pushdown
         automaton (``models/json_constrain.py``) computes the legal next-byte
@@ -633,7 +647,14 @@ class LanguageModel:
         the token budget runs out mid-document, the shortest closing suffix
         completes it. Replaces the reference's trust-the-API
         ``response_format`` + fence-stripping + parse-failure path
-        (providers.py:10-19, memory_system.py:684-703)."""
+        (providers.py:10-19, memory_system.py:684-703).
+
+        ``scaffold``: a literal JSON prefix the output MUST start with (e.g.
+        ``'{"memories": [{"content": "'``) — teacher-forced through the
+        prefill in one dispatch, validated byte-by-byte against the grammar
+        automaton, then generation continues from the automaton state the
+        scaffold reached. This is schema-shaped decoding: callers pin the
+        keys/structure they need and let the model fill the values."""
         from lazzaro_tpu.models.json_constrain import JsonState, constrain_mask
 
         if not isinstance(self.tokenizer, ByteTokenizer):
@@ -641,12 +662,24 @@ class LanguageModel:
                 "generate_json requires the byte tokenizer (the JSON grammar "
                 "automaton masks logits per BYTE; subword ids don't map 1:1)")
         cfg = self.cfg
-        max_new_tokens, logits, caches, pos = self._prep_prompt(
-            prompt, max_new_tokens)
-
         state = JsonState(force_object=force_object)
-        key = jax.random.PRNGKey(seed)
         out = bytearray()
+        scaffold_ids: tuple = ()
+        if scaffold:
+            sbytes = scaffold.encode("utf-8")
+            for i, b in enumerate(sbytes):
+                mask = constrain_mask(state, cfg.vocab_size, ByteTokenizer.EOS)
+                if not mask[b]:
+                    raise ValueError(
+                        f"scaffold is not a valid JSON prefix at byte {i} "
+                        f"({bytes([b])!r} after {sbytes[:i]!r})")
+                out.append(b)
+                state.feed(b)
+            scaffold_ids = tuple(int(b) for b in sbytes)
+        max_new_tokens, logits, caches, pos = self._prep_prompt(
+            prompt, max_new_tokens, extra_ids=scaffold_ids)
+
+        key = jax.random.PRNGKey(seed)
         for _ in range(max_new_tokens):
             mask = constrain_mask(state, cfg.vocab_size, ByteTokenizer.EOS)
             host_logits = np.array(logits[0], np.float32)   # writable copy

@@ -110,6 +110,19 @@ def masked_topk_auto(emb, madd, queries, k=10, block_rows=4096):
 _BLOCK_BYTES = 6 * 1024 * 1024
 
 
+def fit_block_rows(n: int, d: int, itemsize: int) -> int:
+    """Largest power-of-two block ≤ 4096 that fits the VMEM budget AND
+    divides ``n``; 0 when no block ≥ 512 divides n (caller falls back to the
+    XLA path). Shared by the single-chip arena dispatch and the shard_map
+    per-shard dispatch, whose local row counts are N/n_shards."""
+    blk = 4096
+    while blk > 512 and blk * d * itemsize > _BLOCK_BYTES:
+        blk //= 2
+    while blk >= 512 and n % blk != 0:
+        blk //= 2
+    return blk if blk >= 512 else 0
+
+
 def masked_topk_arena(emb: jax.Array, mask: jax.Array, queries: jax.Array,
                       k: int = 10) -> Tuple[jax.Array, jax.Array]:
     """The ``arena_search`` serving path: boolean mask → additive mask, block
@@ -118,10 +131,8 @@ def masked_topk_arena(emb: jax.Array, mask: jax.Array, queries: jax.Array,
     ``state.TOPK_BLOCK`` multiples precisely so no padded copy of the matrix
     is ever made here."""
     n, d = emb.shape
-    blk = 4096
-    while blk > 512 and blk * d * emb.dtype.itemsize > _BLOCK_BYTES:
-        blk //= 2
-    assert n % blk == 0, f"arena rows {n} not a multiple of block {blk}"
+    blk = fit_block_rows(n, d, emb.dtype.itemsize)
+    assert blk, f"arena rows {n} have no VMEM-fitting block divisor >= 512"
     madd = jnp.where(mask, 0.0, NEG).astype(jnp.float32)
     on_tpu = jax.default_backend() in ("tpu", "axon")
     return pallas_masked_topk(emb, madd, queries.astype(emb.dtype),
