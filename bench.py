@@ -222,6 +222,31 @@ def bench_kernels(on_tpu: bool):
         np.asarray(r)
     batch64_ms = (time.perf_counter() - t0) * 1e3 / reps
 
+    # Int8 serving shadow: half the scan bytes (ops/quant.py).
+    from lazzaro_tpu.ops.quant import quantize_rows, quantized_topk
+
+    q8, qsc = quantize_rows(arena.emb)
+    mask = arena.alive
+    for _ in range(3):
+        _, r = quantized_topk(q8, qsc, mask, queries[:1], 10)
+        np.asarray(r)
+    lat_i8 = []
+    for i in range(K_WARM, K_WARM + QUERIES):
+        t0 = time.perf_counter()
+        _, r = quantized_topk(q8, qsc, mask, queries[i:i + 1], 10)
+        np.asarray(r)
+        lat_i8.append((time.perf_counter() - t0) * 1e3)
+    int8_p50 = float(np.percentile(lat_i8, 50))
+    for _ in range(3):
+        _, r = quantized_topk(q8, qsc, mask, qb, 10)
+        np.asarray(r)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _, r = quantized_topk(q8, qsc, mask, qb, 10)
+        np.asarray(r)
+    int8_batch64_ms = (time.perf_counter() - t0) * 1e3 / reps
+    del q8, qsc
+
     B = 1024
     add_emb = jax.random.normal(jax.random.PRNGKey(3), (B, DIM), jnp.float32)
     rows = jnp.arange(B, dtype=jnp.int32)
@@ -238,7 +263,8 @@ def bench_kernels(on_tpu: bool):
     scatter_rows = reps * B / (time.perf_counter() - t0)
     del arena, a2, emb
     p50s = {impl: float(np.percentile(l, 50)) for impl, l in lat_by_impl.items()}
-    return p50s, batch64_ms, n_rows, scatter_rows
+    p50s["int8"] = int8_p50
+    return p50s, batch64_ms, int8_batch64_ms, n_rows, scatter_rows
 
 
 def bench_llm_loop(on_tpu: bool):
@@ -436,7 +462,9 @@ def main():
     consolidation_msg = None
     if os.environ.get("BENCH_CONSOLIDATE", "1") != "0":
         t0 = time.perf_counter()
-        consolidation_msg = ms.run_consolidation()
+        # persist=False: the reusable BENCH_WORKDIR artifact must not
+        # accumulate consolidation mutations across repeated runs
+        consolidation_msg = ms.run_consolidation(persist=False)
         t_consolidation = time.perf_counter() - t0
 
     # The scan streams the FULL allocated arena (capacity+1 rows), not just
@@ -447,7 +475,8 @@ def main():
     ms.close()
 
     t_kernel_phase = time.perf_counter()
-    kernel_p50s, batch64_ms, kernel_rows, scatter_rows = bench_kernels(on_tpu)
+    (kernel_p50s, batch64_ms, int8_batch64_ms, kernel_rows,
+     scatter_rows) = bench_kernels(on_tpu)
     t_kernel_phase = time.perf_counter() - t_kernel_phase
 
     llm_loop = None
@@ -463,6 +492,11 @@ def main():
     if "pallas" in kernel_p50s:
         rl["arena_search_pallas"] = _roofline(kernel_rows, DIM, 2,
                                               kernel_p50s["pallas"], 1, on_tpu)
+    # int8 shadow scans HALF the bytes per row (dtype_bytes=1)
+    rl["arena_search_int8"] = _roofline(kernel_rows, DIM, 1,
+                                        kernel_p50s["int8"], 1, on_tpu)
+    rl["arena_search_int8_batch64"] = _roofline(kernel_rows, DIM, 1,
+                                                int8_batch64_ms, 64, on_tpu)
     if batch_qps:
         rl["batched_search_qps_64"] = _roofline(
             arena_rows, DIM, 2, 64_000.0 / batch_qps, 64, on_tpu)
@@ -493,6 +527,8 @@ def main():
                 round(kernel_p50s["pallas"], 4)
                 if "pallas" in kernel_p50s else None),
             "arena_search_batch64_ms": round(batch64_ms, 4),
+            "arena_search_int8_p50_ms": round(kernel_p50s["int8"], 4),
+            "arena_search_int8_batch64_ms": round(int8_batch64_ms, 4),
             "arena_scatter_rows_per_sec": round(scatter_rows, 1),
             "roofline": rl,
             "phase_s": {"ingest": round(t_ingest, 1),

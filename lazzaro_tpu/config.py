@@ -20,6 +20,13 @@ class MemoryConfig:
     initial_capacity: int = 1024    # arena rows; grows by doubling
     max_edges: int = 8192           # edge arena rows; grows by doubling
     dtype: str = "float32"          # arena embedding dtype ("bfloat16" for 1M+)
+    # Int8 serving shadow (ops/quant.py): user-facing searches scan a
+    # per-row-quantized copy at half the HBM bytes (the bandwidth floor is
+    # what bounds 1M-row retrieval); consolidation's dedup/link/merge
+    # decisions keep scanning the exact master arena. Single-chip only:
+    # under a mesh the flag is ignored (with a warning) — the sharded path
+    # searches the exact arena through shard_map.
+    int8_serving: bool = False
 
     # --- behavior flags (parity with memory_system.py:63-84) ---------------
     enable_sharding: bool = True

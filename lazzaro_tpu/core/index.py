@@ -39,9 +39,26 @@ class MemoryIndex:
 
     def __init__(self, dim: int, capacity: int = 1024, edge_capacity: int = 8192,
                  dtype=jnp.float32, epoch: Optional[float] = None,
-                 mesh=None, shard_axis: str = "data"):
+                 mesh=None, shard_axis: str = "data",
+                 int8_serving: bool = False):
         self.dim = dim
         self.dtype = dtype
+        # Int8 serving shadow (ops/quant.py): half the HBM bytes per scan.
+        # Exact-path callers (dedup/merge thresholds) bypass it. The shadow
+        # re-quantizes lazily, invalidated ONLY by embedding-mutating ops
+        # (add / grow) — metadata sweeps (decay, boost, access counts,
+        # delete's alive flip) leave the vectors untouched, and the alive/
+        # tenant mask is taken fresh from the master at every search, so
+        # they must not trigger a ~3 GB full-arena requant.
+        self.int8_serving = bool(int8_serving) and mesh is None
+        if int8_serving and mesh is not None:
+            import warnings
+            warnings.warn(
+                "int8_serving is single-chip only (the mesh path searches "
+                "through shard_map over the exact arena); the flag is "
+                "ignored under a mesh", stacklevel=3)
+        self._int8_shadow = None           # (q [N,d] i8, scale [N] f32)
+        self._int8_dirty = True
         self.mesh = mesh
         self.shard_axis = shard_axis
         self._n_parts = int(mesh.shape[shard_axis]) if mesh is not None else 1
@@ -157,6 +174,7 @@ class MemoryIndex:
             old_cap = self.state.capacity
             new_cap = self._grown_capacity(old_cap)
             self.state = S.grow_arena(self.state, new_cap)
+            self._int8_dirty = True        # emb shape changed
             self._free_rows = list(range(new_cap - 1, old_cap - 1, -1)) + self._free_rows
         return [self._free_rows.pop() for _ in range(n)]
 
@@ -210,6 +228,7 @@ class MemoryIndex:
             jnp.asarray(pad([tid] * n, -1, np.int32)),
             jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
         )
+        self._int8_dirty = True            # emb rows written
         return rows
 
     def delete(self, ids: Iterable[str]) -> None:
@@ -238,11 +257,17 @@ class MemoryIndex:
                                  tenant, k, super_filter)[0]
 
     def search_batch(self, queries: np.ndarray, tenant: str, k: int = 10,
-                     super_filter: int = 0) -> List[Tuple[List[str], List[float]]]:
+                     super_filter: int = 0, exact: bool = False
+                     ) -> List[Tuple[List[str], List[float]]]:
         """Multi-query masked top-k: ONE matmul + top_k for Q queries (the
         TPU serving path for fleets of agents — per-query dispatch amortized
         away). Returns a (ids, scores) pair per query. Q is bucketed to a
-        power of two so jit specializations stay bounded."""
+        power of two so jit specializations stay bounded.
+
+        ``exact=True`` forces the full-precision master arena even when the
+        int8 serving shadow is enabled — consolidation's dedup/link gates
+        compare scores against tight thresholds (0.95) where the ~1e-2
+        quantization error could flip a decision."""
 
         queries = np.asarray(queries, np.float32)
         if queries.ndim == 1:
@@ -259,7 +284,18 @@ class MemoryIndex:
         # round trips (~70 ms each on the tunneled backend) don't scale
         # with the query count.
         q_pad = jnp.asarray(pad_to_pow2(queries))
-        if self.mesh is None:
+        if self.mesh is None and self.int8_serving and not exact:
+            from lazzaro_tpu.ops.quant import quantized_topk
+
+            if self._int8_dirty or self._int8_shadow is None:
+                from lazzaro_tpu.ops.quant import quantize_rows
+                self._int8_shadow = quantize_rows(self.state.emb)
+                self._int8_dirty = False
+            q8, qscale = self._int8_shadow
+            mask = S.arena_mask(self.state, jnp.int32(tid), super_filter)
+            scores, rows = quantized_topk(q8, qscale, mask,
+                                          S.normalize(q_pad), k_eff)
+        elif self.mesh is None:
             scores, rows = S.arena_search(self.state, q_pad, jnp.int32(tid),
                                           k_eff, super_filter, impl="auto")
         else:

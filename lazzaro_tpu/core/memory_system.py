@@ -127,7 +127,8 @@ class MemorySystem:
         self.mesh = mesh
         self.index = MemoryIndex(dim, capacity=cfg.initial_capacity,
                                  edge_capacity=cfg.max_edges,
-                                 dtype=jnp.dtype(cfg.dtype), mesh=mesh)
+                                 dtype=jnp.dtype(cfg.dtype), mesh=mesh,
+                                 int8_serving=cfg.int8_serving)
 
         self.query_cache = QueryCache(cfg.cache_size) if self.enable_caching else None
 
@@ -805,7 +806,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             if probeable:
                 qs = np.stack([staged[i][2] for i in probeable])
                 res = self.index.search_batch(qs, self.user_id, k=1,
-                                              super_filter=-1)
+                                              super_filter=-1, exact=True)
                 for i, (ids, scores) in zip(probeable, res):
                     if ids:
                         probe[i] = (ids[0].partition(":")[2], scores[0])

@@ -273,7 +273,17 @@ class OnDeviceLLM:
         # Optional schema scaffold for json_object responses: a literal JSON
         # prefix the constrained decode must start with (e.g.
         # '{"memories": [{"content": "'), pinning the keys the consumer
-        # parses. See LanguageModel.generate_json(scaffold=...).
+        # parses. See LanguageModel.generate_json(scaffold=...). Byte-
+        # tokenizer only — the grammar automaton masks logits per byte, so
+        # accepting a scaffold we'd silently drop on the HF/subword fallback
+        # path would void the pinned-schema guarantee the caller configured.
+        if json_scaffold is not None:
+            from lazzaro_tpu.models.tokenizer import ByteTokenizer
+            if not isinstance(self.lm.tokenizer, ByteTokenizer):
+                raise ValueError(
+                    "json_scaffold requires a ByteTokenizer-backed model; "
+                    "subword vocabularies cannot teacher-force a byte-exact "
+                    "JSON prefix")
         self.json_scaffold = json_scaffold
 
     def _render(self, messages: List[Dict[str, str]]) -> str:
