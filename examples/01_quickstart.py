@@ -6,6 +6,11 @@ embedder and the heuristic LLM, so this runs anywhere JAX does (CPU or TPU).
     python examples/01_quickstart.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from lazzaro_tpu import MemorySystem
 
 ms = MemorySystem(db_dir="quickstart_db", enable_async=False)

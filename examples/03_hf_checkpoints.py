@@ -5,6 +5,11 @@ for files you already have on disk) — swap in your own paths.
     python examples/03_hf_checkpoints.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 import torch
 import transformers

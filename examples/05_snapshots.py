@@ -7,6 +7,11 @@ orders of magnitude faster than row-wise persistence at large node counts.
     python examples/05_snapshots.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from lazzaro_tpu import MemorySystem
 
 ms = MemorySystem(db_dir="snap_db", enable_async=False)

@@ -330,11 +330,17 @@ def arena_search(
     fast-path scan (memory_system.py:464-470) — same kernel, different mask.
 
     Dispatch (all static at trace time): big block-aligned arenas on TPU
-    take the blocked Pallas kernel (streams the matrix through VMEM, per-
-    block top-k, no [Q, N] HBM score tensor — measured 1.6× faster at
-    1M×768 bf16); everything else takes the one-matmul XLA path. Callers
-    with a row-sharded arena must pass ``impl="xla"`` (pallas_call has no
-    GSPMD partitioning rule)."""
+    take the blocked Pallas kernel — it streams the matrix through VMEM
+    with per-block top-k, so no [Q, N] f32 score tensor ever lands in HBM
+    (4 GB per 1k queries at 1M rows) and the final sort runs over
+    nblocks·k candidates instead of N. (An earlier "1.6× faster" claim
+    came from a broken clock — the tunneled backend acks dispatch on
+    block_until_ready, r4 post-mortem; on this rig per-call latency is
+    round-trip-dominated and the two impls measure equal. The HBM-traffic
+    advantage is structural.) Everything else takes the one-matmul XLA
+    path. Callers with a row-sharded arena must pass ``impl="xla"``
+    (pallas_call has no GSPMD partitioning rule) or go through the
+    shard_map composition in ``ops/topk.make_sharded_topk``."""
     q = normalize(jnp.atleast_2d(query)).astype(state.emb.dtype)
     mask = arena_mask(state, tenant, super_filter)
     n, nq = state.emb.shape[0], q.shape[0]
