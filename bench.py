@@ -479,9 +479,18 @@ def main():
      scatter_rows) = bench_kernels(on_tpu)
     t_kernel_phase = time.perf_counter() - t_kernel_phase
 
+    # LLM-in-the-loop stage (BASELINE.md north star): ON by default on a
+    # healthy TPU; set BENCH_LLM_LOOP=0 to skip, =1 to force (e.g. on CPU).
     llm_loop = None
-    if os.environ.get("BENCH_LLM_LOOP"):
-        llm_loop = bench_llm_loop(on_tpu)
+    llm_flag = os.environ.get("BENCH_LLM_LOOP", "")
+    if llm_flag == "1" or (llm_flag != "0" and on_tpu and not _degraded_error):
+        print("[bench] LLM-loop stage starting", file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        try:
+            llm_loop = bench_llm_loop(on_tpu)
+        except Exception as e:   # a failed extra stage must not void the run
+            llm_loop = {"error": f"{type(e).__name__}: {e}"[:300]}
+        llm_loop["stage_total_s"] = round(time.perf_counter() - t0, 1)
 
     # --- roofline self-check: impossible numbers must flag themselves ----
     rl_headline = _roofline(arena_rows, DIM, 2, p50, 1, on_tpu)

@@ -6,10 +6,7 @@ embedder and the heuristic LLM, so this runs anywhere JAX does (CPU or TPU).
     python examples/01_quickstart.py
 """
 
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 
 from lazzaro_tpu import MemorySystem
 

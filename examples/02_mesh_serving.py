@@ -8,10 +8,7 @@ Run on real chips, or simulate a pod on CPU:
         python examples/02_mesh_serving.py
 """
 
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 
 import jax
 

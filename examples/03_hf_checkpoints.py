@@ -5,10 +5,7 @@ for files you already have on disk) — swap in your own paths.
     python examples/03_hf_checkpoints.py
 """
 
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 
 import numpy as np
 import torch

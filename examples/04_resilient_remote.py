@@ -6,10 +6,7 @@ OpenAILLM/OpenAIEmbedder (same protocols) for the real thing.
     python examples/04_resilient_remote.py
 """
 
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 
 from lazzaro_tpu import MemorySystem
 from lazzaro_tpu.core.resilience import ResilientEmbedder, ResilientLLM

@@ -7,10 +7,7 @@ orders of magnitude faster than row-wise persistence at large node counts.
     python examples/05_snapshots.py
 """
 
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo-root sys.path)
 
 from lazzaro_tpu import MemorySystem
 

@@ -1,0 +1,13 @@
+"""Make the in-repo package importable when examples run as scripts.
+
+``python examples/0N_*.py`` puts examples/ (not the repo root) on
+``sys.path``; importing this module from each example adds the root once,
+in one place. Installing the package (``pip install -e .``) makes this a
+no-op."""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
