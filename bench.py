@@ -442,16 +442,18 @@ def main():
     p95 = float(np.percentile(lat, 95))
 
     # --- fleet serving: batched query path through the orchestrator ------
-    batch_qps = None
+    # Per-dispatch latency here is round-trip-bound (~70 ms through the
+    # tunnel), so throughput scales with batch size: measure 64 and 512.
+    batch_qps = {}
     if hasattr(ms, "search_memories_batch"):
-        qb = [f"fact {j}: user detail number {j}"
-              for j in rng.integers(0, n_facts, size=64)]
-        ms.search_memories_batch(qb)          # compile
-        t0 = time.perf_counter()
-        reps = 5
-        for _ in range(reps):
-            ms.search_memories_batch(qb)      # returns host nodes = real sync
-        batch_qps = reps * len(qb) / (time.perf_counter() - t0)
+        for bsz, reps in ((64, 5), (512, 3)):
+            qb = [f"fact {j}: user detail number {j}"
+                  for j in rng.integers(0, n_facts, size=bsz)]
+            ms.search_memories_batch(qb)      # compile
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                ms.search_memories_batch(qb)  # returns host nodes = real sync
+            batch_qps[bsz] = reps * bsz / (time.perf_counter() - t0)
     t_search_phase = time.perf_counter() - t_search_phase
 
     # --- deep consolidation at full scale: the chunked all-pairs merge ---
@@ -482,8 +484,10 @@ def main():
     # LLM-in-the-loop stage (BASELINE.md north star): ON by default on a
     # healthy TPU; set BENCH_LLM_LOOP=0 to skip, =1 to force (e.g. on CPU).
     llm_loop = None
-    llm_flag = os.environ.get("BENCH_LLM_LOOP", "")
-    if llm_flag == "1" or (llm_flag != "0" and on_tpu and not _degraded_error):
+    llm_flag = os.environ.get("BENCH_LLM_LOOP", "").strip().lower()
+    force_on = llm_flag in ("1", "true", "yes", "on")
+    force_off = llm_flag in ("0", "false", "no", "off")
+    if force_on or (not force_off and on_tpu and not _degraded_error):
         print("[bench] LLM-loop stage starting", file=sys.stderr, flush=True)
         t0 = time.perf_counter()
         try:
@@ -506,9 +510,9 @@ def main():
                                         kernel_p50s["int8"], 1, on_tpu)
     rl["arena_search_int8_batch64"] = _roofline(kernel_rows, DIM, 1,
                                                 int8_batch64_ms, 64, on_tpu)
-    if batch_qps:
-        rl["batched_search_qps_64"] = _roofline(
-            arena_rows, DIM, 2, 64_000.0 / batch_qps, 64, on_tpu)
+    for bsz, qps in batch_qps.items():
+        rl[f"batched_search_qps_{bsz}"] = _roofline(
+            arena_rows, DIM, 2, bsz * 1000.0 / qps, bsz, on_tpu)
     suspect = any(v.get("suspect") for v in rl.values())
 
     size_tag = "1M" if nodes >= 1_000_000 else f"{nodes // 1000}k"
@@ -528,8 +532,10 @@ def main():
             "graph_nodes": nodes,
             "graph_edges_live": edges,     # chain links decay+prune away (parity)
             "edges_linked_total": edges_linked,
-            "batched_search_qps_64": (round(batch_qps, 1)
-                                      if batch_qps is not None else None),
+            "batched_search_qps_64": (round(batch_qps[64], 1)
+                                      if 64 in batch_qps else None),
+            "batched_search_qps_512": (round(batch_qps[512], 1)
+                                       if 512 in batch_qps else None),
             # raw kernels, honest names — NOT the system metrics:
             "arena_search_xla_p50_ms": round(kernel_p50s["xla"], 4),
             "arena_search_pallas_p50_ms": (

@@ -416,42 +416,52 @@ class MemoryIndex:
                 out.append((node_id, float(imp)))
         return out[:k]
 
+    def link_candidates_multi(self, new_ids: Sequence[str], tenant: str,
+                              k: int = 3, shard_modes: Sequence[int] = (1, 0)
+                              ) -> Dict[int, Dict[str, List[Tuple[str, float]]]]:
+        """Several shard-mode link scans in ONE host round trip.
+
+        The consolidation pipeline needs both the same-shard (mode 1) and
+        the any-shard (mode 0) candidate sets per conversation; dispatches
+        are async, so issuing both kernels and fetching all four output
+        arrays in one packed readback saves a full ~70 ms tunnel RTT per
+        conversation vs. two sequential ``link_candidates`` calls."""
+        rows = [self.id_to_row[i] for i in new_ids if i in self.id_to_row]
+        tid = self._tenants.get(tenant)
+        if not rows or tid is None:
+            return {sm: {} for sm in shard_modes}
+        all_rows = np.asarray(rows, np.int32)
+        rows_dev = jnp.asarray(S.pad_rows(all_rows, self.state.capacity))
+        outs = [S.arena_link_candidates(self.state, rows_dev, rows_dev,
+                                        jnp.int32(tid),
+                                        min(k, self.state.capacity), sm)
+                for sm in shard_modes]
+        flat = fetch_packed(*[a for pair in outs for a in pair])
+        result: Dict[int, Dict[str, List[Tuple[str, float]]]] = {}
+        for i, sm in enumerate(shard_modes):
+            scores, cand = flat[2 * i], flat[2 * i + 1]
+            out: Dict[str, List[Tuple[str, float]]] = {}
+            for bi, node_row in enumerate(all_rows.tolist()):
+                node_id = self.row_to_id[node_row]
+                pairs = []
+                for s, c in zip(scores[bi], cand[bi]):
+                    if s <= S.NEG_INF / 2:
+                        continue
+                    cid = self.row_to_id.get(int(c))
+                    if cid is not None:
+                        pairs.append((cid, float(s)))
+                out[node_id] = pairs
+            result[sm] = out
+        return result
+
     def link_candidates(self, new_ids: Sequence[str], tenant: str, k: int = 3,
                         shard_mode: int = 0) -> Dict[str, List[Tuple[str, float]]]:
-        """Per new node: top-k (existing_id, cosine) candidates.
-
-        ONE dispatch + ONE readback for the whole batch: the kernel streams
-        [512, capacity] f32 tiles via lax.map (the HBM high-water mark at 1M
-        rows — ~2 GB transient beside a 1.5 GB bf16 arena), and the host pays
-        a single ~70 ms tunnel round trip per conversation instead of one
-        per 512-row chunk (r4 ingest profile: the chunk loop was ~2/3 of
-        end_conversation wall-clock)."""
-        rows = [self.id_to_row[i] for i in new_ids if i in self.id_to_row]
-        if not rows:
-            return {}
-        tid = self._tenants.get(tenant)
-        if tid is None:
-            return {}
-        all_rows = np.asarray(rows, np.int32)
-        # one device upload: the query batch and the exclusion set are the
-        # same whole-batch array since the chunk loop moved on-device
-        rows_dev = jnp.asarray(S.pad_rows(all_rows, self.state.capacity))
-        scores, cand = S.arena_link_candidates(
-            self.state, rows_dev, rows_dev, jnp.int32(tid),
-            min(k, self.state.capacity), shard_mode)
-        scores, cand = fetch_packed(scores, cand)      # ONE readback RTT
-        out: Dict[str, List[Tuple[str, float]]] = {}
-        for bi, node_row in enumerate(all_rows.tolist()):
-            node_id = self.row_to_id[node_row]
-            pairs = []
-            for s, c in zip(scores[bi], cand[bi]):
-                if s <= S.NEG_INF / 2:
-                    continue
-                cid = self.row_to_id.get(int(c))
-                if cid is not None:
-                    pairs.append((cid, float(s)))
-            out[node_id] = pairs
-        return out
+        """Per new node: top-k (existing_id, cosine) candidates — the
+        single-mode view of ``link_candidates_multi`` (same ONE dispatch +
+        ONE readback; the kernel streams [512, capacity] f32 tiles via
+        lax.map, the HBM high-water mark at 1M rows)."""
+        return self.link_candidates_multi(new_ids, tenant, k,
+                                          (shard_mode,))[shard_mode]
 
     def merge_candidates(self, tenant: str, threshold: float = 0.95
                          ) -> List[Tuple[str, str, float]]:

@@ -943,8 +943,13 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             if new_nodes_data:
                 self.store.add_nodes(new_nodes_data, user_id=self.user_id)
 
-            self._link_within_shards(new_nodes)
-            self._link_to_existing_memories(new_nodes)
+            # Both link scans (same-shard + any-shard) in one round trip.
+            link_cands = self.index.link_candidates_multi(
+                [self._q(n) for n, _ in new_nodes], self.user_id,
+                k=self.config.cross_link_top_k,
+                shard_modes=(1, 0)) if new_nodes else {1: {}, 0: {}}
+            self._link_within_shards(new_nodes, link_cands[1])
+            self._link_to_existing_memories(new_nodes, link_cands[0])
 
         self._enforce_buffer_limit()
 
@@ -1007,10 +1012,13 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         self.index.add_edges(triples, self.user_id,
                              reinforce=self.config.edge_reinforce)
 
-    def _link_within_shards(self, new_nodes: List[Tuple[str, str]]) -> None:
+    def _link_within_shards(self, new_nodes: List[Tuple[str, str]],
+                            cands: Optional[Dict] = None) -> None:
         """Chain consecutive new nodes (w=0.5) + top-3 same-shard cosine>0.5
         links (w=sim·0.8). The similarity scan is one batched matmul on the
-        arena (replaces hot loop #2, memory_system.py:797-836)."""
+        arena (replaces hot loop #2, memory_system.py:797-836); the
+        consolidation path precomputes ``cands`` via
+        ``link_candidates_multi`` so both link passes share one readback."""
         by_shard: Dict[str, List[str]] = {}
         for node_id, shard_key in new_nodes:
             by_shard.setdefault(shard_key, []).append(node_id)
@@ -1026,9 +1034,10 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         if not all_new:
             self._add_edges_batch(batch)
             return
-        cands = self.index.link_candidates(
-            [self._q(n) for n in all_new], self.user_id,
-            k=self.config.cross_link_top_k, shard_mode=1)
+        if cands is None:
+            cands = self.index.link_candidates(
+                [self._q(n) for n in all_new], self.user_id,
+                k=self.config.cross_link_top_k, shard_mode=1)
         for qid, pairs in cands.items():
             nid = qid.partition(":")[2]
             for qcand, sim in pairs:
@@ -1038,15 +1047,17 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                                       weight=sim * self.config.link_weight_scale))
         self._add_edges_batch(batch)
 
-    def _link_to_existing_memories(self, new_nodes: List[Tuple[str, str]]) -> None:
+    def _link_to_existing_memories(self, new_nodes: List[Tuple[str, str]],
+                                   cands: Optional[Dict] = None) -> None:
         """Top-3 cross-links across ALL existing memories (any shard), gate
         0.5, weight sim·0.8, dedup both directions (replaces hot loop #3,
         memory_system.py:838-891)."""
         if not new_nodes:
             return
-        cands = self.index.link_candidates(
-            [self._q(n) for n, _ in new_nodes], self.user_id,
-            k=self.config.cross_link_top_k, shard_mode=0)
+        if cands is None:
+            cands = self.index.link_candidates(
+                [self._q(n) for n, _ in new_nodes], self.user_id,
+                k=self.config.cross_link_top_k, shard_mode=0)
         batch: List[Edge] = []
         staged: Set[Tuple[str, str]] = set()
         for qid, pairs in cands.items():
