@@ -1884,6 +1884,10 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
 
         self._drain_background()   # outside the mutex: the worker needs it
         with self._mutex:
+            # load_index builds a bare MemoryIndex; carry over the serving
+            # configuration or a restore would silently drop int8 serving
+            new_index.int8_serving = (self.config.int8_serving
+                                      and self.mesh is None)
             self.index = new_index
             self.user_id = host.get("user_id", self.user_id)
             self.shards.clear()

@@ -103,3 +103,20 @@ def test_system_behavior_parity_with_int8_serving(tmp_path):
     assert int8_nodes == exact_nodes
     assert int8_hits == exact_hits
     assert any("data engineer" in h for h in int8_hits)
+
+
+def test_int8_serving_survives_snapshot_restore(tmp_path):
+    cfg = MemoryConfig(journal=False, int8_serving=True)
+    ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False, config=cfg)
+    ms.start_conversation()
+    ms.chat("I work as a data engineer on a big ETL project.")
+    ms.end_conversation()
+    snap = str(tmp_path / "snap")
+    ms.save_snapshot(snap)
+    ms.load_snapshot(snap)                 # index object is replaced
+    assert ms.index.int8_serving
+    hits = [n.content for n in ms.search_memories("data engineer")]
+    assert any("data engineer" in h for h in hits)
+    assert ms.index._int8_shadow is not None   # int8 path actually served
+    ms.close()
