@@ -239,12 +239,14 @@ def save_index(index: MemoryIndex, ckpt_dir: str,
     _write_versioned(ckpt_dir, arrays, meta)
 
 
-def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data") -> MemoryIndex:
+def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
+               int8_serving: bool = False) -> MemoryIndex:
     """Rebuild a MemoryIndex from the snapshot ``CURRENT`` points at.
 
     ``mesh``: restore row-sharded over the mesh axis (the saved total row
     count must divide the axis size — mesh-created indexes guarantee this
-    via capacity rounding)."""
+    via capacity rounding). ``int8_serving`` flows into the constructor so
+    its single-chip clamp + warning apply in the one place they live."""
     data, meta = _read_versioned(ckpt_dir)
     if meta.get("kind") == "sharded":
         raise ValueError(f"{ckpt_dir} is a sharded-index checkpoint — use "
@@ -262,7 +264,8 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data") -> MemoryInde
 
     dt = jnp.bfloat16 if meta["dtype"] == "bfloat16" else jnp.dtype(meta["dtype"])
     index = MemoryIndex(meta["dim"], capacity=1, edge_capacity=1, dtype=dt,
-                        epoch=meta["epoch"], mesh=mesh, shard_axis=shard_axis)
+                        epoch=meta["epoch"], mesh=mesh, shard_axis=shard_axis,
+                        int8_serving=int8_serving)
     index.state = arena        # setter re-shards over the mesh if given
     index.edge_state = edges
 

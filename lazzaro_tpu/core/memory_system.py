@@ -1858,7 +1858,8 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         pair_warning = ""
         try:
             new_index = ckpt.load_index(os.path.join(snapshot_dir, "index"),
-                                        mesh=self.mesh)
+                                        mesh=self.mesh,
+                                        int8_serving=self.config.int8_serving)
             # Pairing check: both halves carry the save's snapshot_id; a
             # mismatch means a crash landed between the two writes and one
             # half is stale. Restore proceeds (both halves are individually
@@ -1884,10 +1885,6 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
 
         self._drain_background()   # outside the mutex: the worker needs it
         with self._mutex:
-            # load_index builds a bare MemoryIndex; carry over the serving
-            # configuration or a restore would silently drop int8 serving
-            new_index.int8_serving = (self.config.int8_serving
-                                      and self.mesh is None)
             self.index = new_index
             self.user_id = host.get("user_id", self.user_id)
             self.shards.clear()

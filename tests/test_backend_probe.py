@@ -56,3 +56,33 @@ def test_probe_backend_timeout_never_hangs():
     res = bp.probe_backend(timeout=0.01, env=bp.cpu_env())
     assert res["ok"] is False
     assert "timed out" in res["error"]
+
+
+def test_ensure_healthy_or_cpu_noop_when_env_forced(monkeypatch):
+    for var in bp.ACCEL_ENV_VARS:
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    called = []
+    monkeypatch.setattr(bp, "probe_backend",
+                        lambda **kw: called.append(1) or {"ok": False})
+    health = bp.ensure_healthy_or_cpu(timeout=1.0)
+    assert health["ok"] and health.get("forced_by_env")
+    assert not called                      # genuinely env-gated: no probe
+
+
+def test_ensure_healthy_or_cpu_steers_cpu_on_failure(monkeypatch):
+    monkeypatch.setenv(bp.ACCEL_ENV_VARS[0], "10.0.0.1")  # accel plugin "live"
+    attempts = []
+
+    def fake_probe(**kw):
+        attempts.append(1)
+        return {"ok": False, "error": "wedged"}
+
+    steered = []
+    monkeypatch.setattr(bp, "probe_backend", fake_probe)
+    monkeypatch.setattr(bp, "force_cpu", lambda *a, **k: steered.append(1))
+    health = bp.ensure_healthy_or_cpu(timeout=1.0, retries=1, retry_wait=0.0)
+    assert health["ok"] is False
+    assert len(attempts) == 2              # initial + one retry
+    assert steered                         # fell back to CPU
