@@ -311,12 +311,40 @@ def bench_llm_loop(on_tpu: bool):
 
     # Schema-scaffolded decode pins the {"memories": [{"content": ...
     # shape, so even random weights yield parseable extraction payloads —
-    # the facts/sec number below exercises the REAL pipeline shape.
-    llm = OnDeviceLLM(lm=lm, max_new_tokens=192, json_scaffold=scaffold)
+    # the facts/sec number below exercises the REAL pipeline shape with
+    # BOTH model stages on device (decoder extraction + encoder embedding):
+    # the BASELINE.md north star, "no external API in the loop".
+    from lazzaro_tpu.core.providers import EncoderEmbedder
+    from lazzaro_tpu.models.encoder import EncoderConfig, TextEncoder
+
+    enc_geometry = "base" if on_tpu else "tiny"
+    embedder = EncoderEmbedder(
+        TextEncoder(getattr(EncoderConfig, enc_geometry)()))
+    embedder.batch_embed(["warmup one", "warmup two"])  # compile OUTSIDE timer
+
+    class RecordingLLM:
+        """Pass-through that keeps the last payload, so the bench can
+        report extraction candidates vs nodes surviving dedup (untrained-
+        encoder embeddings can legitimately collapse near-identical noise
+        strings into one node — that must be visible, not silent)."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.last = None
+
+        def completion(self, messages, response_format=None):
+            self.last = self.inner.completion(messages, response_format)
+            return self.last
+
+        def completion_stream(self, messages, response_format=None):
+            yield self.completion(messages, response_format)
+
+    llm = RecordingLLM(OnDeviceLLM(lm=lm, max_new_tokens=192,
+                                   json_scaffold=scaffold))
     with tempfile.TemporaryDirectory() as tmp:
         ms = MemorySystem(
             enable_async=False, auto_consolidate=False, load_from_disk=False,
-            db_dir=tmp, llm_provider=llm, embedding_provider=BulkEmbedder(),
+            db_dir=tmp, llm_provider=llm, embedding_provider=embedder,
             config=MemoryConfig(dtype="bfloat16", journal=False),
             verbose=False)
         ms.start_conversation()
@@ -328,11 +356,17 @@ def bench_llm_loop(on_tpu: bool):
         ms.end_conversation()            # LLM extract → JSON → full ingest
         dt = time.perf_counter() - t0
         facts = ms.buffer.size()[0]
+        try:
+            candidates = len(json.loads(llm.last).get("memories", []))
+        except (TypeError, ValueError, AttributeError):
+            candidates = None
         ms.close()
-    return {"geometry": geometry, "json_valid": json_valid,
+    return {"geometry": geometry, "encoder_geometry": enc_geometry,
+            "json_valid": json_valid,
             "constrained_decode_tok_per_sec": round(decode_tok_s, 1),
             "first_call_compile_s": round(compile_s, 1),
-            "facts_extracted": int(facts),
+            "extraction_candidates": candidates,
+            "facts_in_graph": int(facts),
             "llm_loop_facts_per_sec": round(facts / dt, 3) if facts else 0.0,
             "llm_loop_total_s": round(dt, 2)}
 

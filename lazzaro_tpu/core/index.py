@@ -164,6 +164,7 @@ class MemoryIndex:
             "dim": self.dim,
             "dtype": str(np.dtype(self.dtype)),
             "tenants": len(self._tenants),
+            "int8_serving": self.int8_serving,
             "mesh": (f"{self._n_parts}x {self.shard_axis}"
                      if self.mesh is not None else None),
         }
