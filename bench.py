@@ -320,7 +320,11 @@ def bench_llm_loop(on_tpu: bool):
     enc_geometry = "base" if on_tpu else "tiny"
     embedder = EncoderEmbedder(
         TextEncoder(getattr(EncoderConfig, enc_geometry)()))
-    embedder.batch_embed(["warmup one", "warmup two"])  # compile OUTSIDE timer
+    # Compile OUTSIDE the timer, in the pow2 batch buckets the pipeline
+    # actually hits (encode_batch pads to pow2: 6 facts -> bucket 8; the
+    # single-query retrieval path uses bucket 1).
+    embedder.batch_embed([f"warmup {i}" for i in range(8)])
+    embedder.embed("warmup single")
 
     class RecordingLLM:
         """Pass-through that keeps the last payload, so the bench can
@@ -475,6 +479,26 @@ def main():
     p50 = float(np.percentile(lat, 50))
     p95 = float(np.percentile(lat, 95))
 
+    # Same surface with the int8 serving shadow on (exact master retained
+    # for consolidation; single-chip only — the headline above stays exact).
+    p50_int8 = None
+    if ms.mesh is None:
+        ms.index.int8_serving = True
+        for i in range(K_WARM):          # warm + build the shadow
+            ms.search_memories(f"fact {probe[i]}: user detail number {probe[i]}")
+        lat8 = []
+        for i in range(K_WARM, K_WARM + QUERIES):
+            q = f"fact {probe[i]}: user detail number {probe[i]}"
+            t0 = time.perf_counter()
+            ms.search_memories(q)
+            lat8.append((time.perf_counter() - t0) * 1e3)
+        p50_int8 = float(np.percentile(lat8, 50))
+        ms.index.int8_serving = False
+        # drop the ~0.77 GB quantized shadow before consolidation and the
+        # kernel section allocate their own arenas
+        ms.index._int8_shadow = None
+        ms.index._int8_dirty = True
+
     # --- fleet serving: batched query path through the orchestrator ------
     # Per-dispatch latency here is round-trip-bound (~70 ms through the
     # tunnel), so throughput scales with batch size: measure 64 and 512.
@@ -558,6 +582,8 @@ def main():
         "roofline_suspect": suspect,
         "extra": {
             "p95_ms": round(p95, 4),
+            "p50_int8_serving_ms": (round(p50_int8, 4)
+                                    if p50_int8 is not None else None),
             "exact_hit_rate": round(hits_ok / QUERIES, 3),
             "ingest_pipeline_memories_per_sec_per_chip": (
                 round(ingest_per_s, 1) if ingest_per_s else None),

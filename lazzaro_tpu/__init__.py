@@ -9,7 +9,8 @@ running as batched XLA programs on an HBM-resident arena instead of Python
 loops over a CPU vector database.
 """
 
+from lazzaro_tpu.config import MemoryConfig
 from lazzaro_tpu.core.memory_system import MemorySystem
 
 __version__ = "0.1.0"
-__all__ = ["MemorySystem"]
+__all__ = ["MemorySystem", "MemoryConfig"]
