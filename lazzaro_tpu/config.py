@@ -27,6 +27,13 @@ class MemoryConfig:
     # under a mesh the flag is ignored (with a warning) — the sharded path
     # searches the exact arena through shard_map.
     int8_serving: bool = False
+    # IVF coarse stage (ops/ivf.py): > 0 sets nprobe and routes serving
+    # searches through centroid prefilter + member gather once the arena
+    # passes ~4k live rows (below that exact scans are trivial). Fresh
+    # rows serve exactly from a residual until the periodic rebuild;
+    # recall is controlled by nprobe (== n_clusters is exact). Consolidation
+    # gates always use the exact master. Single-chip only, like int8.
+    ivf_serving: int = 0
 
     # --- behavior flags (parity with memory_system.py:63-84) ---------------
     enable_sharding: bool = True

@@ -40,7 +40,7 @@ class MemoryIndex:
     def __init__(self, dim: int, capacity: int = 1024, edge_capacity: int = 8192,
                  dtype=jnp.float32, epoch: Optional[float] = None,
                  mesh=None, shard_axis: str = "data",
-                 int8_serving: bool = False):
+                 int8_serving: bool = False, ivf_nprobe: int = 0):
         self.dim = dim
         self.dtype = dtype
         # Int8 serving shadow (ops/quant.py): half the HBM bytes per scan.
@@ -59,6 +59,23 @@ class MemoryIndex:
                 "ignored under a mesh", stacklevel=3)
         self._int8_shadow = None           # (q [N,d] i8, scale [N] f32)
         self._int8_dirty = True
+        # IVF coarse stage (ops/ivf.py): nprobe > 0 routes serving searches
+        # through centroid prefilter + member gather. Rows added after a
+        # build serve EXACTLY from a residual list until the next rebuild
+        # (sealed/fresh split); rows that re-use a previously routed slot
+        # keep their (stale) cluster but are scanned with their current
+        # vector, so nothing is ever dropped. Coarse routing is geometry-
+        # global; tenant isolation is enforced at the fine stage mask.
+        if ivf_nprobe and mesh is not None:
+            import warnings
+            warnings.warn(
+                "ivf_serving is single-chip only (the mesh path searches "
+                "the exact arena through shard_map); the flag is ignored "
+                "under a mesh", stacklevel=3)
+        self.ivf_nprobe = int(ivf_nprobe) if mesh is None else 0
+        self._ivf = None
+        self._ivf_fresh: List[int] = []    # rows not yet in any member slot
+        self._ivf_routed = None            # np bool [rows]: in members/residual
         self.mesh = mesh
         self.shard_axis = shard_axis
         self._n_parts = int(mesh.shape[shard_axis]) if mesh is not None else 1
@@ -230,6 +247,20 @@ class MemoryIndex:
             jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
         )
         self._int8_dirty = True            # emb rows written
+        if self.ivf_nprobe and self._ivf is not None:
+            routed = self._ivf_routed
+            if routed is not None and len(routed) < self.state.emb.shape[0]:
+                # arena grew since the build: extend the routed bitmap so
+                # grown rows can be marked and never double-append to the
+                # residual (duplicate rows would surface twice in one top-k)
+                grown = np.zeros((self.state.emb.shape[0],), bool)
+                grown[:len(routed)] = routed
+                self._ivf_routed = routed = grown
+            for r in rows:
+                if routed is None or not routed[r]:
+                    self._ivf_fresh.append(r)
+                    if routed is not None:
+                        routed[r] = True   # never append the same row twice
         return rows
 
     def delete(self, ids: Iterable[str]) -> None:
@@ -285,6 +316,12 @@ class MemoryIndex:
         # round trips (~70 ms each on the tunneled backend) don't scale
         # with the query count.
         q_pad = jnp.asarray(pad_to_pow2(queries))
+        if self.mesh is None and self.ivf_nprobe and not exact:
+            got = self._ivf_search(q_pad, tid, k_eff, super_filter)
+            if got is not None:
+                h_scores, h_rows = got
+                return decode_topk(h_scores[:nq], h_rows[:nq],
+                                   self.row_to_id, S.NEG_INF)
         if self.mesh is None and self.int8_serving and not exact:
             from lazzaro_tpu.ops.quant import quantized_topk
 
@@ -312,6 +349,70 @@ class MemoryIndex:
         h_scores, h_rows = fetch_packed(scores, rows)
         return decode_topk(h_scores[:nq], h_rows[:nq],
                            self.row_to_id, S.NEG_INF)
+
+    # Below this many live rows an exact scan is trivially cheap and a
+    # k-means build would be pure overhead.
+    _IVF_MIN_ROWS = 4096
+
+    def _ivf_search(self, q_pad, tid: int, k_eff: int, super_filter: int):
+        """Coarse-to-fine serving scan, or None to fall through to the
+        exact/int8 paths (arena too small, or too few candidates for k)."""
+        from lazzaro_tpu.ops.ivf import ivf_search
+
+        ivf = self._ensure_ivf()
+        if ivf is None:
+            return None
+        residual = self._ivf_residual_dev()
+        n_cand = (min(self.ivf_nprobe, ivf.n_clusters) * ivf.members.shape[1]
+                  + residual.shape[0])
+        if n_cand < k_eff:
+            return None
+        mask = S.arena_mask(self.state, jnp.int32(tid), super_filter)
+        scores, rows = ivf_search(ivf.centroids, ivf.members, residual,
+                                  self.state.emb, mask, S.normalize(q_pad),
+                                  k_eff, nprobe=self.ivf_nprobe)
+        return fetch_packed(scores, rows)      # ONE readback RTT
+
+    def _ensure_ivf(self):
+        """Build or refresh the coarse index. Rebuilds only when the fresh
+        residual outgrows 25% of the sealed build (k-means is the expensive
+        part; between rebuilds fresh rows serve exactly)."""
+        n_alive = len(self.id_to_row)
+        if n_alive < self._IVF_MIN_ROWS:
+            return None
+        if (self._ivf is not None
+                and len(self._ivf_fresh) <= self._ivf.built_rows // 4):
+            return self._ivf
+        from lazzaro_tpu.ops.ivf import build_ivf
+
+        mask_np = np.asarray(self.state.alive)
+        self._ivf = build_ivf(self.state.emb, mask_np)
+        self._ivf_fresh = []
+        self._ivf_res_cache = None
+        routed = np.zeros((self.state.emb.shape[0],), bool)
+        m = np.asarray(self._ivf.members).ravel()
+        routed[m[m >= 0]] = True
+        r = np.asarray(self._ivf.residual)
+        routed[r[r >= 0]] = True
+        self._ivf_routed = routed
+        return self._ivf
+
+    def _ivf_residual_dev(self):
+        """Sealed-build residual + fresh rows as one padded device array,
+        re-uploaded only when the fresh list changed."""
+        cache = getattr(self, "_ivf_res_cache", None)
+        if cache is not None and cache[0] == len(self._ivf_fresh):
+            return cache[1]
+        from lazzaro_tpu.ops.ivf import _pow2
+
+        base = np.asarray(self._ivf.residual)
+        comb = np.concatenate([base[base >= 0],
+                               np.asarray(self._ivf_fresh, np.int32)])
+        padded = np.full((_pow2(len(comb)),), -1, np.int32)
+        padded[:len(comb)] = comb
+        dev = jnp.asarray(padded)
+        self._ivf_res_cache = (len(self._ivf_fresh), dev)
+        return dev
 
     def _mesh_searcher(self, k: int):
         """Cached shard_map distributed top-k (ops/topk.py) per k bucket."""

@@ -128,7 +128,8 @@ class MemorySystem:
         self.index = MemoryIndex(dim, capacity=cfg.initial_capacity,
                                  edge_capacity=cfg.max_edges,
                                  dtype=jnp.dtype(cfg.dtype), mesh=mesh,
-                                 int8_serving=cfg.int8_serving)
+                                 int8_serving=cfg.int8_serving,
+                                 ivf_nprobe=cfg.ivf_serving)
 
         self.query_cache = QueryCache(cfg.cache_size) if self.enable_caching else None
 

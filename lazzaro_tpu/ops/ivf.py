@@ -1,0 +1,173 @@
+"""Coarse-to-fine retrieval: centroid prefilter over the HBM arena.
+
+The exact scan reads all N·d bytes per query batch (~1.9 ms floor at
+1M×768 bf16 on a v5e). This is the OTHER honest route below that floor
+(VERDICT r3 next #7, SURVEY §7.2's hierarchy-as-coarse-stage): spherical
+k-means clusters the arena; a query scores C centroids (C ≈ √N), visits
+only the ``nprobe`` nearest clusters' member rows, and scans those — HBM
+traffic per query drops from N·d to ~(C + nprobe·N/C)·d, ~25× at 1M rows
+with C=1024, nprobe=8. Approximate by construction: recall is controlled
+by ``nprobe`` (= exact when nprobe == C, because every alive row lives in
+exactly one cluster or the residual).
+
+Freshness without per-write rebuilds (the same sealed/fresh split as the
+ArrowStore's LSM segments): rows added after a build go to a RESIDUAL set
+that every search scans exactly; a periodic rebuild folds them into the
+clusters. Skew is bounded the same way — clusters overflow their fixed
+member capacity into the residual, so no row is ever silently dropped.
+
+Reference analog: LanceDB's IVF-PQ ANN index over the raw vectors
+(vector_store.py's table ANN) — here the coarse stage is an explicit,
+testable kernel instead of a library call.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lazzaro_tpu.ops.chunking import chunked_map
+
+NEG_INF = -1e30
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters", "iters"))
+def _kmeans_device(emb: jax.Array, mask: jax.Array, init_rows: jax.Array,
+                   n_clusters: int, iters: int) -> jax.Array:
+    """Spherical k-means (cosine): normalized centroids [C, d]. Dead rows
+    never contribute; a cluster that goes empty keeps its old centroid."""
+    x = emb.astype(jnp.float32)
+    cent = x[init_rows]                                    # [C, d]
+
+    def assign(c):
+        def chunk(rows):
+            scores = jnp.dot(x[rows], c.T,
+                             preferred_element_type=jnp.float32)
+            return jnp.argmax(scores, axis=1).astype(jnp.int32)
+        return chunked_map(chunk, jnp.arange(x.shape[0], dtype=jnp.int32))
+
+    def step(c, _):
+        a = jnp.where(mask, assign(c), n_clusters)         # dead -> bucket C
+        sums = jnp.zeros((n_clusters + 1, x.shape[1]), jnp.float32
+                         ).at[a].add(jnp.where(mask[:, None], x, 0.0))
+        counts = jnp.zeros((n_clusters + 1,), jnp.float32).at[a].add(
+            mask.astype(jnp.float32))
+        new = sums[:n_clusters]
+        norms = jnp.linalg.norm(new, axis=1, keepdims=True)
+        new = jnp.where((counts[:n_clusters, None] > 0) & (norms > 1e-9),
+                        new / jnp.maximum(norms, 1e-9), c)
+        return new, None
+
+    cent, _ = jax.lax.scan(step, cent, None, length=iters)
+    return cent
+
+
+@jax.jit
+def _assign_device(emb: jax.Array, mask: jax.Array, cent: jax.Array
+                   ) -> jax.Array:
+    """Final cluster assignment [N] (dead rows -> -1)."""
+    x = emb.astype(jnp.float32)
+
+    def chunk(rows):
+        scores = jnp.dot(x[rows], cent.T, preferred_element_type=jnp.float32)
+        return jnp.argmax(scores, axis=1).astype(jnp.int32)
+
+    a = chunked_map(chunk, jnp.arange(x.shape[0], dtype=jnp.int32))
+    return jnp.where(mask, a, -1)
+
+
+@dataclass
+class IvfIndex:
+    centroids: jax.Array     # [C, d] f32, L2-normalized
+    members: jax.Array       # [C, M] i32 arena rows, -1 padded
+    residual: jax.Array      # [R] i32 arena rows scanned exactly, -1 padded
+    built_rows: int          # alive rows at build time (staleness signal)
+
+    @property
+    def n_clusters(self) -> int:
+        return self.centroids.shape[0]
+
+
+def _pow2(n: int, lo: int = 8) -> int:
+    return max(lo, 1 << max(0, int(n - 1)).bit_length())
+
+
+def build_ivf(emb: jax.Array, mask_np: np.ndarray,
+              n_clusters: Optional[int] = None, iters: int = 8,
+              member_cap_factor: int = 4, seed: int = 0) -> IvfIndex:
+    """Cluster the alive rows and build the fixed-shape member table.
+
+    ``member_cap_factor``: per-cluster capacity = factor · N/C (pow2-
+    rounded); rows beyond a cluster's capacity overflow into the residual,
+    so skewed data degrades to a bigger exact scan — never to dropped
+    rows."""
+    alive_rows = np.nonzero(mask_np)[0]
+    n_alive = len(alive_rows)
+    if n_alive == 0:
+        raise ValueError("cannot build an IVF over an empty arena")
+    if n_clusters is None:
+        n_clusters = max(4, _pow2(int(np.sqrt(n_alive)), lo=4))
+    n_clusters = min(n_clusters, n_alive)
+    rng = np.random.default_rng(seed)
+    init = rng.choice(alive_rows, size=n_clusters, replace=False)
+
+    mask = jnp.asarray(mask_np)
+    cent = _kmeans_device(emb, mask, jnp.asarray(init, jnp.int32),
+                          n_clusters, iters)
+    assign = np.asarray(_assign_device(emb, mask, cent))
+
+    cap = _pow2(member_cap_factor * max(1, n_alive // n_clusters))
+    members = np.full((n_clusters, cap), -1, np.int32)
+    overflow = []
+    fill = np.zeros((n_clusters,), np.int64)
+    for row in alive_rows:
+        c = assign[row]
+        if fill[c] < cap:
+            members[c, fill[c]] = row
+            fill[c] += 1
+        else:
+            overflow.append(row)
+    residual = np.full((_pow2(len(overflow), lo=8),), -1, np.int32)
+    residual[:len(overflow)] = overflow
+    return IvfIndex(centroids=cent, members=jnp.asarray(members),
+                    residual=jnp.asarray(residual), built_rows=n_alive)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "q_chunk"))
+def ivf_search(centroids: jax.Array, members: jax.Array, residual: jax.Array,
+               emb: jax.Array, mask: jax.Array, queries: jax.Array,
+               k: int, nprobe: int = 8, q_chunk: int = 8
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Coarse (centroid) → fine (member gather) masked top-k.
+
+    Per query: score C centroids, take the ``nprobe`` best clusters,
+    gather their member rows plus the residual, score those candidates
+    exactly, and top-k. Candidate tensors are [q_chunk, nprobe·M + R, d],
+    so queries stream in small chunks to bound the gather footprint."""
+    q = queries.astype(jnp.float32)
+    q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-9)
+    nprobe = min(nprobe, centroids.shape[0])
+
+    def chunk(q_c):                                        # [qc, d]
+        cs = jnp.dot(q_c, centroids.T,
+                     preferred_element_type=jnp.float32)   # [qc, C]
+        _, cids = jax.lax.top_k(cs, nprobe)                # [qc, P]
+        cand = members[cids].reshape(q_c.shape[0], -1)     # [qc, P*M]
+        cand = jnp.concatenate(
+            [cand, jnp.broadcast_to(residual[None, :],
+                                    (q_c.shape[0], residual.shape[0]))],
+            axis=1)                                        # [qc, P*M+R]
+        safe = jnp.maximum(cand, 0)
+        vecs = emb[safe].astype(jnp.float32)               # [qc, L, d]
+        scores = jnp.einsum("qld,qd->ql", vecs, q_c)
+        valid = (cand >= 0) & mask[safe]
+        scores = jnp.where(valid, scores, NEG_INF)
+        ts, pos = jax.lax.top_k(scores, k)
+        return ts, jnp.take_along_axis(cand, pos, axis=1)
+
+    return chunked_map(chunk, q, chunk=q_chunk)
