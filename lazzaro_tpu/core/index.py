@@ -182,6 +182,9 @@ class MemoryIndex:
             "dtype": str(np.dtype(self.dtype)),
             "tenants": len(self._tenants),
             "int8_serving": self.int8_serving,
+            "ivf": (f"nprobe={self.ivf_nprobe}, "
+                    f"{'built' if self._ivf is not None else 'pending'}"
+                    if self.ivf_nprobe else None),
             "mesh": (f"{self._n_parts}x {self.shard_axis}"
                      if self.mesh is not None else None),
         }
@@ -282,11 +285,12 @@ class MemoryIndex:
             self._free_edge_slots.append(self.edge_slots.pop(k))
 
     def search(self, query: np.ndarray, tenant: str, k: int = 10,
-               super_filter: int = 0) -> Tuple[List[str], List[float]]:
+               super_filter: int = 0, exact: bool = False
+               ) -> Tuple[List[str], List[float]]:
         """Masked cosine top-k; returns (ids, scores), dead/padded hits
         dropped. Single-query view of ``search_batch``."""
         return self.search_batch(np.asarray(query, np.float32)[None, :],
-                                 tenant, k, super_filter)[0]
+                                 tenant, k, super_filter, exact=exact)[0]
 
     def search_batch(self, queries: np.ndarray, tenant: str, k: int = 10,
                      super_filter: int = 0, exact: bool = False
@@ -356,11 +360,16 @@ class MemoryIndex:
 
     def _ivf_search(self, q_pad, tid: int, k_eff: int, super_filter: int):
         """Coarse-to-fine serving scan, or None to fall through to the
-        exact/int8 paths (arena too small, or too few candidates for k)."""
+        exact/int8 paths. Falls through when: no build exists yet (builds
+        happen in ``ivf_maintenance``, NEVER on the query path — a k-means
+        over 1M rows is multi-second), the super-node gate is being
+        evaluated (threshold-sensitive: a missed cluster would
+        nondeterministically disable the hierarchy fast path), or there
+        are too few candidates for k."""
         from lazzaro_tpu.ops.ivf import ivf_search
 
-        ivf = self._ensure_ivf()
-        if ivf is None:
+        ivf = self._ivf
+        if ivf is None or super_filter == 1:
             return None
         residual = self._ivf_residual_dev()
         n_cand = (min(self.ivf_nprobe, ivf.n_clusters) * ivf.members.shape[1]
@@ -373,16 +382,20 @@ class MemoryIndex:
                                   k_eff, nprobe=self.ivf_nprobe)
         return fetch_packed(scores, rows)      # ONE readback RTT
 
-    def _ensure_ivf(self):
-        """Build or refresh the coarse index. Rebuilds only when the fresh
-        residual outgrows 25% of the sealed build (k-means is the expensive
-        part; between rebuilds fresh rows serve exactly)."""
+    def ivf_maintenance(self) -> bool:
+        """Build or refresh the coarse index; returns True if a (re)build
+        ran. Rebuilds only when the fresh residual outgrows 25% of the
+        sealed build. This is the ONLY place the k-means runs — call it
+        from background maintenance (the consolidation worker does), never
+        from a serving query."""
+        if not self.ivf_nprobe:
+            return False
         n_alive = len(self.id_to_row)
         if n_alive < self._IVF_MIN_ROWS:
-            return None
+            return False
         if (self._ivf is not None
                 and len(self._ivf_fresh) <= self._ivf.built_rows // 4):
-            return self._ivf
+            return False
         from lazzaro_tpu.ops.ivf import build_ivf
 
         mask_np = np.asarray(self.state.alive)
@@ -395,7 +408,7 @@ class MemoryIndex:
         r = np.asarray(self._ivf.residual)
         routed[r[r >= 0]] = True
         self._ivf_routed = routed
-        return self._ivf
+        return True
 
     def _ivf_residual_dev(self):
         """Sealed-build residual + fresh rows as one padded device array,

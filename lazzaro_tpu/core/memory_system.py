@@ -648,7 +648,10 @@ class MemorySystem:
         # 1. Hierarchy fast path: one masked top-k over super-node rows
         #    (replaces the O(#super × d) Python scan, memory_system.py:464-482).
         if self.enable_hierarchy and self.super_nodes:
-            sids, sscores = self.index.search(q, self.user_id, k=1, super_filter=1)
+            # threshold-gated decision (0.4 super-node gate): always the
+            # exact master — approximate serving modes could flip it
+            sids, sscores = self.index.search(q, self.user_id, k=1,
+                                              super_filter=1, exact=True)
             if sids and sscores[0] > self.config.super_node_gate:
                 best = self.super_nodes.get(sids[0].partition(":")[2])
                 if best is not None:
@@ -963,6 +966,14 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
 
         if self.query_cache:
             self.query_cache.invalidate_results()
+
+        # IVF coarse-index upkeep belongs to background maintenance (this
+        # runs on the single consolidation worker), never a serving query —
+        # a 1M-row k-means is multi-second.
+        if self.index.ivf_nprobe:
+            with self._mutex:
+                if self.index.ivf_maintenance():
+                    self._log("🧭 IVF coarse index rebuilt")
 
         elapsed = time.time() - start_time
         self.metrics["consolidation_times"].append(elapsed)

@@ -115,11 +115,17 @@ def test_memory_index_ivf_serving_and_freshness():
         idx.add(ids[s:s + 1000], emb[s:s + 1000], [0.5] * 1000, [0.0] * 1000,
                 ["semantic"] * 1000, ["default"] * 1000, "u1")
 
-    # self-lookup recall through the coarse stage
+    # builds run ONLY via explicit maintenance (never on the query path)
     probe = rng.integers(0, n, 50)
+    idx.search_batch(emb[probe[:2]], "u1", k=1)
+    assert idx._ivf is None               # serving query didn't build
+    assert idx.ivf_maintenance()          # background-maintenance analog
+    assert idx._ivf is not None
+    assert not idx.ivf_maintenance()      # fresh list empty: no rebuild
+
+    # self-lookup recall through the coarse stage
     res = idx.search_batch(emb[probe], "u1", k=1)
     hits = sum(1 for p, (got, _) in zip(probe, res) if got == [f"m{p}"])
-    assert idx._ivf is not None           # build actually happened
     assert hits >= 47, f"ivf self-recall {hits}/50"
 
     # a fresh post-build row must be served exactly via the residual
