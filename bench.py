@@ -53,7 +53,10 @@ from lazzaro_tpu.utils import backend_probe  # noqa: E402  (no backend touch)
 N = int(os.environ.get("BENCH_N", 1_000_000))
 DIM = int(os.environ.get("BENCH_DIM", 768))
 INGEST_BUDGET_S = float(os.environ.get("BENCH_INGEST_BUDGET_S", 3000))
-CPU_FALLBACK_N = 100_000
+# Degraded (TPU-unreachable) runs fall back to CPU at a size that finishes
+# well inside any driver window — a slow fallback that gets killed leaves
+# NO parseable artifact, which defeats the point of falling back.
+CPU_FALLBACK_N = 20_000
 
 _degraded_error = None
 _health = backend_probe.ensure_healthy_or_cpu(timeout=120.0, retries=1)
