@@ -11,6 +11,12 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
+# Strip the accelerator-plugin vars: tests must NEVER touch the TPU tunnel
+# (a leaked handle is what voided round 3), and with them absent the
+# backend_probe env gate recognizes this as genuinely CPU-forced, so
+# entry()/dryrun tests skip the (90 s on a wedged tunnel) subprocess probe.
+for _var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+    os.environ.pop(_var, None)
 
 # The axon sitecustomize pins the TPU backend via env at interpreter start;
 # config.update after import is the reliable override in this image.

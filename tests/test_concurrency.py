@@ -139,7 +139,8 @@ def test_concurrent_serving_modes_during_async_ingest(tmp_path):
                 errors.append(e)
                 return
 
-    threads = [threading.Thread(target=reader) for _ in range(3)]
+    threads = [threading.Thread(target=reader, daemon=True)
+               for _ in range(3)]
     for t in threads:
         t.start()
     try:
@@ -148,8 +149,10 @@ def test_concurrent_serving_modes_during_async_ingest(tmp_path):
             ms.chat(f"I work on project {c} as a data engineer.")
             ms.end_conversation()
         # drain while readers are STILL live: the queued consolidations'
-        # arena mutations are exactly the race window under test
-        ms._drain_background()
+        # arena mutations are exactly the race window under test — with a
+        # bounded wait, so a drain deadlock FAILS instead of hanging pytest
+        assert ms.background_executor is not None
+        ms.background_executor.submit(lambda: None).result(timeout=60)
     finally:
         stop.set()
         for t in threads:
