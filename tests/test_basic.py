@@ -104,3 +104,22 @@ def test_edge_placement_cache_o1_and_self_healing(tmp_db):
     assert ms._find_edge(("n0", "n1")) is None
     assert ("n0", "n1") not in ms._edge_shard
     ms.close()
+
+
+def test_fetch_packed_bitcast_round_trip():
+    """One-readback packed fetch (utils/batching): ints bitcast through f32
+    must round-trip bit-exactly, including negatives/sentinels and extreme
+    values; floats come back untouched."""
+    import numpy as np
+    import jax.numpy as jnp
+    from lazzaro_tpu.utils.batching import fetch_packed
+
+    f = np.array([[1.5, -2.25], [3.0, float("-1e30")]], np.float32)
+    i = np.array([[-1, 2147483647], [-2147483648, 0]], np.int32)
+    f2 = np.array([[0.0, 1e-38], [np.pi, -0.0]], np.float32)
+    got_f, got_i, got_f2 = fetch_packed(jnp.asarray(f), jnp.asarray(i),
+                                        jnp.asarray(f2))
+    np.testing.assert_array_equal(got_f, f)
+    np.testing.assert_array_equal(got_i, i)
+    np.testing.assert_array_equal(got_f2, f2)
+    assert got_i.dtype == np.int32 and got_f.dtype == np.float32
