@@ -571,6 +571,30 @@ def main():
     arena_rows = ms.index.state.emb.shape[0]
     ms.close()
 
+    # Snapshot the measurements gathered so far to stderr + a sidecar file:
+    # if an external window kills this process during the remaining stages
+    # (kernel A/Bs, the multi-minute LLM compile), the captured artifact's
+    # stderr tail still carries every system-level number instead of
+    # losing the whole run.
+    partial = {
+        "partial": True, "p50_ms": round(p50, 4), "p95_ms": round(p95, 4),
+        "p50_int8_serving_ms": p50_int8, "p50_ivf_serving_ms": p50_ivf,
+        "exact_hit_rate": hits_ok / QUERIES, "graph_nodes": nodes,
+        "ingest_total_s": round(t_ingest, 1),
+        "batched_search_qps": {str(b): round(v, 1)
+                               for b, v in batch_qps.items()},
+        "deep_consolidation_s": (round(t_consolidation, 1)
+                                 if t_consolidation is not None else None),
+    }
+    print(f"[bench] partial results: {json.dumps(partial)}",
+          file=sys.stderr, flush=True)
+    partial_path = os.path.join(workdir, f"bench_partial_{TOTAL}_{DIM}.json")
+    try:
+        with open(partial_path, "w") as f:
+            json.dump(partial, f)
+    except OSError:
+        pass
+
     t_kernel_phase = time.perf_counter()
     (kernel_p50s, batch64_ms, int8_batch64_ms, kernel_rows,
      scatter_rows) = bench_kernels(on_tpu)
@@ -666,6 +690,12 @@ def main():
     }
     if _degraded_error:
         out["error"] = _degraded_error
+    # the run completed: retire the crash-salvage sidecar so a stale
+    # partial can never be attributed to a later killed run
+    try:
+        os.unlink(partial_path)
+    except OSError:
+        pass
     print(json.dumps(out))
 
 
