@@ -139,3 +139,62 @@ def test_memory_index_ivf_serving_and_freshness():
     # exact=True must bypass the coarse stage entirely
     (got_exact, _), = idx.search_batch(fresh, "u1", k=1, exact=True)
     assert got_exact == ["fresh"]
+
+
+def test_system_maintenance_hook_builds_ivf(tmp_path):
+    """MemorySystem with ivf_serving on: once ingest passes the build
+    threshold, the consolidation worker's maintenance hook builds the
+    coarse index — no serving query ever pays for the k-means."""
+    import json as _json
+
+    from lazzaro_tpu.config import MemoryConfig
+    from lazzaro_tpu.core.memory_system import MemorySystem
+
+    d = 16
+    per, convs = 1600, 3                   # 4800 > _IVF_MIN_ROWS after conv 3
+
+    class Emb:
+        dim = d
+
+        def _v(self, t):
+            rng = np.random.default_rng(abs(hash(t)) % (1 << 31))
+            v = rng.standard_normal(d)
+            return (v / np.linalg.norm(v)).tolist()
+
+        def embed(self, t):
+            return self._v(t)
+
+        def batch_embed(self, ts):
+            return [self._v(t) for t in ts]
+
+    class LLM:
+        def __init__(self):
+            self.c = 0
+
+        def completion(self, messages, response_format=None):
+            base = self.c * per
+            self.c += 1
+            return _json.dumps({"memories": [
+                {"content": f"fact {base + i} body", "type": "semantic",
+                 "salience": 0.6} for i in range(per)]})
+
+        def completion_stream(self, messages, response_format=None):
+            yield self.completion(messages, response_format)
+
+    ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False,
+                      llm_provider=LLM(), embedding_provider=Emb(),
+                      max_buffer_size=20000,
+                      config=MemoryConfig(journal=False, ivf_serving=4,
+                                          initial_capacity=8192,
+                                          auto_consolidate=False))
+    for c in range(convs):
+        ms.start_conversation()
+        ms.add_to_short_term(f"conversation {c}", "episodic", 0.7)
+        ms.end_conversation()
+        if c < convs - 1:
+            assert ms.index._ivf is None   # below threshold: no build yet
+    assert ms.index._ivf is not None       # worker hook built it
+    hits = ms.search_memories("fact 42 body")
+    assert hits
+    ms.close()
