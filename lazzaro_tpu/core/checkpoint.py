@@ -240,13 +240,15 @@ def save_index(index: MemoryIndex, ckpt_dir: str,
 
 
 def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
-               int8_serving: bool = False) -> MemoryIndex:
+               int8_serving: bool = False, ivf_nprobe: int = 0) -> MemoryIndex:
     """Rebuild a MemoryIndex from the snapshot ``CURRENT`` points at.
 
     ``mesh``: restore row-sharded over the mesh axis (the saved total row
     count must divide the axis size — mesh-created indexes guarantee this
-    via capacity rounding). ``int8_serving`` flows into the constructor so
-    its single-chip clamp + warning apply in the one place they live."""
+    via capacity rounding). ``int8_serving``/``ivf_nprobe`` flow into the
+    constructor so the single-chip clamp + warning apply in the one place
+    they live; a restored system keeps serving in its configured mode (the
+    next consolidation pass rebuilds the coarse IVF stage)."""
     data, meta = _read_versioned(ckpt_dir)
     if meta.get("kind") == "sharded":
         raise ValueError(f"{ckpt_dir} is a sharded-index checkpoint — use "
@@ -265,7 +267,7 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
     dt = jnp.bfloat16 if meta["dtype"] == "bfloat16" else jnp.dtype(meta["dtype"])
     index = MemoryIndex(meta["dim"], capacity=1, edge_capacity=1, dtype=dt,
                         epoch=meta["epoch"], mesh=mesh, shard_axis=shard_axis,
-                        int8_serving=int8_serving)
+                        int8_serving=int8_serving, ivf_nprobe=ivf_nprobe)
     index.state = arena        # setter re-shards over the mesh if given
     index.edge_state = edges
 

@@ -62,10 +62,12 @@ class MemoryIndex:
         # IVF coarse stage (ops/ivf.py): nprobe > 0 routes serving searches
         # through centroid prefilter + member gather. Rows added after a
         # build serve EXACTLY from a residual list until the next rebuild
-        # (sealed/fresh split); rows that re-use a previously routed slot
-        # keep their (stale) cluster but are scanned with their current
-        # vector, so nothing is ever dropped. Coarse routing is geometry-
-        # global; tenant isolation is enforced at the fine stage mask.
+        # (sealed/fresh split). delete() un-routes freed MEMBER slots, so a
+        # re-used slot joins the fresh residual (scanned exactly with its
+        # new vector) instead of inheriting the dead vector's cluster;
+        # sealed-residual slots stay routed (the residual already scans the
+        # current vector). Nothing is ever dropped. Coarse routing is
+        # geometry-global; tenant isolation is the fine-stage mask.
         if ivf_nprobe and mesh is not None:
             import warnings
             warnings.warn(
@@ -73,9 +75,17 @@ class MemoryIndex:
                 "the exact arena through shard_map); the flag is ignored "
                 "under a mesh", stacklevel=3)
         self.ivf_nprobe = int(ivf_nprobe) if mesh is None else 0
-        self._ivf = None
-        self._ivf_fresh: List[int] = []    # rows not yet in any member slot
+        # Concurrency contract (advisor r4): writers (add/delete/
+        # ivf_maintenance, all on the single-writer side) publish the build
+        # and its fresh-row list as ONE immutable tuple, so a concurrent
+        # reader can never pair a new member table with an old residual (or
+        # vice versa). ``_ivf_routed``/``_ivf_stale`` are writer-side
+        # bookkeeping only — readers never touch them.
+        self._ivf_pack: Optional[tuple] = None  # (IvfIndex, fresh_rows tuple)
         self._ivf_routed = None            # np bool [rows]: in members/residual
+        self._ivf_in_residual = None       # np bool [rows]: in SEALED residual
+        self._ivf_stale = 0                # member slots invalidated by delete
+        self._ivf_res_cache = None         # (ivf, len(fresh), device residual)
         self.mesh = mesh
         self.shard_axis = shard_axis
         self._n_parts = int(mesh.shape[shard_axis]) if mesh is not None else 1
@@ -99,6 +109,29 @@ class MemoryIndex:
         self._shards: Dict[str, int] = {}
         self.tenant_nodes: Dict[str, set] = {}
         self._mesh_topk_cache: Dict[int, object] = {}
+
+    # Compat views over the atomic pack (tests/bench poke these; assigning
+    # ``_ivf = None`` drops the whole build, freeing members + residual).
+    @property
+    def _ivf(self):
+        pack = self._ivf_pack
+        return pack[0] if pack is not None else None
+
+    @_ivf.setter
+    def _ivf(self, v) -> None:
+        # Drop ALL per-build state — the residual cache in particular pins
+        # the members table and the padded device residual, so leaving it
+        # would defeat the setter's freeing purpose.
+        self._ivf_res_cache = None
+        self._ivf_routed = None
+        self._ivf_in_residual = None
+        self._ivf_stale = 0
+        self._ivf_pack = None if v is None else (v, ())
+
+    @property
+    def _ivf_fresh(self) -> List[int]:
+        pack = self._ivf_pack
+        return list(pack[1]) if pack is not None else []
 
     # -------------------------------------------------------------- sharding
     def _round_capacity(self, capacity: int, block: bool = True) -> int:
@@ -250,7 +283,9 @@ class MemoryIndex:
             jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
         )
         self._int8_dirty = True            # emb rows written
-        if self.ivf_nprobe and self._ivf is not None:
+        pack = self._ivf_pack
+        if self.ivf_nprobe and pack is not None:
+            ivf, ivf_fresh = pack
             routed = self._ivf_routed
             if routed is not None and len(routed) < self.state.emb.shape[0]:
                 # arena grew since the build: extend the routed bitmap so
@@ -259,11 +294,16 @@ class MemoryIndex:
                 grown = np.zeros((self.state.emb.shape[0],), bool)
                 grown[:len(routed)] = routed
                 self._ivf_routed = routed = grown
+            appended = []
             for r in rows:
                 if routed is None or not routed[r]:
-                    self._ivf_fresh.append(r)
+                    appended.append(r)
                     if routed is not None:
                         routed[r] = True   # never append the same row twice
+            if appended:
+                # ONE tuple swap: a concurrent reader sees either the old
+                # or the new (build, fresh) pair, never a torn mix
+                self._ivf_pack = (ivf, ivf_fresh + tuple(appended))
         return rows
 
     def delete(self, ids: Iterable[str]) -> None:
@@ -279,6 +319,37 @@ class MemoryIndex:
         self.state = S.arena_delete(self.state, jnp.asarray(padded))
         self.edge_state = S.edges_delete_for_nodes(self.edge_state, jnp.asarray(padded))
         self._free_rows.extend(rows)
+        routed = self._ivf_routed
+        if routed is not None:
+            # Per-build bookkeeping, by where the freed slot lives:
+            #  - fresh residual: drop it from the fresh tuple (a re-add must
+            #    append exactly once — leaving it would grow the residual
+            #    with duplicates every churn cycle) and un-route it;
+            #  - sealed residual: leave it routed — the residual scans the
+            #    slot's CURRENT vector, so a re-add is served exactly with
+            #    no action and no staleness;
+            #  - member slot: un-route (a re-add must not inherit the dead
+            #    vector's cluster) and count toward the rebuild trigger so
+            #    churn at stable row count still converges to a rebuild
+            #    (advisor r4).
+            pack = self._ivf_pack
+            fresh_set = set(pack[1]) if pack is not None else set()
+            in_res = self._ivf_in_residual
+            dropped_fresh = set()
+            for r in rows:
+                if r >= len(routed) or not routed[r]:
+                    continue
+                if r in fresh_set:
+                    routed[r] = False
+                    dropped_fresh.add(r)
+                elif in_res is not None and r < len(in_res) and in_res[r]:
+                    pass                   # sealed residual: already exact
+                else:
+                    routed[r] = False
+                    self._ivf_stale += 1
+            if dropped_fresh:
+                self._ivf_pack = (pack[0], tuple(
+                    x for x in pack[1] if x not in dropped_fresh))
         dead = [k for k, slot in self.edge_slots.items()
                 if k[0] not in self.id_to_row or k[1] not in self.id_to_row]
         for k in dead:
@@ -329,12 +400,25 @@ class MemoryIndex:
         if self.mesh is None and self.int8_serving and not exact:
             from lazzaro_tpu.ops.quant import quantized_topk
 
-            if self._int8_dirty or self._int8_shadow is None:
+            # ONE state snapshot feeds both the shadow and the mask: a
+            # concurrent add/grow between two self.state reads would pair
+            # an [N_old] shadow with an [N_new] mask (shape crash) — the
+            # arena pytree is immutable, so everything derived from ``st``
+            # is self-consistent (advisor r4, medium).
+            st = self.state
+            shadow = self._int8_shadow
+            if (self._int8_dirty or shadow is None
+                    or shadow[0].shape[0] != st.emb.shape[0]):
                 from lazzaro_tpu.ops.quant import quantize_rows
-                self._int8_shadow = quantize_rows(self.state.emb)
-                self._int8_dirty = False
-            q8, qscale = self._int8_shadow
-            mask = S.arena_mask(self.state, jnp.int32(tid), super_filter)
+                shadow = quantize_rows(st.emb)
+                self._int8_shadow = shadow
+                if self.state is st:
+                    # only clear the flag if no writer raced past ``st`` —
+                    # otherwise rows added mid-quantize would stay invisible
+                    # to int8 serving until the NEXT mutation
+                    self._int8_dirty = False
+            q8, qscale = shadow
+            mask = S.arena_mask(st, jnp.int32(tid), super_filter)
             scores, rows = quantized_topk(q8, qscale, mask,
                                           S.normalize(q_pad), k_eff)
         elif self.mesh is None:
@@ -368,17 +452,23 @@ class MemoryIndex:
         are too few candidates for k."""
         from lazzaro_tpu.ops.ivf import ivf_search
 
-        ivf = self._ivf
-        if ivf is None or super_filter == 1:
+        # Atomic snapshots: the (build, fresh) pair comes from ONE tuple
+        # read, and mask + emb both derive from ONE immutable arena state —
+        # a racing writer can swap either underneath us but never tear them
+        # (advisor r4).
+        pack = self._ivf_pack
+        if pack is None or super_filter == 1:
             return None
-        residual = self._ivf_residual_dev()
+        ivf, fresh = pack
+        st = self.state
+        residual = self._ivf_residual_dev(ivf, fresh)
         n_cand = (min(self.ivf_nprobe, ivf.n_clusters) * ivf.members.shape[1]
                   + residual.shape[0])
         if n_cand < k_eff:
             return None
-        mask = S.arena_mask(self.state, jnp.int32(tid), super_filter)
+        mask = S.arena_mask(st, jnp.int32(tid), super_filter)
         scores, rows = ivf_search(ivf.centroids, ivf.members, residual,
-                                  self.state.emb, mask, S.normalize(q_pad),
+                                  st.emb, mask, S.normalize(q_pad),
                                   k_eff, nprobe=self.ivf_nprobe)
         return fetch_packed(scores, rows)      # ONE readback RTT
 
@@ -393,38 +483,52 @@ class MemoryIndex:
         n_alive = len(self.id_to_row)
         if n_alive < self._IVF_MIN_ROWS:
             return False
-        if (self._ivf is not None
-                and len(self._ivf_fresh) <= self._ivf.built_rows // 4):
+        pack = self._ivf_pack
+        if (pack is not None
+                and len(pack[1]) + self._ivf_stale <= pack[0].built_rows // 4):
+            # staleness = rows awaiting a member slot PLUS member slots
+            # invalidated by delete — churn at stable row count still trips
+            # the trigger (advisor r4)
             return False
         from lazzaro_tpu.ops.ivf import build_ivf
 
-        mask_np = np.asarray(self.state.alive)
-        self._ivf = build_ivf(self.state.emb, mask_np)
-        self._ivf_fresh = []
-        self._ivf_res_cache = None
-        routed = np.zeros((self.state.emb.shape[0],), bool)
-        m = np.asarray(self._ivf.members).ravel()
+        st = self.state
+        mask_np = np.asarray(st.alive)
+        ivf = build_ivf(st.emb, mask_np)
+        routed = np.zeros((st.emb.shape[0],), bool)
+        m = np.asarray(ivf.members).ravel()
         routed[m[m >= 0]] = True
-        r = np.asarray(self._ivf.residual)
-        routed[r[r >= 0]] = True
+        r = np.asarray(ivf.residual)
+        in_res = np.zeros((st.emb.shape[0],), bool)
+        in_res[r[r >= 0]] = True
+        routed |= in_res
+        # writer-side bookkeeping first, the reader-visible pack LAST — a
+        # reader can only ever observe a fully-initialized build
         self._ivf_routed = routed
+        self._ivf_in_residual = in_res
+        self._ivf_stale = 0
+        self._ivf_res_cache = None
+        self._ivf_pack = (ivf, ())
         return True
 
-    def _ivf_residual_dev(self):
+    def _ivf_residual_dev(self, ivf, fresh):
         """Sealed-build residual + fresh rows as one padded device array,
-        re-uploaded only when the fresh list changed."""
-        cache = getattr(self, "_ivf_res_cache", None)
-        if cache is not None and cache[0] == len(self._ivf_fresh):
-            return cache[1]
+        re-uploaded only when the (build, fresh) snapshot changed. Cache
+        validity is keyed on the build object identity (pinned by the cache
+        tuple itself) + fresh length, so a rebuild can never serve the old
+        residual against the new member table."""
+        cache = self._ivf_res_cache
+        if cache is not None and cache[0] is ivf and cache[1] == len(fresh):
+            return cache[2]
         from lazzaro_tpu.ops.ivf import _pow2
 
-        base = np.asarray(self._ivf.residual)
+        base = np.asarray(ivf.residual)
         comb = np.concatenate([base[base >= 0],
-                               np.asarray(self._ivf_fresh, np.int32)])
+                               np.asarray(fresh, np.int32)])
         padded = np.full((_pow2(len(comb)),), -1, np.int32)
         padded[:len(comb)] = comb
         dev = jnp.asarray(padded)
-        self._ivf_res_cache = (len(self._ivf_fresh), dev)
+        self._ivf_res_cache = (ivf, len(fresh), dev)
         return dev
 
     def _mesh_searcher(self, k: int):

@@ -1871,7 +1871,8 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         try:
             new_index = ckpt.load_index(os.path.join(snapshot_dir, "index"),
                                         mesh=self.mesh,
-                                        int8_serving=self.config.int8_serving)
+                                        int8_serving=self.config.int8_serving,
+                                        ivf_nprobe=self.config.ivf_serving)
             # Pairing check: both halves carry the save's snapshot_id; a
             # mismatch means a crash landed between the two writes and one
             # half is stale. Restore proceeds (both halves are individually

@@ -67,16 +67,24 @@ def pad_to_pow2(arr: np.ndarray) -> np.ndarray:
 def decode_topk(scores: np.ndarray, rows: np.ndarray,
                 row_to_id: Dict[int, str], neg_inf: float
                 ) -> List[Tuple[List[str], List[float]]]:
-    """Per query: drop NEG_INF sentinels and rows without a live id mapping;
-    return (ids, scores) pairs."""
+    """Per query: drop NEG_INF sentinels, rows without a live id mapping,
+    and repeated rows (a slot reused after delete can appear in both a
+    stale IVF member slot and the fresh residual — scores are sorted
+    descending, so keeping the first occurrence keeps the best); return
+    (ids, scores) pairs."""
     out: List[Tuple[List[str], List[float]]] = []
     for qi in range(scores.shape[0]):
         ids: List[str] = []
         sc: List[float] = []
+        seen = set()
         for s, r in zip(scores[qi], rows[qi]):
             if s <= neg_inf / 2:
                 continue
-            node_id = row_to_id.get(int(r))
+            r = int(r)
+            if r in seen:
+                continue
+            seen.add(r)
+            node_id = row_to_id.get(r)
             if node_id is not None:
                 ids.append(node_id)
                 sc.append(float(s))

@@ -198,3 +198,56 @@ def test_system_maintenance_hook_builds_ivf(tmp_path):
     hits = ms.search_memories("fact 42 body")
     assert hits
     ms.close()
+
+
+def test_delete_readd_churn_triggers_rebuild_and_serves_new_vector():
+    """Slots reused after delete must (a) count toward the rebuild trigger
+    even at stable row count, (b) be served with their NEW vector via the
+    fresh residual instead of the dead vector's stale cluster, and (c)
+    never surface the same node twice in one top-k (the reused row can sit
+    in both a stale member slot and the residual). Advisor r4 findings."""
+    from lazzaro_tpu.core.index import MemoryIndex
+
+    rng = np.random.default_rng(11)
+    d = 32
+    n = 5000
+    emb = rng.standard_normal((n, d)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    idx = MemoryIndex(dim=d, capacity=n + 64, ivf_nprobe=8)
+    ids = [f"m{i}" for i in range(n)]
+    idx.add(ids, emb, [0.5] * n, [0.0] * n, ["semantic"] * n,
+            ["default"] * n, "u1")
+    assert idx.ivf_maintenance()
+    built = idx._ivf
+
+    # churn: delete/re-add the same 30% of rows with NEW vectors — row
+    # count is stable the whole time
+    churn = [f"m{i}" for i in range(0, n, 3)]
+    idx.delete(churn)
+    emb2 = rng.standard_normal((len(churn), d)).astype(np.float32)
+    emb2 /= np.linalg.norm(emb2, axis=1, keepdims=True)
+    idx.add(churn, emb2, [0.5] * len(churn), [0.0] * len(churn),
+            ["semantic"] * len(churn), ["default"] * len(churn), "u1")
+
+    # (b) reused slots serve their NEW vector exactly (residual membership)
+    res = idx.search_batch(emb2[:20], "u1", k=3)
+    for want, (got, _) in zip(churn[:20], res):
+        assert got and got[0] == want
+        # (c) dedup: a row can never appear twice in one result list
+        assert len(got) == len(set(got))
+
+    # repeated churn of the SAME post-build rows must not grow the fresh
+    # residual with duplicates (delete drops them from the fresh tuple, the
+    # re-add appends exactly once)
+    for _ in range(3):
+        idx.delete(churn[:50])
+        idx.add(churn[:50], emb2[:50], [0.5] * 50, [0.0] * 50,
+                ["semantic"] * 50, ["default"] * 50, "u1")
+    fresh = idx._ivf_fresh
+    assert len(fresh) == len(set(fresh))
+
+    # (a) the invalidated member slots trip the rebuild threshold
+    assert idx._ivf_stale > built.built_rows // 4
+    assert idx.ivf_maintenance()
+    assert idx._ivf is not built          # genuinely rebuilt
+    assert idx._ivf_stale == 0

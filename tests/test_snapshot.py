@@ -240,3 +240,31 @@ def test_snapshot_pair_mismatch_warns(tmp_path):
     msg = ms2.load_snapshot(snap)
     assert "loaded" in msg and "different snapshot ids" in msg
     ms2.close()
+
+
+def test_restore_preserves_ivf_serving_config(tmp_path):
+    """config.ivf_serving must survive load_snapshot the way int8_serving
+    does — a restored system silently serving exact forever (and never
+    running the worker's ivf_maintenance hook) was advisor r4's medium
+    finding."""
+    from lazzaro_tpu.config import MemoryConfig
+
+    ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False,
+                      config=MemoryConfig(journal=False, int8_serving=True,
+                                          ivf_serving=6))
+    ms.start_conversation()
+    ms.chat("I work as a data engineer on a big ETL project.")
+    ms.end_conversation()
+    snap = str(tmp_path / "snap")
+    ms.save_snapshot(snap)
+    ms.close()
+
+    ms2 = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db2"),
+                       verbose=False, load_from_disk=False,
+                       config=MemoryConfig(journal=False, int8_serving=True,
+                                           ivf_serving=6))
+    assert "loaded" in ms2.load_snapshot(snap)
+    assert ms2.index.ivf_nprobe == 6
+    assert ms2.index.int8_serving
+    ms2.close()
