@@ -641,21 +641,22 @@ class MemoryIndex:
         """Several shard-mode link scans in ONE host round trip.
 
         The consolidation pipeline needs both the same-shard (mode 1) and
-        the any-shard (mode 0) candidate sets per conversation; dispatches
-        are async, so issuing both kernels and fetching all four output
-        arrays in one packed readback saves a full ~70 ms tunnel RTT per
-        conversation vs. two sequential ``link_candidates`` calls."""
+        the any-shard (mode 0) candidate sets per conversation. Both modes
+        are masks over the SAME query×arena score matrix, so ONE fused
+        kernel streams the arena from HBM once and re-masks per mode
+        (``arena_link_candidates_multi``) — at 1M rows the matmul is the
+        whole cost, so two modes for the price of one — and all four
+        output arrays come back in one packed readback: one ~70 ms tunnel
+        RTT per conversation total."""
         rows = [self.id_to_row[i] for i in new_ids if i in self.id_to_row]
         tid = self._tenants.get(tenant)
         if not rows or tid is None:
             return {sm: {} for sm in shard_modes}
         all_rows = np.asarray(rows, np.int32)
         rows_dev = jnp.asarray(S.pad_rows(all_rows, self.state.capacity))
-        outs = [S.arena_link_candidates(self.state, rows_dev, rows_dev,
-                                        jnp.int32(tid),
-                                        min(k, self.state.capacity), sm)
-                for sm in shard_modes]
-        flat = fetch_packed(*[a for pair in outs for a in pair])
+        flat = fetch_packed(*S.arena_link_candidates_multi(
+            self.state, rows_dev, rows_dev, jnp.int32(tid),
+            min(k, self.state.capacity), tuple(shard_modes)))
         result: Dict[int, Dict[str, List[Tuple[str, float]]]] = {}
         for i, sm in enumerate(shard_modes):
             scores, cand = flat[2 * i], flat[2 * i + 1]

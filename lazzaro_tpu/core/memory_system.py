@@ -1172,17 +1172,28 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 results.append(f"✓ Merged {merged} similar nodes")
 
         components = self.buffer.get_connected_components()
+        # ONE pass over all edges, bucketing intra-component weights by
+        # component id — the per-component edge scan was O(components ×
+        # edges), which at 1M nodes with a few hundred thousand live edges
+        # is billions of host operations inside the measured deep-
+        # consolidation path.
+        comp_of: Dict[str, int] = {}
+        for ci, component in enumerate(components):
+            for nid in component:
+                comp_of[nid] = ci
+        w_sum = [0.0] * len(components)
+        w_cnt = [0] * len(components)
+        for s in self.shards.values():
+            for (src, tgt), e in s.edges.items():
+                ci = comp_of.get(src)
+                if ci is not None and comp_of.get(tgt) == ci:
+                    w_sum[ci] += e.weight
+                    w_cnt[ci] += 1
         profile_updates = 0
-        for component in components:
-            if len(component) < self.config.component_min_size:
+        for ci, component in enumerate(components):
+            if len(component) < self.config.component_min_size or not w_cnt[ci]:
                 continue
-            component_edges = [e for s in self.shards.values()
-                               for (src, tgt), e in s.edges.items()
-                               if src in component and tgt in component]
-            if not component_edges:
-                continue
-            avg_weight = sum(e.weight for e in component_edges) / len(component_edges)
-            if avg_weight > self.config.component_min_avg_weight:
+            if w_sum[ci] / w_cnt[ci] > self.config.component_min_avg_weight:
                 update = self._extract_profile_from_component(component)
                 if "Updated" in update:
                     profile_updates += 1

@@ -366,24 +366,30 @@ def arena_search(
     return top_scores, top_rows
 
 
-@functools.partial(jax.jit, static_argnames=("k", "shard_mode"))
-def arena_link_candidates(
+@functools.partial(jax.jit, static_argnames=("k", "shard_modes"))
+def arena_link_candidates_multi(
     state: ArenaState,
     new_rows: jax.Array,   # [B] i32 rows to find candidates FOR (whole batch)
     excl_rows: jax.Array,  # [E] i32 rows excluded as candidates (ALL new rows)
     tenant: jax.Array,
     k: int,
-    shard_mode: int = 0,   # 0: any shard, 1: same shard only, -1: other shards only
-) -> Tuple[jax.Array, jax.Array]:
-    """For each new node, top-k most similar existing nodes (excluding self and
-    other new rows). One batched matmul replaces reference hot loops #2/#3
-    (``memory_system.py:797-836`` within-shard, ``:838-891`` cross-shard).
+    shard_modes: Tuple[int, ...] = (1, 0),
+    # 0: any shard, 1: same shard only, -1: other shards only
+) -> Tuple[jax.Array, ...]:
+    """For each new node, top-k most similar existing nodes (excluding self
+    and other new rows), for SEVERAL shard modes in one pass. One batched
+    matmul replaces reference hot loops #2/#3 (``memory_system.py:797-836``
+    within-shard, ``:838-891`` cross-shard) — and because every mode is just
+    a different mask over the SAME score matrix, the arena is streamed from
+    HBM once and the [C, cap+1] scores are re-masked per mode: two modes
+    cost one matmul, not two (the matmul dominates the top-k).
 
     Batches past QUERY_CHUNK stream through ``lax.map`` in [512, cap+1] f32
     tiles INSIDE this one dispatch — the tile bounds HBM at 1M rows, and a
     whole-conversation link batch costs ONE host round trip (the tunneled
     backend charges ~70 ms per readback, r4 measurement; the old host-side
-    chunk loop paid it per 512 rows)."""
+    chunk loop paid it per 512 rows). Returns ``(scores, rows)`` pairs
+    flattened in ``shard_modes`` order."""
     mask = state.alive & (state.tenant_id == tenant) & ~state.is_super
     # exclude the new rows themselves from candidates
     excl = jnp.zeros((state.emb.shape[0],), bool).at[excl_rows].set(True)
@@ -393,13 +399,33 @@ def arena_link_candidates(
         q = state.emb[rows_c]                     # [C, d]
         scores = jnp.dot(q, state.emb.T,
                          preferred_element_type=jnp.float32)  # [C, cap+1]
-        full_mask = mask[None, :]
-        if shard_mode != 0:
-            same = state.shard_id[rows_c][:, None] == state.shard_id[None, :]
-            full_mask = full_mask & (same if shard_mode == 1 else ~same)
-        return jax.lax.top_k(jnp.where(full_mask, scores, NEG_INF), k)
+        same = None
+        outs = []
+        for sm in shard_modes:
+            full_mask = mask[None, :]
+            if sm != 0:
+                if same is None:
+                    same = (state.shard_id[rows_c][:, None]
+                            == state.shard_id[None, :])
+                full_mask = full_mask & (same if sm == 1 else ~same)
+            outs.extend(jax.lax.top_k(jnp.where(full_mask, scores, NEG_INF), k))
+        return tuple(outs)
 
     return chunked_map(chunk, new_rows)
+
+
+def arena_link_candidates(
+    state: ArenaState,
+    new_rows: jax.Array,
+    excl_rows: jax.Array,
+    tenant: jax.Array,
+    k: int,
+    shard_mode: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Single-mode view of ``arena_link_candidates_multi``."""
+    s, r = arena_link_candidates_multi(state, new_rows, excl_rows, tenant, k,
+                                       (shard_mode,))
+    return s, r
 
 
 @jax.jit
