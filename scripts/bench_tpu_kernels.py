@@ -1,0 +1,188 @@
+"""Standalone TPU kernel + IVF capture at 1M rows — no graph needed.
+
+The r4 post-mortem: a wedged tunnel at bench start voided the whole
+round's TPU evidence. This script is the smallest unit of capture — a
+synthetic (clustered, bench-geometry) 1M-row arena and the raw serving
+kernels over it:
+
+  exact XLA / exact Pallas / int8 single-query p50, batch-64 amortized,
+  scatter throughput      (bench.bench_kernels — shared code path)
+  IVF build time + p50 + recall@5 vs the exact oracle at several nprobe
+  settings                (ops/ivf.py — the claims in its docstring)
+
+It needs only ~2-5 min of healthy tunnel, so the watcher runs it FIRST
+whenever the backend comes back. Prints ONE JSON line (same contract as
+bench.py). Timed regions end in a forced device->host readback; the
+roofline self-check flags physically impossible numbers.
+
+Env: BENCH_N / BENCH_DIM as bench.py; KERNELS_SKIP_IVF=1 for speed.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+os.environ.setdefault("BENCH_N", "1000000")
+import bench  # noqa: E402  (runs the subprocess backend-health gate)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from lazzaro_tpu.core import state as S  # noqa: E402
+
+
+def clustered_arena(n_rows: int, dim: int, group: int = 4,
+                    n_topics: int = 12, seed: int = 0) -> jax.Array:
+    """Vectorized bench-geometry corpus (0.5 topic + 0.794 group + 0.346
+    noise, unit rows) — same cluster statistics as the graph bench's
+    ``_fact_vec``, generated in bulk. Built on host in chunks, shipped to
+    the device as ONE bf16 matrix."""
+    rng = np.random.default_rng(seed)
+    n_groups = max(1, n_rows // group)
+    topics = rng.standard_normal((n_topics, dim)).astype(np.float32)
+    topics /= np.linalg.norm(topics, axis=1, keepdims=True)
+    out = np.empty((n_rows, dim), np.float32)
+    chunk = 131072
+    for lo in range(0, n_rows, chunk):
+        hi = min(n_rows, lo + chunk)
+        idx = np.arange(lo, hi)
+        g = idx % n_groups
+        g_rng = np.random.default_rng(seed + 2 + lo)   # fresh noise per chunk
+        # group dirs must be reproducible per group id without holding a
+        # [n_groups, dim] matrix: derive each chunk's group dirs from a
+        # per-group Philox stream
+        gd = np.empty((hi - lo, dim), np.float32)
+        uniq, inv = np.unique(g, return_inverse=True)
+        dirs = np.empty((len(uniq), dim), np.float32)
+        for j, gid in enumerate(uniq.tolist()):
+            r = np.random.default_rng(1_000_000_000 + gid)
+            v = r.standard_normal(dim).astype(np.float32)
+            dirs[j] = v / np.linalg.norm(v)
+        gd[:] = dirs[inv]
+        noise = g_rng.standard_normal((hi - lo, dim)).astype(np.float32)
+        noise /= np.linalg.norm(noise, axis=1, keepdims=True)
+        v = (bench.TOPIC_W * topics[g % n_topics]
+             + bench.GROUP_W * gd + bench.NOISE_W * noise)
+        out[lo:hi] = v / np.linalg.norm(v, axis=1, keepdims=True)
+    return jnp.asarray(out, jnp.bfloat16)
+
+
+def main():
+    t_start = time.perf_counter()
+    dev = jax.devices()[0]
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    n = bench.N
+    dim = bench.DIM
+
+    t0 = time.perf_counter()
+    p50s, batch64_ms, int8_batch64_ms, kernel_rows, scatter = \
+        bench.bench_kernels(on_tpu)
+    t_kernels = time.perf_counter() - t0
+
+    ivf = None
+    if os.environ.get("KERNELS_SKIP_IVF") != "1":
+        from lazzaro_tpu.ops.ivf import build_ivf, ivf_search
+
+        t0 = time.perf_counter()
+        emb = clustered_arena(n, dim)
+        mask = np.ones((n,), bool)
+        t_corpus = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        index = build_ivf(emb, mask)
+        jax.block_until_ready(index.centroids)
+        np.asarray(index.centroids[:1])          # forced readback
+        build_s = time.perf_counter() - t0
+
+        # exact oracle top-5 for 64 held-out-style queries (existing rows —
+        # self-hit excluded by looking at ranks 1..5 is unnecessary: IVF
+        # must reproduce the oracle INCLUDING the self hit)
+        rng = np.random.default_rng(7)
+        qrows = rng.integers(0, n, size=64)
+        queries = np.asarray(emb[qrows].astype(jnp.float32))
+        mask_dev = jnp.asarray(mask)
+
+        def exact_topk(q, k=5):
+            scores = jnp.dot(emb.astype(jnp.float32), jnp.asarray(q).T,
+                             preferred_element_type=jnp.float32)  # [n, Q]
+            _, rows = jax.lax.top_k(scores.T, k)
+            return np.asarray(rows)
+
+        oracle = exact_topk(queries)
+        ivf = {"build_s": round(build_s, 2),
+               "corpus_gen_s": round(t_corpus, 1),
+               "n_clusters": int(index.n_clusters),
+               "by_nprobe": {}}
+        for nprobe in (4, 8, 16):
+            sc, rows = ivf_search(index.centroids, index.members,
+                                  index.residual, emb, mask_dev,
+                                  jnp.asarray(queries), 5, nprobe=nprobe)
+            got = np.asarray(rows)
+            recall = float(np.mean([
+                len(set(got[i]) & set(oracle[i])) / 5.0
+                for i in range(len(qrows))]))
+            # p50 latency: single-query dispatches, forced readback
+            lat = []
+            for i in range(12):
+                t0 = time.perf_counter()
+                _, r = ivf_search(index.centroids, index.members,
+                                  index.residual, emb, mask_dev,
+                                  jnp.asarray(queries[i:i + 1]), 5,
+                                  nprobe=nprobe)
+                np.asarray(r)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            ivf["by_nprobe"][str(nprobe)] = {
+                "recall_at_5": round(recall, 4),
+                "p50_ms": round(float(np.percentile(lat[2:], 50)), 3)}
+
+    rl = {
+        "exact_xla": bench._roofline(kernel_rows, dim, 2, p50s["xla"], 1, on_tpu),
+        "int8": bench._roofline(kernel_rows, dim, 1, p50s["int8"], 1, on_tpu),
+        "batch64": bench._roofline(kernel_rows, dim, 2, batch64_ms, 64, on_tpu),
+    }
+    if "pallas" in p50s:
+        rl["pallas"] = bench._roofline(kernel_rows, dim, 2, p50s["pallas"], 1,
+                                       on_tpu)
+    out = {
+        "metric": f"arena_kernels_{n // 1000}k_rows",
+        "value": round(p50s["xla"], 4),
+        "unit": "ms",
+        "vs_baseline": round(100.0 / p50s["xla"], 2),
+        "roofline_suspect": any(v.get("suspect") for v in rl.values()),
+        "extra": {
+            "arena_search_xla_p50_ms": round(p50s["xla"], 4),
+            "arena_search_pallas_p50_ms": (round(p50s["pallas"], 4)
+                                           if "pallas" in p50s else None),
+            "arena_search_int8_p50_ms": round(p50s["int8"], 4),
+            "arena_search_batch64_ms": round(batch64_ms, 4),
+            "arena_search_int8_batch64_ms": round(int8_batch64_ms, 4),
+            "arena_scatter_rows_per_sec": round(scatter, 1),
+            "ivf": ivf,
+            "roofline": rl,
+            "kernel_rows": kernel_rows,
+            "dim": dim,
+            "phase_s": {"kernels": round(t_kernels, 1),
+                        "total_wall": round(time.perf_counter() - t_start, 1)},
+            "device": str(dev),
+        },
+    }
+    if bench._degraded_error:
+        out["error"] = bench._degraded_error
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"metric": "arena_kernels", "value": None,
+                          "unit": "ms",
+                          "error": f"{type(e).__name__}: {e}"[:500]}))
+        sys.exit(0)

@@ -1,0 +1,192 @@
+"""TPU-opportunistic capture loop (r4 verdict, next-round item #1).
+
+The tunnel to the TPU backend flaps on a multi-hour scale (r1 down,
+r2 up, r3 down, r4 up for the first ~25 min then wedged). bench.py
+converts availability into evidence exactly once, at process start —
+this watcher converts ANY window of availability, whenever it occurs:
+
+  every PROBE_EVERY seconds, probe the backend in a subprocess with a
+  hard timeout; on the first healthy probe run the capture ladder,
+  cheapest rung first, writing each result to bench_artifacts/
+  IMMEDIATELY (a later wedge cannot eat a captured artifact):
+
+    1. kernels_1m  — synthetic-arena kernel + IVF capture (~5 min of
+                     tunnel time; scripts/bench_tpu_kernels.py)
+    2. graph_full  — the full 1M-graph bench.py against the prebuilt
+                     BENCH_WORKDIR (reload + search + serving modes +
+                     consolidation + LLM loop). Only when the prebuild
+                     marker says the ingest is COMPLETE and no other
+                     bench.py is running (two processes would race on
+                     the store's delta segments).
+
+Each rung runs at most CAPTURE_ATTEMPTS times (a rung that died on a
+mid-run wedge is retried on the next healthy probe). State lives in
+bench_artifacts/r5_watch_state.json; the log is append-only.
+
+Run:  nohup python scripts/tpu_watch.py >> bench_artifacts/tpu_watch.log 2>&1 &
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "bench_artifacts")
+STATE_PATH = os.path.join(ART, "r5_watch_state.json")
+WORKDIR = os.path.join(REPO, "bench_workdir")
+PROBE_EVERY = float(os.environ.get("WATCH_PROBE_EVERY", 420))
+PROBE_TIMEOUT = float(os.environ.get("WATCH_PROBE_TIMEOUT", 90))
+CAPTURE_ATTEMPTS = 3
+
+_PROBE_SNIPPET = r"""
+import json, sys
+from lazzaro_tpu.utils import backend_probe
+h = backend_probe.ensure_healthy_or_cpu(timeout={t}, retries=0)
+print(json.dumps(h))
+sys.exit(0 if h.get("ok") else 1)
+"""
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_state(st: dict) -> None:
+    tmp = STATE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(st, f, indent=1)
+    os.replace(tmp, STATE_PATH)
+
+
+def probe_healthy() -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET.format(t=PROBE_TIMEOUT)],
+            cwd=REPO, capture_output=True, text=True,
+            timeout=PROBE_TIMEOUT + 60)
+        out = (r.stdout or "").strip().splitlines()
+        log(f"probe rc={r.returncode} {out[-1][:160] if out else ''}")
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        log("probe: hard timeout (tunnel wedged)")
+        return False
+
+
+def ingest_complete() -> bool:
+    marker = os.path.join(WORKDIR, "INGESTED_1000000_768_g2")
+    try:
+        with open(marker) as f:
+            saved = json.load(f)
+        return int(saved.get("convs_done", 0)) >= 200
+    except (OSError, ValueError):
+        return False
+
+
+def other_bench_running() -> bool:
+    r = subprocess.run(["pgrep", "-f", "python bench.py"],
+                       capture_output=True, text=True)
+    return bool(r.stdout.strip())
+
+
+def run_capture(name: str, cmd, env_extra: dict, timeout_s: float) -> bool:
+    """Run one rung; write the artifact + timestamped copy on success.
+    Success = rc 0 AND a parseable JSON tail with a non-null value AND no
+    tpu_unreachable error (a CPU-fallback run is NOT a TPU capture)."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    log(f"capture {name}: starting (timeout {timeout_s:.0f}s)")
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                           text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f"capture {name}: TIMED OUT after {time.time() - t0:.0f}s")
+        return False
+    tail = (r.stdout or "").strip().splitlines()
+    stamp = time.strftime("%m%d_%H%M%S")
+    err_path = os.path.join(ART, f"r5_{name}_{stamp}.stderr.txt")
+    with open(err_path, "w") as f:
+        f.write((r.stderr or "")[-20000:])
+    if not tail:
+        log(f"capture {name}: rc={r.returncode}, no stdout")
+        return False
+    try:
+        doc = json.loads(tail[-1])
+    except ValueError:
+        log(f"capture {name}: unparseable tail: {tail[-1][:200]}")
+        return False
+    path = os.path.join(ART, f"r5_{name}_{stamp}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    ok = (r.returncode == 0 and doc.get("value") is not None
+          and "tpu_unreachable" not in str(doc.get("error", "")))
+    dev = str(doc.get("extra", {}).get("device", ""))
+    log(f"capture {name}: rc={r.returncode} ok={ok} device={dev!r} -> {path}")
+    if ok and "TPU" not in dev and "tpu" not in dev:
+        log(f"capture {name}: device is not TPU — counting as failed")
+        return False
+    return ok
+
+
+RUNGS = [
+    ("kernels_1m",
+     [sys.executable, "scripts/bench_tpu_kernels.py"],
+     {"BENCH_N": "1000000", "BENCH_DIM": "768"},
+     45 * 60,
+     lambda: True),
+    ("graph_full",
+     [sys.executable, "bench.py"],
+     {"BENCH_WORKDIR": WORKDIR, "BENCH_INGEST_BUDGET_S": "4000",
+      "BENCH_LLM_LOOP": "1", "BENCH_CONSOLIDATE": "1",
+      "BENCH_REFDEFAULT": "1"},
+     150 * 60,
+     lambda: ingest_complete() and not other_bench_running()),
+]
+
+
+def main() -> None:
+    os.makedirs(ART, exist_ok=True)
+    st = load_state()
+    log(f"watcher up: probe every {PROBE_EVERY:.0f}s, state={st}")
+    while True:
+        todo = [(n, c, e, t) for n, c, e, t, gate in RUNGS
+                if not st.get(n, {}).get("done")
+                and st.get(n, {}).get("attempts", 0) < CAPTURE_ATTEMPTS
+                and gate()]
+        if not todo:
+            blocked = [n for n, *_rest, gate in RUNGS
+                       if not st.get(n, {}).get("done") and not gate()]
+            if not blocked and all(st.get(n, {}).get("done")
+                                   or st.get(n, {}).get("attempts", 0)
+                                   >= CAPTURE_ATTEMPTS
+                                   for n, *_ in RUNGS):
+                log("all rungs done or exhausted — watcher exiting")
+                return
+            time.sleep(PROBE_EVERY)
+            continue
+        if probe_healthy():
+            for name, cmd, env_extra, timeout_s in todo:
+                rung_state = st.setdefault(name, {})
+                rung_state["attempts"] = rung_state.get("attempts", 0) + 1
+                save_state(st)
+                if run_capture(name, cmd, env_extra, timeout_s):
+                    rung_state["done"] = True
+                    rung_state["ts"] = time.strftime("%Y-%m-%d %H:%M:%S")
+                    save_state(st)
+                else:
+                    break   # tunnel likely wedged mid-run; re-probe first
+        time.sleep(PROBE_EVERY)
+
+
+if __name__ == "__main__":
+    main()
