@@ -1,6 +1,11 @@
 """BertEncoder numerics vs a real ``transformers`` BertModel (random-init,
 built locally — zero egress) and the ``from_hf`` weight mapping."""
 
+# Compile-heavy (multi-second XLA compiles / 100k-row arenas): the
+# default lane must stay inside a driver window; run the full lane
+# with no -m filter for round gates.
+pytestmark = __import__("pytest").mark.slow
+
 import numpy as np
 import pytest
 

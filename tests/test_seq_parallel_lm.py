@@ -8,6 +8,11 @@ the ordinary single-device train step — same params, same batch, same
 loss and updated params to float tolerance.
 """
 
+# Compile-heavy (multi-second XLA compiles / 100k-row arenas): the
+# default lane must stay inside a driver window; run the full lane
+# with no -m filter for round gates.
+pytestmark = __import__("pytest").mark.slow
+
 import dataclasses
 
 import numpy as np
