@@ -49,14 +49,11 @@ class MemoryIndex:
         # (add / grow) — metadata sweeps (decay, boost, access counts,
         # delete's alive flip) leave the vectors untouched, and the alive/
         # tenant mask is taken fresh from the master at every search, so
-        # they must not trigger a ~3 GB full-arena requant.
-        self.int8_serving = bool(int8_serving) and mesh is None
-        if int8_serving and mesh is not None:
-            import warnings
-            warnings.warn(
-                "int8_serving is single-chip only (the mesh path searches "
-                "through shard_map over the exact arena); the flag is "
-                "ignored under a mesh", stacklevel=3)
+        # they must not trigger a ~3 GB full-arena requant. Composes with
+        # the mesh: the per-row shadow shards exactly like the master, so
+        # each chip scans its local int8 rows and only the k-candidate
+        # combine crosses ICI (ops/topk.py make_sharded_int8_topk).
+        self.int8_serving = bool(int8_serving)
         self._int8_shadow = None           # (q [N,d] i8, scale [N] f32)
         self._int8_dirty = True
         # IVF coarse stage (ops/ivf.py): nprobe > 0 routes serving searches
@@ -406,18 +403,7 @@ class MemoryIndex:
             # arena pytree is immutable, so everything derived from ``st``
             # is self-consistent (advisor r4, medium).
             st = self.state
-            shadow = self._int8_shadow
-            if (self._int8_dirty or shadow is None
-                    or shadow[0].shape[0] != st.emb.shape[0]):
-                from lazzaro_tpu.ops.quant import quantize_rows
-                shadow = quantize_rows(st.emb)
-                self._int8_shadow = shadow
-                if self.state is st:
-                    # only clear the flag if no writer raced past ``st`` —
-                    # otherwise rows added mid-quantize would stay invisible
-                    # to int8 serving until the NEXT mutation
-                    self._int8_dirty = False
-            q8, qscale = shadow
+            q8, qscale = self._int8_shadow_for(st)
             mask = S.arena_mask(st, jnp.int32(tid), super_filter)
             scores, rows = quantized_topk(q8, qscale, mask,
                                           S.normalize(q_pad), k_eff)
@@ -430,10 +416,17 @@ class MemoryIndex:
             # under shard_map each device sees its local rows as a plain
             # array, so the per-shard scorer (pallas on big TPU shards, XLA
             # otherwise) composes with the mesh; only the k-candidate
-            # combine crosses ICI (VERDICT r3 weak #7).
-            mask = S.arena_mask(self.state, jnp.int32(tid), super_filter)
-            scores, rows = self._mesh_searcher(k_eff)(
-                self.state.emb, mask, S.normalize(q_pad))
+            # combine crosses ICI (VERDICT r3 weak #7). The int8 shadow
+            # composes the same way — row-local state, per-shard scan.
+            st = self.state
+            mask = S.arena_mask(st, jnp.int32(tid), super_filter)
+            if self.int8_serving and not exact:
+                q8, qscale = self._int8_shadow_for(st)
+                scores, rows = self._mesh_searcher(k_eff, int8=True)(
+                    q8, qscale, mask, S.normalize(q_pad))
+            else:
+                scores, rows = self._mesh_searcher(k_eff)(
+                    st.emb, mask, S.normalize(q_pad))
         h_scores, h_rows = fetch_packed(scores, rows)
         return decode_topk(h_scores[:nq], h_rows[:nq],
                            self.row_to_id, S.NEG_INF)
@@ -531,13 +524,39 @@ class MemoryIndex:
         self._ivf_res_cache = (ivf, len(fresh), dev)
         return dev
 
-    def _mesh_searcher(self, k: int):
-        """Cached shard_map distributed top-k (ops/topk.py) per k bucket."""
-        if k not in self._mesh_topk_cache:
-            from lazzaro_tpu.ops.topk import make_sharded_topk
-            self._mesh_topk_cache[k] = make_sharded_topk(
-                self.mesh, self.shard_axis, k=k, impl="auto")
-        return self._mesh_topk_cache[k]
+    def _int8_shadow_for(self, st: S.ArenaState):
+        """(Re)build the int8 shadow from ONE arena snapshot; under a mesh
+        the shadow is constrained to the master's row sharding so the
+        per-shard scan never gathers. Clears the dirty flag only when no
+        writer raced past ``st`` (advisor r4)."""
+        shadow = self._int8_shadow
+        if (self._int8_dirty or shadow is None
+                or shadow[0].shape[0] != st.emb.shape[0]):
+            from lazzaro_tpu.ops.quant import quantize_rows
+            shadow = quantize_rows(st.emb)
+            if self.mesh is not None:
+                shadow = (jax.device_put(shadow[0], self._mat_sharding),
+                          jax.device_put(shadow[1], self._row_sharding))
+            self._int8_shadow = shadow
+            if self.state is st:
+                # only clear the flag if no writer raced past ``st`` —
+                # otherwise rows added mid-quantize would stay invisible
+                # to int8 serving until the NEXT mutation
+                self._int8_dirty = False
+        return shadow
+
+    def _mesh_searcher(self, k: int, int8: bool = False):
+        """Cached shard_map distributed top-k (ops/topk.py) per (k, mode)
+        bucket."""
+        key = ("int8", k) if int8 else k
+        if key not in self._mesh_topk_cache:
+            from lazzaro_tpu.ops.topk import (make_sharded_int8_topk,
+                                              make_sharded_topk)
+            self._mesh_topk_cache[key] = (
+                make_sharded_int8_topk(self.mesh, self.shard_axis, k=k)
+                if int8 else
+                make_sharded_topk(self.mesh, self.shard_axis, k=k, impl="auto"))
+        return self._mesh_topk_cache[key]
 
     # ------------------------------------------------------- numeric sweeps
     def update_access(self, ids: Sequence[str], boost: float = 0.05,

@@ -109,6 +109,54 @@ def make_sharded_topk(mesh: Mesh, axis: str = "data", k: int = 10,
     return search
 
 
+def make_sharded_int8_topk(mesh: Mesh, axis: str = "data", k: int = 10):
+    """Int8 serving composed with the mesh (VERDICT r4 next #7): the
+    per-row quantized shadow is row-LOCAL state, so it shards exactly like
+    the master arena. Each chip scans its own int8 rows — half the HBM
+    bytes of the bf16 scan, int8×int8→int32 on the MXU (ops/quant.py) —
+    takes a local top-k, and the k-candidate combine rides the same ICI
+    ``all_gather`` as the exact sharded path above.
+
+    Returns ``search(q8, scale, mask, query) -> (scores, global_rows)``
+    with ``q8 [N, d] i8``, ``scale [N] f32``, ``mask [N]`` sharded along
+    ``axis`` and the query replicated."""
+    from lazzaro_tpu.ops.quant import quantize_rows
+
+    def local_search(q8_l, scale_l, mask_l, query):
+        shard_idx = jax.lax.axis_index(axis)
+        local_n = q8_l.shape[0]
+        k_eff = min(k, local_n)
+        qq, qscale = quantize_rows(query)
+        dots = jax.lax.dot_general(qq, q8_l, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+        scores = (dots.astype(jnp.float32)
+                  * qscale[:, None] * scale_l[None, :])
+        scores = jnp.where(mask_l[None, :], scores, NEG_INF)
+        top_s, top_i = jax.lax.top_k(scores, k_eff)
+        top_i = top_i + shard_idx * local_n                 # globalize rows
+        all_s = jax.lax.all_gather(top_s, axis)
+        all_i = jax.lax.all_gather(top_i, axis)
+        all_s = jnp.moveaxis(all_s, 0, 1).reshape(top_s.shape[0], -1)
+        all_i = jnp.moveaxis(all_i, 0, 1).reshape(top_s.shape[0], -1)
+        fin_s, fin_pos = jax.lax.top_k(all_s, k)
+        fin_i = jnp.take_along_axis(all_i, fin_pos, axis=1)
+        return fin_s, fin_i
+
+    mapped = shard_map(
+        local_search,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(axis), P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def search(q8, scale, mask, query):
+        return mapped(q8, scale, mask, jnp.atleast_2d(query))
+
+    return search
+
+
 def shard_rows(mesh: Mesh, axis: str = "data") -> NamedSharding:
     """Row-sharding spec for [N, ...] index arrays."""
     return NamedSharding(mesh, P(axis))
