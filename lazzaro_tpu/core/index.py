@@ -739,6 +739,11 @@ class MemoryIndex:
         return np.asarray(S.arena_mean_embedding(self.state, jnp.asarray(padded)))
 
     def get_embedding(self, node_id: str) -> Optional[np.ndarray]:
+        """Single-row fetch — COLD-PATH utility (CLI inspection, tests).
+        One device→host RTT per call (~70 ms on the tunneled backend);
+        every per-conversation path uses the bulk transfers instead
+        (``_bulk_fill_embeddings``, ``pull_numeric_rows``,
+        ``mean_embedding``)."""
         r = self.id_to_row.get(node_id)
         if r is None:
             return None

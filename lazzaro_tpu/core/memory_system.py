@@ -1403,16 +1403,6 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         return [n for n in (self.buffer.get_node(c) for c in connected) if n]
 
     # ------------------------------------------------------------ persistence
-    def _node_embedding(self, node: Node) -> Optional[List[float]]:
-        """Host embedding, or the authoritative arena row when the host copy
-        was never materialized (snapshot-loaded graphs). Arena rows are
-        L2-normalized; all downstream similarity is cosine, so this is
-        semantics-preserving."""
-        if node.embedding is not None:
-            return [float(x) for x in node.embedding]
-        emb = self.index.get_embedding(self._q(node.id))
-        return [float(x) for x in emb] if emb is not None else None
-
     def _bulk_fill_embeddings(self, dicts: List[Dict[str, Any]],
                               node_ids: List[str]) -> None:
         """Fill missing/empty 'embedding' entries from the arena in ONE
@@ -1861,7 +1851,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
     def load_snapshot(self, snapshot_dir: str) -> str:
         """Restore from ``save_snapshot`` output. Host nodes come back with
         ``embedding=None`` — the arena owns the vectors; persistence and
-        merge paths fetch them on demand (``_node_embedding``). Any
+        merge paths fetch them on demand (``_bulk_fill_embeddings``). Any
         in-flight conversation is discarded (the snapshot is the new truth)
         and the per-user WAL is reopened for the snapshot's user."""
         from lazzaro_tpu.core import checkpoint as ckpt

@@ -332,30 +332,56 @@ class OnDeviceLLM:
 
 
 # ---------------------------------------------------------------------------
-# Optional remote shims (protocol parity; require network + API keys)
+# Optional remote shims (protocol parity; require network + API keys).
+#
+# Table-driven on purpose: OpenAI and Together expose the same
+# chat.completions / embeddings calling convention, so each provider is a
+# two-line subclass binding an SDK client to the shared adapters below.
+# The CONTRACT is the part that matters and it is kept provider-uniform:
+# any SDK failure swallows to "" / zero vectors — these shims are the
+# lowest layer, and real failure handling (retry, circuit breaker, offline
+# fallback, health counters) lives in core/resilience.py; wrap a shim in
+# ResilientLLM / ResilientEmbedder to get it.
 # ---------------------------------------------------------------------------
 
+_REMOTE_TEMPERATURE = 0.7          # parity with the reference's remote calls
 
-class OpenAILLM:
-    def __init__(self, api_key: str, model: str = "gpt-4o-mini"):
-        import openai  # optional dep
-        self.client = openai.OpenAI(api_key=api_key)
+
+def _swallow(call, fallback):
+    """Run ``call``; any SDK exception (or a None payload) becomes
+    ``fallback``. The uniform lowest-layer failure contract."""
+    try:
+        out = call()
+        return fallback if out is None else out
+    except Exception:
+        return fallback
+
+
+class _ChatCompletionsLLM:
+    """Adapter for any OpenAI-compatible ``chat.completions`` SDK."""
+
+    def __init__(self, client, model: str):
+        self.client = client
         self.model = model
 
-    def completion(self, messages, response_format=None):
-        try:
-            kwargs = {"model": self.model, "messages": messages, "temperature": 0.7}
-            if response_format:
-                kwargs["response_format"] = response_format
-            resp = self.client.chat.completions.create(**kwargs)
-            return resp.choices[0].message.content or ""
-        except Exception:
-            return ""
+    def _create(self, messages, response_format=None, stream: bool = False):
+        kwargs = dict(model=self.model, messages=messages,
+                      temperature=_REMOTE_TEMPERATURE)
+        if response_format:
+            kwargs["response_format"] = response_format
+        if stream:
+            kwargs["stream"] = True
+        return self.client.chat.completions.create(**kwargs)
 
-    def completion_stream(self, messages, response_format=None):
+    def completion(self, messages, response_format=None) -> str:
+        return _swallow(
+            lambda: self._create(messages, response_format)
+            .choices[0].message.content, "")
+
+    def completion_stream(self, messages,
+                          response_format=None) -> Iterator[str]:
         try:
-            stream = self.client.chat.completions.create(
-                model=self.model, messages=messages, temperature=0.7, stream=True)
+            stream = self._create(messages, stream=True)
             for chunk in stream:
                 delta = chunk.choices[0].delta.content
                 if delta:
@@ -364,32 +390,54 @@ class OpenAILLM:
             return
 
 
-class OpenAIEmbedder:
-    dim = 1536
+class _EmbeddingsEndpoint:
+    """Adapter for any OpenAI-compatible ``embeddings`` SDK."""
 
-    def __init__(self, api_key: str, model: str = "text-embedding-3-small"):
-        import openai
-        self.client = openai.OpenAI(api_key=api_key)
+    def __init__(self, client, model: str, dim: int):
+        self.client = client
         self.model = model
-
-    def embed(self, text: str) -> List[float]:
-        try:
-            resp = self.client.embeddings.create(model=self.model, input=[text])
-            return resp.data[0].embedding
-        except Exception:
-            return [0.0] * self.dim
+        self.dim = dim
 
     def batch_embed(self, texts: List[str]) -> List[List[float]]:
-        try:
-            resp = self.client.embeddings.create(model=self.model, input=texts)
-            return [d.embedding for d in resp.data]
-        except Exception:
-            return [[0.0] * self.dim for _ in texts]
+        return _swallow(
+            lambda: [d.embedding for d in self.client.embeddings.create(
+                model=self.model, input=texts).data],
+            [[0.0] * self.dim for _ in texts])
+
+    def embed(self, text: str) -> List[float]:
+        return self.batch_embed([text])[0]
+
+
+class OpenAILLM(_ChatCompletionsLLM):
+    def __init__(self, api_key: str, model: str = "gpt-4o-mini"):
+        import openai  # optional dep
+        super().__init__(openai.OpenAI(api_key=api_key), model)
+
+
+class OpenAIEmbedder(_EmbeddingsEndpoint):
+    def __init__(self, api_key: str, model: str = "text-embedding-3-small"):
+        import openai  # optional dep
+        super().__init__(openai.OpenAI(api_key=api_key), model, dim=1536)
+
+
+class TogetherLLM(_ChatCompletionsLLM):
+    def __init__(self, api_key: str,
+                 model: str = "meta-llama/Llama-3.3-70B-Instruct-Turbo"):
+        import together  # optional dep
+        super().__init__(together.Together(api_key=api_key), model)
+
+
+class TogetherEmbedder(_EmbeddingsEndpoint):
+    def __init__(self, api_key: str,
+                 model: str = "togethercomputer/m2-bert-80M-8k-retrieval"):
+        import together  # optional dep
+        super().__init__(together.Together(api_key=api_key), model, dim=768)
 
 
 class GeminiLLM:
-    """Remote shim (parity: reference providers.py:59-99 — flattens chat
-    messages into a User:/Assistant: prompt; no response_format support)."""
+    """Gemini shim (parity: reference providers.py:59-99 semantics — chat
+    history flattens into one User:/Assistant: prompt; no response_format
+    support in this SDK surface)."""
 
     def __init__(self, api_key: str, model: str = "gemini-2.0-flash"):
         import google.generativeai as genai  # optional dep
@@ -398,19 +446,17 @@ class GeminiLLM:
 
     @staticmethod
     def _flatten(messages: List[Dict[str, str]]) -> str:
-        parts = []
-        for m in messages:
-            role = {"user": "User", "assistant": "Assistant"}.get(m["role"], "System")
-            parts.append(f"{role}: {m['content']}")
-        return "\n".join(parts)
+        roles = {"user": "User", "assistant": "Assistant"}
+        return "\n".join(f"{roles.get(m['role'], 'System')}: {m['content']}"
+                         for m in messages)
 
     def completion(self, messages, response_format=None) -> str:
-        try:
-            return self.model.generate_content(self._flatten(messages)).text or ""
-        except Exception:
-            return ""
+        return _swallow(
+            lambda: self.model.generate_content(self._flatten(messages)).text,
+            "")
 
-    def completion_stream(self, messages, response_format=None):
+    def completion_stream(self, messages,
+                          response_format=None) -> Iterator[str]:
         try:
             for chunk in self.model.generate_content(self._flatten(messages),
                                                      stream=True):
@@ -421,73 +467,19 @@ class GeminiLLM:
 
 
 class GeminiEmbedder:
-    dim = 768
-
     def __init__(self, api_key: str, model: str = "models/embedding-001"):
-        import google.generativeai as genai
+        import google.generativeai as genai  # optional dep
         genai.configure(api_key=api_key)
         self._genai = genai
         self.model = model
+        self.dim = 768
 
     def embed(self, text: str) -> List[float]:
-        try:
-            return self._genai.embed_content(model=self.model,
-                                             content=text)["embedding"]
-        except Exception:
-            return [0.0] * self.dim
+        return _swallow(
+            lambda: self._genai.embed_content(model=self.model,
+                                              content=text)["embedding"],
+            [0.0] * self.dim)
 
     def batch_embed(self, texts: List[str]) -> List[List[float]]:
+        # this SDK has no batch endpoint — per-text calls, same contract
         return [self.embed(t) for t in texts]
-
-
-class TogetherLLM:
-    def __init__(self, api_key: str,
-                 model: str = "meta-llama/Llama-3.3-70B-Instruct-Turbo"):
-        import together  # optional dep
-        self.client = together.Together(api_key=api_key)
-        self.model = model
-
-    def completion(self, messages, response_format=None) -> str:
-        try:
-            kwargs = {"model": self.model, "messages": messages, "temperature": 0.7}
-            if response_format:
-                kwargs["response_format"] = response_format
-            resp = self.client.chat.completions.create(**kwargs)
-            return resp.choices[0].message.content or ""
-        except Exception:
-            return ""
-
-    def completion_stream(self, messages, response_format=None):
-        try:
-            stream = self.client.chat.completions.create(
-                model=self.model, messages=messages, temperature=0.7, stream=True)
-            for chunk in stream:
-                delta = chunk.choices[0].delta.content
-                if delta:
-                    yield delta
-        except Exception:
-            return
-
-
-class TogetherEmbedder:
-    dim = 768
-
-    def __init__(self, api_key: str,
-                 model: str = "togethercomputer/m2-bert-80M-8k-retrieval"):
-        import together
-        self.client = together.Together(api_key=api_key)
-        self.model = model
-
-    def embed(self, text: str) -> List[float]:
-        try:
-            resp = self.client.embeddings.create(model=self.model, input=[text])
-            return resp.data[0].embedding
-        except Exception:
-            return [0.0] * self.dim
-
-    def batch_embed(self, texts: List[str]) -> List[List[float]]:
-        try:
-            resp = self.client.embeddings.create(model=self.model, input=texts)
-            return [d.embedding for d in resp.data]
-        except Exception:
-            return [[0.0] * self.dim for _ in texts]

@@ -1,0 +1,109 @@
+"""Multi-tenant at the BASELINE configs[1] cardinality (r4 verdict #6).
+
+The reference partitions tenants with a LanceDB BTREE on user_id
+(vector_store.py:55); here tenancy is a first-class arena column
+(core/state.py tenant_id) masked inside every kernel. These tests push
+the machinery to 1,000 tenants and verify what the column must
+guarantee: search isolation (also batched), per-tenant eviction, decay
+scoped to one tenant, and the system surface (switch_user /
+get_all_users) at high user cardinality.
+"""
+
+# 1k tenants × 100 rows: minutes, not seconds — full-lane only.
+pytestmark = __import__("pytest").mark.slow
+
+import numpy as np
+
+from lazzaro_tpu.core.index import MemoryIndex
+
+N_TENANTS = 1000
+ROWS_PER_TENANT = 100
+DIM = 64
+
+
+def _build_index():
+    rng = np.random.default_rng(0)
+    idx = MemoryIndex(dim=DIM, capacity=N_TENANTS * ROWS_PER_TENANT + 64,
+                      edge_capacity=1024)
+    for t in range(N_TENANTS):
+        emb = rng.standard_normal((ROWS_PER_TENANT, DIM)).astype(np.float32)
+        emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+        ids = [f"t{t}:m{i}" for i in range(ROWS_PER_TENANT)]
+        idx.add(ids, emb, [0.5] * ROWS_PER_TENANT, [0.0] * ROWS_PER_TENANT,
+                ["semantic"] * ROWS_PER_TENANT, ["default"] * ROWS_PER_TENANT,
+                f"user{t}")
+    return idx
+
+
+def test_thousand_tenant_isolation_eviction_decay():
+    idx = _build_index()
+    assert len(idx._tenants) == N_TENANTS
+    rng = np.random.default_rng(1)
+
+    # search isolation: a query NEVER crosses its tenant mask — sample 25
+    # tenants, query with another tenant's exact vector
+    sample = rng.integers(0, N_TENANTS, size=25)
+    import time
+    lat = []
+    for t in sample.tolist():
+        other = (t + 1) % N_TENANTS
+        q = np.asarray(
+            idx.state.emb[idx.id_to_row[f"t{other}:m0"]], np.float32)
+        t0 = time.perf_counter()
+        ids, _ = idx.search(q, f"user{t}", k=5)
+        lat.append((time.perf_counter() - t0) * 1e3)
+        assert ids and all(i.startswith(f"t{t}:") for i in ids)
+    p50 = float(np.percentile(lat, 50))
+    assert p50 < 5000          # sanity ceiling; the bench records the number
+
+    # batched search stays inside the tenant too
+    qs = np.asarray(
+        idx.state.emb[np.asarray([idx.id_to_row[f"t7:m{i}"]
+                                  for i in range(8)])], np.float32)
+    for ids, _ in idx.search_batch(qs, "user7", k=3):
+        assert ids and all(i.startswith("t7:") for i in ids)
+
+    # per-tenant eviction candidates come only from that tenant
+    for t in sample[:5].tolist():
+        cands = idx.evict_candidates(f"user{t}", k=7)
+        assert cands and all(nid.startswith(f"t{t}:") for nid, _ in cands)
+
+    # decay is tenant-scoped: user3's saliences drop, user4's are untouched
+    r3 = [idx.id_to_row[f"t3:m{i}"] for i in range(5)]
+    r4 = [idx.id_to_row[f"t4:m{i}"] for i in range(5)]
+    before = np.asarray(idx.state.salience)
+    idx.decay("user3", rate=0.1)
+    after = np.asarray(idx.state.salience)
+    assert (after[r3] < before[r3]).all()
+    np.testing.assert_array_equal(after[r4], before[r4])
+
+
+def test_system_thousand_users_switch_and_enumerate(tmp_path):
+    """switch_user / get_all_users at 1k-user cardinality: every user's
+    graph is isolated, enumeration sees everyone, and switching back
+    restores a user's memories from the store."""
+    from lazzaro_tpu.config import MemoryConfig
+    from lazzaro_tpu.core.memory_system import MemorySystem
+
+    n_users = 1000
+    ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False,
+                      config=MemoryConfig(journal=False))
+    first = ms.user_id
+    for u in range(n_users):
+        ms.switch_user(f"user{u}")
+        ms.start_conversation()
+        ms.add_to_short_term(f"user {u} owns artifact number {u}",
+                             "semantic", 0.8)
+        ms.end_conversation()
+    users = ms.get_all_users()
+    assert len([u for u in users if u.startswith("user")]) == n_users
+
+    # spot-check isolation + restore-on-switch for a few users
+    for u in (0, 499, 999):
+        ms.switch_user(f"user{u}")
+        hits = ms.search_memories(f"artifact number {u}")
+        assert hits, f"user{u} lost their graph"
+        assert all(f"user {u} " in n.content for n in hits)
+    ms.switch_user(first)
+    ms.close()
