@@ -14,6 +14,13 @@ mutable master (scatter updates, decay sweeps, exact merge thresholds);
 ``core/index.py`` re-quantizes lazily when enough rows changed. Reference
 analog: LanceDB's ANN index over the raw vectors (vector_store.py:132-140)
 — same split of exact store vs. scan-optimized replica.
+
+MEASURED (r5): the win is TPU-specific by design — on the 1-core CPU
+fallback int8 is SLOWER than exact (67.4 ms vs 60.7 ms at 100k×768,
+``bench_artifacts/r5_kernels_100k_cpu.json``: no int8 SIMD path there),
+exactly the inversion the r4 review flagged; the halved-bytes/int8-MXU
+claim applies to the TPU capture (``r5_kernels_1m_*.json`` via
+scripts/tpu_watch.py whenever the tunnel is up).
 """
 
 from __future__ import annotations
