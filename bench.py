@@ -747,6 +747,9 @@ def main():
     # full arena is pointless wall-clock on the CPU fallback.
     p50_ivf = None
     ivf_build_s = None
+    p50_pq = None
+    pq_recall = None
+    pq_build_s = None
     if ms.mesh is None and on_tpu:
         ms.index.ivf_nprobe = 8
         t0 = time.perf_counter()
@@ -772,6 +775,32 @@ def main():
                     ivf_hits += 1
             p50_ivf = float(np.percentile(lat_ivf, 50))
             ivf_recall = ivf_hits / QUERIES
+
+            # IVF-PQ over the SAME coarse build: m-byte member scan +
+            # exact shortlist refine (ops/pq.py)
+            from lazzaro_tpu.ops.pq import train_pq
+            t0 = time.perf_counter()
+            ms.index._pq_book = train_pq(ms.index.state.emb,
+                                         np.asarray(ms.index.state.alive))
+            ms.index._pq_dirty = True
+            ms.index.pq_serving = True
+            ms.search_memories(      # warm: triggers the lazy encode too
+                f"fact {probe[0]}: user detail number {probe[0]}")
+            pq_build_s = time.perf_counter() - t0
+            lat_pq = []
+            pq_hits = 0
+            for i in range(K_WARM, K_WARM + QUERIES):
+                q = f"fact {probe[i]}: user detail number {probe[i]}"
+                t0 = time.perf_counter()
+                hits = ms.search_memories(q)
+                lat_pq.append((time.perf_counter() - t0) * 1e3)
+                if hits and hits[0].content.startswith(f"fact {probe[i]}:"):
+                    pq_hits += 1
+            p50_pq = float(np.percentile(lat_pq, 50))
+            pq_recall = pq_hits / QUERIES
+            ms.index.pq_serving = False
+            ms.index._pq_book = None
+            ms.index._pq_codes = None
         ms.index.ivf_nprobe = 0
         ms.index._ivf = None             # free members/centroids/residual
         ms.index._ivf_res_cache = None
@@ -925,6 +954,12 @@ def main():
                             if ivf_build_s is not None else None),
             "ivf_exact_hit_rate": (round(ivf_recall, 3)
                                    if p50_ivf is not None else None),
+            "p50_ivf_pq_serving_ms": (round(p50_pq, 4)
+                                      if p50_pq is not None else None),
+            "ivf_pq_exact_hit_rate": (round(pq_recall, 3)
+                                      if pq_recall is not None else None),
+            "ivf_pq_train_encode_s": (round(pq_build_s, 2)
+                                      if pq_build_s is not None else None),
             "exact_hit_rate": round(hits_ok / QUERIES, 3),
             "ingest_pipeline_memories_per_sec_per_chip": (
                 round(ingest_per_s, 1) if ingest_per_s else None),

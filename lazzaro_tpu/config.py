@@ -23,17 +23,23 @@ class MemoryConfig:
     # Int8 serving shadow (ops/quant.py): user-facing searches scan a
     # per-row-quantized copy at half the HBM bytes (the bandwidth floor is
     # what bounds 1M-row retrieval); consolidation's dedup/link/merge
-    # decisions keep scanning the exact master arena. Single-chip only:
-    # under a mesh the flag is ignored (with a warning) — the sharded path
-    # searches the exact arena through shard_map.
+    # decisions keep scanning the exact master arena. Composes with a
+    # mesh: the shadow row-shards like the master and each chip scans its
+    # local int8 rows (ops/topk.py make_sharded_int8_topk).
     int8_serving: bool = False
     # IVF coarse stage (ops/ivf.py): > 0 sets nprobe and routes serving
     # searches through centroid prefilter + member gather once the arena
     # passes ~4k live rows (below that exact scans are trivial). Fresh
     # rows serve exactly from a residual until the periodic rebuild;
     # recall is controlled by nprobe (== n_clusters is exact). Consolidation
-    # gates always use the exact master. Single-chip only, like int8.
+    # gates always use the exact master. Single-chip only.
     ivf_serving: int = 0
+    # IVF-PQ member storage (ops/pq.py; LanceDB's default index family):
+    # with ivf_serving > 0, the member scan reads product-quantized codes
+    # (m = dim/8 bytes per row instead of dim·2) and the top shortlist is
+    # re-scored exactly from the master, so returned scores stay exact.
+    # No effect without ivf_serving.
+    pq_serving: bool = False
 
     # --- behavior flags (parity with memory_system.py:63-84) ---------------
     enable_sharding: bool = True

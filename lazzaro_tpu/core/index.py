@@ -40,7 +40,8 @@ class MemoryIndex:
     def __init__(self, dim: int, capacity: int = 1024, edge_capacity: int = 8192,
                  dtype=jnp.float32, epoch: Optional[float] = None,
                  mesh=None, shard_axis: str = "data",
-                 int8_serving: bool = False, ivf_nprobe: int = 0):
+                 int8_serving: bool = False, ivf_nprobe: int = 0,
+                 pq_serving: bool = False):
         self.dim = dim
         self.dtype = dtype
         # Int8 serving shadow (ops/quant.py): half the HBM bytes per scan.
@@ -83,6 +84,14 @@ class MemoryIndex:
         self._ivf_in_residual = None       # np bool [rows]: in SEALED residual
         self._ivf_stale = 0                # member slots invalidated by delete
         self._ivf_res_cache = None         # (ivf, len(fresh), device residual)
+        # IVF-PQ member storage (ops/pq.py): the member scan reads m-byte
+        # codes instead of d·2-byte rows and the shortlist is re-scored
+        # exactly from the master. Codebook trains in ivf_maintenance;
+        # codes re-encode lazily like the int8 shadow.
+        self.pq_serving = bool(pq_serving) and self.ivf_nprobe > 0
+        self._pq_book = None               # PQCodebook (trained once/rebuild)
+        self._pq_codes = None              # [rows, m] u8 device array
+        self._pq_dirty = True
         self.mesh = mesh
         self.shard_axis = shard_axis
         self._n_parts = int(mesh.shape[shard_axis]) if mesh is not None else 1
@@ -123,6 +132,9 @@ class MemoryIndex:
         self._ivf_routed = None
         self._ivf_in_residual = None
         self._ivf_stale = 0
+        self._pq_book = None
+        self._pq_codes = None
+        self._pq_dirty = True
         self._ivf_pack = None if v is None else (v, ())
 
     @property
@@ -214,6 +226,7 @@ class MemoryIndex:
             "int8_serving": self.int8_serving,
             "ivf": (f"nprobe={self.ivf_nprobe}, "
                     f"{'built' if self._ivf is not None else 'pending'}"
+                    + (", pq" if self.pq_serving else "")
                     if self.ivf_nprobe else None),
             "mesh": (f"{self._n_parts}x {self.shard_axis}"
                      if self.mesh is not None else None),
@@ -226,6 +239,7 @@ class MemoryIndex:
             new_cap = self._grown_capacity(old_cap)
             self.state = S.grow_arena(self.state, new_cap)
             self._int8_dirty = True        # emb shape changed
+            self._pq_dirty = True
             self._free_rows = list(range(new_cap - 1, old_cap - 1, -1)) + self._free_rows
         return [self._free_rows.pop() for _ in range(n)]
 
@@ -280,6 +294,7 @@ class MemoryIndex:
             jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
         )
         self._int8_dirty = True            # emb rows written
+        self._pq_dirty = True
         pack = self._ivf_pack
         if self.ivf_nprobe and pack is not None:
             ivf, ivf_fresh = pack
@@ -460,9 +475,19 @@ class MemoryIndex:
         if n_cand < k_eff:
             return None
         mask = S.arena_mask(st, jnp.int32(tid), super_filter)
-        scores, rows = ivf_search(ivf.centroids, ivf.members, residual,
-                                  st.emb, mask, S.normalize(q_pad),
-                                  k_eff, nprobe=self.ivf_nprobe)
+        book = self._pq_book
+        if self.pq_serving and book is not None:
+            from lazzaro_tpu.ops.pq import ivf_pq_search
+
+            codes = self._pq_codes_for(st, book)
+            scores, rows = ivf_pq_search(
+                ivf.centroids, ivf.members, residual, book.centroids,
+                codes, st.emb, mask, S.normalize(q_pad), k_eff,
+                nprobe=self.ivf_nprobe, r=max(4 * k_eff, 64))
+        else:
+            scores, rows = ivf_search(ivf.centroids, ivf.members, residual,
+                                      st.emb, mask, S.normalize(q_pad),
+                                      k_eff, nprobe=self.ivf_nprobe)
         return fetch_packed(scores, rows)      # ONE readback RTT
 
     def ivf_maintenance(self) -> bool:
@@ -502,7 +527,27 @@ class MemoryIndex:
         self._ivf_stale = 0
         self._ivf_res_cache = None
         self._ivf_pack = (ivf, ())
+        if self.pq_serving:
+            # (re)train the member codebook on the same build cadence; the
+            # codes shadow re-encodes lazily on the serving path
+            from lazzaro_tpu.ops.pq import train_pq
+            self._pq_book = train_pq(st.emb, mask_np)
+            self._pq_dirty = True
         return True
+
+    def _pq_codes_for(self, st: S.ArenaState, book):
+        """Lazy re-encode of the PQ code shadow from ONE arena snapshot
+        (same contract as the int8 shadow: invalidated by add/grow,
+        cleared only when no writer raced past ``st``)."""
+        codes = self._pq_codes
+        if (self._pq_dirty or codes is None
+                or codes.shape[0] != st.emb.shape[0]):
+            from lazzaro_tpu.ops.pq import encode_pq
+            codes = encode_pq(book.centroids, st.emb)
+            self._pq_codes = codes
+            if self.state is st:
+                self._pq_dirty = False
+        return codes
 
     def _ivf_residual_dev(self, ivf, fresh):
         """Sealed-build residual + fresh rows as one padded device array,

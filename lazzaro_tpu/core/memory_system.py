@@ -129,7 +129,8 @@ class MemorySystem:
                                  edge_capacity=cfg.max_edges,
                                  dtype=jnp.dtype(cfg.dtype), mesh=mesh,
                                  int8_serving=cfg.int8_serving,
-                                 ivf_nprobe=cfg.ivf_serving)
+                                 ivf_nprobe=cfg.ivf_serving,
+                                 pq_serving=cfg.pq_serving)
 
         self.query_cache = QueryCache(cfg.cache_size) if self.enable_caching else None
 
@@ -1873,7 +1874,8 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             new_index = ckpt.load_index(os.path.join(snapshot_dir, "index"),
                                         mesh=self.mesh,
                                         int8_serving=self.config.int8_serving,
-                                        ivf_nprobe=self.config.ivf_serving)
+                                        ivf_nprobe=self.config.ivf_serving,
+                                        pq_serving=self.config.pq_serving)
             # Pairing check: both halves carry the save's snapshot_id; a
             # mismatch means a crash landed between the two writes and one
             # half is stale. Restore proceeds (both halves are individually

@@ -1,0 +1,190 @@
+"""IVF-PQ serving: member storage at m bytes per row + exact refinement.
+
+The int8 shadow (ops/quant.py) halves scan bytes; product quantization
+goes an order of magnitude further — the missing member of the serving-
+mode family (VERDICT r4 "what's missing" #3; reference analog: LanceDB's
+DEFAULT index family is IVF-PQ over the raw vectors,
+vector_store.py:132-140, which this composes the same way: IVF coarse
+routing from ops/ivf.py + PQ member scan + exact re-rank).
+
+Geometry: split d dims into ``m`` subspaces of d/m dims; per-subspace
+k-means learns 256 centroids; a row stores one byte per subspace
+(codes [N, m] u8 — 96 bytes/row at 768-d/m=96 vs 1536 bytes bf16, 16×).
+A query (1) scores the IVF centroids and picks ``nprobe`` clusters
+exactly as the plain-IVF path does, (2) gathers the candidates' CODES
+(~nprobe·N/C rows × m bytes instead of × d·2 bytes), scores them with a
+per-query lookup table of partial dots (asymmetric distance), (3) takes
+a top-R shortlist and REFINES: the shortlist's exact bf16 rows are
+gathered from the master arena and re-scored, so the final top-k carries
+EXACT scores — recall is set by the coarse probes and shortlist depth,
+not by quantization error.
+
+A deliberate non-goal is the flat (non-IVF) PQ scan: asymmetric-distance
+over ALL rows is a per-row LUT gather, which the MXU has no use for —
+on TPU the whole-arena alternatives are the one-matmul exact/int8 scans.
+PQ earns its bytes exactly where LanceDB uses it: on the candidate set
+behind the coarse stage, where the gather is thousands of rows, not
+millions.
+
+Like the int8 shadow, PQ state is a SERVING SHADOW over the mutable
+master: codebooks train on a row sample (spherical geometry is
+stationary under the system's mutations — new facts, not new geometry),
+codes re-encode lazily when rows change, and threshold-gated callers
+(dedup 0.95 / link 0.5) always bypass to the exact master.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lazzaro_tpu.ops.chunking import chunked_map
+
+NEG_INF = -1e30
+
+
+@dataclass
+class PQCodebook:
+    centroids: jax.Array      # [m, 256, dsub] f32
+    dim: int
+
+    @property
+    def m(self) -> int:
+        return self.centroids.shape[0]
+
+    @property
+    def dsub(self) -> int:
+        return self.centroids.shape[2]
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _subspace_kmeans(x: jax.Array, init: jax.Array, iters: int) -> jax.Array:
+    """Plain L2 k-means in one subspace. x: [S, dsub] sample rows,
+    init: [256, dsub]. Empty clusters keep their previous centroid."""
+
+    def step(cent, _):
+        # assignment by L2: argmax(2·x·c - |c|²) — |x|² is constant per row
+        scores = (2.0 * x @ cent.T
+                  - jnp.sum(cent * cent, axis=1)[None, :])     # [S, 256]
+        a = jnp.argmax(scores, axis=1)
+        sums = jnp.zeros_like(cent).at[a].add(x)
+        counts = jnp.zeros((cent.shape[0],), jnp.float32).at[a].add(1.0)
+        new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), cent)
+        return new, None
+
+    cent, _ = jax.lax.scan(step, init, None, length=iters)
+    return cent
+
+
+def train_pq(emb: jax.Array, mask_np: np.ndarray, m: int = None,
+             sample: int = 65536, iters: int = 12, seed: int = 0
+             ) -> PQCodebook:
+    """Learn per-subspace codebooks from a row sample of the alive arena.
+
+    ``m`` defaults to d/8 (dsub=8): ~0.5-1% cosine reconstruction error on
+    unit rows — comfortably inside the serving top-k's refinement margin
+    (the final ranking is exact anyway). Training cost is m small k-means
+    over ≤``sample`` rows, a few hundred ms on either backend."""
+    d = emb.shape[1]
+    if m is None:
+        m = max(1, d // 8)
+    if d % m != 0:
+        raise ValueError(f"dim {d} not divisible by m={m}")
+    dsub = d // m
+    alive_rows = np.nonzero(mask_np)[0]
+    if len(alive_rows) == 0:
+        raise ValueError("cannot train PQ over an empty arena")
+    rng = np.random.default_rng(seed)
+    if len(alive_rows) > sample:
+        alive_rows = rng.choice(alive_rows, size=sample, replace=False)
+    x = emb[jnp.asarray(np.sort(alive_rows))].astype(jnp.float32)  # [S, d]
+    xs = x.reshape(x.shape[0], m, dsub)                            # [S, m, ds]
+
+    n_init = min(256, x.shape[0])
+    init_rows = rng.choice(x.shape[0], size=n_init, replace=False)
+    if n_init < 256:
+        init_rows = np.concatenate(
+            [init_rows, rng.choice(x.shape[0], size=256 - n_init)])
+    init = xs[jnp.asarray(init_rows)]                              # [256, m, ds]
+
+    cents = jax.vmap(_subspace_kmeans, in_axes=(1, 1, None), out_axes=0)(
+        xs, init, iters)                                           # [m, 256, ds]
+    return PQCodebook(centroids=cents, dim=d)
+
+
+@jax.jit
+def encode_pq(book_cent: jax.Array, emb: jax.Array) -> jax.Array:
+    """codes [N, m] u8: per-subspace nearest centroid (L2). One fused
+    pass: m small [chunk, dsub]×[dsub, 256] matmuls per row chunk."""
+    m, _, dsub = book_cent.shape
+    cnorm = jnp.sum(book_cent * book_cent, axis=2)                 # [m, 256]
+
+    def chunk(rows):
+        x = emb[rows].astype(jnp.float32).reshape(rows.shape[0], m, dsub)
+        scores = (2.0 * jnp.einsum("nmd,mkd->nmk", x, book_cent)
+                  - cnorm[None, :, :])                             # [C, m, 256]
+        return jnp.argmax(scores, axis=2).astype(jnp.uint8)
+
+    return chunked_map(chunk, jnp.arange(emb.shape[0], dtype=jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "r", "q_chunk"))
+def ivf_pq_search(centroids: jax.Array, members: jax.Array,
+                  residual: jax.Array, book_cent: jax.Array,
+                  codes: jax.Array, emb: jax.Array, mask: jax.Array,
+                  queries: jax.Array, k: int, nprobe: int = 8,
+                  r: int = 128, q_chunk: int = 8
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Coarse (IVF centroids) → PQ member scan → exact refine, ONE dispatch.
+
+    Identical candidate set to ``ops.ivf.ivf_search`` (same members +
+    residual tables), but the candidate gather moves m bytes per row
+    instead of d·2: the LUT-gather runs over thousands of candidates, not
+    the whole arena, and the top-``r`` shortlist is re-scored EXACTLY
+    from the bf16 master so the returned scores match the exact path for
+    every hit the shortlist keeps."""
+    q = queries.astype(jnp.float32)
+    q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-9)
+    nprobe = min(nprobe, centroids.shape[0])
+    m, _, dsub = book_cent.shape
+    offs = jnp.arange(m, dtype=jnp.int32) * 256                    # [m]
+
+    def chunk(q_c):                                                # [qc, d]
+        qc = q_c.shape[0]
+        cs = jnp.dot(q_c, centroids.T,
+                     preferred_element_type=jnp.float32)           # [qc, C]
+        _, cids = jax.lax.top_k(cs, nprobe)
+        cand = members[cids].reshape(qc, -1)                       # [qc, P*M]
+        cand = jnp.concatenate(
+            [cand, jnp.broadcast_to(residual[None, :],
+                                    (qc, residual.shape[0]))], axis=1)
+        safe = jnp.maximum(cand, 0)                                # [qc, L]
+        valid = (cand >= 0) & mask[safe]
+
+        # asymmetric distance: per-query LUT of partial dots + code gather
+        qs = q_c.reshape(qc, m, dsub)
+        lut = jnp.einsum("qmd,mkd->qmk", qs, book_cent)            # [qc, m, 256]
+        flat_lut = lut.reshape(qc, -1)                             # [qc, m*256]
+        idx = codes[safe].astype(jnp.int32) + offs[None, None, :]  # [qc, L, m]
+        s = jax.vmap(lambda fl, ix: jnp.take(fl, ix).sum(-1))(
+            flat_lut, idx)                                         # [qc, L]
+        s = jnp.where(valid, s, NEG_INF)
+
+        # shortlist → exact re-rank from the master arena
+        r_eff = min(r, s.shape[1])
+        _, pos = jax.lax.top_k(s, r_eff)
+        short = jnp.take_along_axis(cand, pos, axis=1)             # [qc, R]
+        s_safe = jnp.maximum(short, 0)
+        vecs = emb[s_safe].astype(jnp.float32)                     # [qc, R, d]
+        exact = jnp.einsum("qrd,qd->qr", vecs, q_c)
+        ok = (short >= 0) & mask[s_safe]
+        exact = jnp.where(ok, exact, NEG_INF)
+        top_s, tpos = jax.lax.top_k(exact, min(k, r_eff))
+        return top_s, jnp.take_along_axis(short, tpos, axis=1)
+
+    return chunked_map(chunk, q, chunk=q_chunk)

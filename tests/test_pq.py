@@ -1,0 +1,170 @@
+"""IVF-PQ serving kernels (ops/pq.py): codebook quality, recall vs the
+exact oracle, refinement exactness, and masking."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from lazzaro_tpu.ops.ivf import build_ivf
+from lazzaro_tpu.ops.pq import PQCodebook, encode_pq, ivf_pq_search, train_pq
+
+
+def _clustered(n, d, group=4, seed=0):
+    """Bench-like geometry: groups of `group` rows at ~0.88 cosine."""
+    rng = np.random.default_rng(seed)
+    n_groups = n // group
+    g_dirs = rng.standard_normal((n_groups, d)).astype(np.float32)
+    g_dirs /= np.linalg.norm(g_dirs, axis=1, keepdims=True)
+    noise = rng.standard_normal((n, d)).astype(np.float32)
+    noise /= np.linalg.norm(noise, axis=1, keepdims=True)
+    v = 0.94 * g_dirs[np.arange(n) % n_groups] + 0.35 * noise
+    return (v / np.linalg.norm(v, axis=1, keepdims=True)).astype(np.float32)
+
+
+def test_codebook_reconstruction_error():
+    d = 64
+    emb = _clustered(4096, d)
+    book = train_pq(jnp.asarray(emb), np.ones((4096,), bool), m=d // 8,
+                    iters=10, seed=1)
+    codes = np.asarray(encode_pq(book.centroids, jnp.asarray(emb)))
+    assert codes.shape == (4096, d // 8) and codes.dtype == np.uint8
+    cent = np.asarray(book.centroids)                      # [m, 256, dsub]
+    recon = cent[np.arange(d // 8)[None, :], codes]        # [N, m, dsub]
+    recon = recon.reshape(4096, d)
+    cos = (recon * emb).sum(1) / np.maximum(
+        np.linalg.norm(recon, axis=1), 1e-9)
+    # PQ is lossy by design (~0.88 cosine at dsub=8/256 centroids on this
+    # geometry); serving recall comes from the shortlist + exact refine,
+    # gated by the recall test below — this only guards against a broken
+    # codebook (random codes sit near 0)
+    assert cos.mean() > 0.8, f"mean reconstruction cosine {cos.mean():.3f}"
+
+
+def test_ivf_pq_recall_and_exact_scores():
+    n, d, k = 20000, 64, 5
+    emb = _clustered(n, d, seed=2)
+    mask = np.ones((n,), bool)
+    dev = jnp.asarray(emb)
+    ivf = build_ivf(dev, mask, n_clusters=64, seed=3)
+    book = train_pq(dev, mask, iters=10, seed=4)
+    codes = encode_pq(book.centroids, dev)
+
+    rng = np.random.default_rng(5)
+    qrows = rng.integers(0, n, size=48)
+    queries = emb[qrows]
+
+    # exact oracle top-k
+    oracle_scores = queries @ emb.T
+    oracle = np.argsort(-oracle_scores, axis=1)[:, :k]
+
+    s, rows = ivf_pq_search(ivf.centroids, ivf.members, ivf.residual,
+                            book.centroids, codes, dev, jnp.asarray(mask),
+                            jnp.asarray(queries), k, nprobe=8, r=64)
+    s, rows = np.asarray(s), np.asarray(rows)
+
+    recall = np.mean([len(set(rows[i]) & set(oracle[i])) / k
+                      for i in range(len(qrows))])
+    assert recall > 0.9, f"ivf-pq recall@5 {recall:.3f}"
+
+    # refinement exactness: every returned score equals the EXACT cosine
+    # of that row (the PQ approximation only picks the shortlist)
+    for i in range(len(qrows)):
+        for j in range(k):
+            if s[i, j] < -1e29:
+                continue
+            exact = float(oracle_scores[i, rows[i, j]])
+            assert abs(s[i, j] - exact) < 5e-3
+    # self-query: top-1 is the row itself at ~1.0
+    assert (rows[:, 0] == qrows).mean() > 0.95
+
+
+def test_ivf_pq_respects_mask():
+    n, d = 8192, 32
+    emb = _clustered(n, d, seed=6)
+    mask = np.ones((n,), bool)
+    dead = np.arange(0, n, 3)
+    mask[dead] = False
+    dev = jnp.asarray(emb)
+    ivf = build_ivf(dev, np.ones((n,), bool), n_clusters=32, seed=7)
+    book = train_pq(dev, mask, iters=6, seed=8)
+    codes = encode_pq(book.centroids, dev)
+    q = emb[dead[:8]]                     # query WITH dead rows' vectors
+    _, rows = ivf_pq_search(ivf.centroids, ivf.members, ivf.residual,
+                            book.centroids, codes, dev, jnp.asarray(mask),
+                            jnp.asarray(q), 5, nprobe=8, r=64)
+    rows = np.asarray(rows)
+    dead_set = set(dead.tolist())
+    assert not any(int(r) in dead_set for r in rows.ravel() if r >= 0)
+
+
+def test_memory_index_pq_serving_and_freshness():
+    from lazzaro_tpu.core.index import MemoryIndex
+
+    rng = np.random.default_rng(10)
+    d, n = 32, 5000                       # past _IVF_MIN_ROWS
+    emb = _clustered(n, d, seed=11)
+    idx = MemoryIndex(dim=d, capacity=n + 64, ivf_nprobe=8, pq_serving=True)
+    assert idx.pq_serving
+    ids = [f"m{i}" for i in range(n)]
+    idx.add(ids, emb, [0.5] * n, [0.0] * n, ["semantic"] * n,
+            ["default"] * n, "u1")
+    assert idx.ivf_maintenance()          # builds IVF AND trains the book
+    assert idx._pq_book is not None
+
+    probe = rng.integers(0, n, 50)
+    res = idx.search_batch(emb[probe], "u1", k=1)
+    assert idx._pq_codes is not None      # the PQ path actually served
+    hits = sum(1 for p, (got, _) in zip(probe, res) if got == [f"m{p}"])
+    assert hits >= 47, f"pq self-recall {hits}/50"
+    # refinement exactness: the self-hit score is the exact cosine (~1.0)
+    (got, sc), = idx.search_batch(emb[probe[:1]], "u1", k=1)
+    assert abs(sc[0] - 1.0) < 5e-3
+
+    # a fresh post-build row re-encodes lazily and is served
+    fresh = np.zeros((1, d), np.float32)
+    fresh[0, 3] = 1.0
+    idx.add(["fresh"], fresh, [0.5], [0.0], ["semantic"], ["default"], "u1")
+    assert idx._pq_dirty
+    (got, _), = idx.search_batch(fresh, "u1", k=1)
+    assert got == ["fresh"]
+
+    # exact=True bypasses the whole approximate stack
+    (got_exact, _), = idx.search_batch(fresh, "u1", k=1, exact=True)
+    assert got_exact == ["fresh"]
+
+    assert ", pq" in idx.stats()["ivf"]
+
+
+def test_pq_without_ivf_is_inert():
+    from lazzaro_tpu.core.index import MemoryIndex
+
+    idx = MemoryIndex(dim=16, capacity=64, pq_serving=True)  # no ivf_nprobe
+    assert not idx.pq_serving
+
+
+def test_system_pq_maintenance_and_snapshot(tmp_path):
+    """MemorySystem threads pq_serving through construction, the worker
+    maintenance hook, and snapshot restore (the ivf_serving restore drop
+    was advisor r4's medium finding — PQ must not repeat it)."""
+    from lazzaro_tpu.config import MemoryConfig
+    from lazzaro_tpu.core.memory_system import MemorySystem
+
+    ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False,
+                      config=MemoryConfig(journal=False, ivf_serving=4,
+                                          pq_serving=True))
+    assert ms.index.pq_serving
+    ms.start_conversation()
+    ms.chat("I work as a data engineer on a big ETL project.")
+    ms.end_conversation()
+    snap = str(tmp_path / "snap")
+    ms.save_snapshot(snap)
+    ms.close()
+
+    ms2 = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db2"),
+                       verbose=False, load_from_disk=False,
+                       config=MemoryConfig(journal=False, ivf_serving=4,
+                                           pq_serving=True))
+    assert "loaded" in ms2.load_snapshot(snap)
+    assert ms2.index.pq_serving and ms2.index.ivf_nprobe == 4
+    assert ms2.search_memories("what is the user's job?")
+    ms2.close()
