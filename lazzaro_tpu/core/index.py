@@ -87,10 +87,11 @@ class MemoryIndex:
         # IVF-PQ member storage (ops/pq.py): the member scan reads m-byte
         # codes instead of d·2-byte rows and the shortlist is re-scored
         # exactly from the master. Codebook trains in ivf_maintenance;
-        # codes re-encode lazily like the int8 shadow.
+        # codes re-encode lazily like the int8 shadow. Book and codes are
+        # published as ONE tuple — codes are meaningless against any other
+        # book, so a reader must never pair them across a retrain.
         self.pq_serving = bool(pq_serving) and self.ivf_nprobe > 0
-        self._pq_book = None               # PQCodebook (trained once/rebuild)
-        self._pq_codes = None              # [rows, m] u8 device array
+        self._pq_pack: Optional[tuple] = None  # (PQCodebook, codes | None)
         self._pq_dirty = True
         self.mesh = mesh
         self.shard_axis = shard_axis
@@ -132,8 +133,7 @@ class MemoryIndex:
         self._ivf_routed = None
         self._ivf_in_residual = None
         self._ivf_stale = 0
-        self._pq_book = None
-        self._pq_codes = None
+        self._pq_pack = None
         self._pq_dirty = True
         self._ivf_pack = None if v is None else (v, ())
 
@@ -141,6 +141,27 @@ class MemoryIndex:
     def _ivf_fresh(self) -> List[int]:
         pack = self._ivf_pack
         return list(pack[1]) if pack is not None else []
+
+    # Compat views over the PQ pack (bench/tests poke these).
+    @property
+    def _pq_book(self):
+        pack = self._pq_pack
+        return pack[0] if pack is not None else None
+
+    @_pq_book.setter
+    def _pq_book(self, v) -> None:
+        self._pq_pack = None if v is None else (v, None)
+
+    @property
+    def _pq_codes(self):
+        pack = self._pq_pack
+        return pack[1] if pack is not None else None
+
+    @_pq_codes.setter
+    def _pq_codes(self, v) -> None:
+        pack = self._pq_pack
+        if pack is not None:
+            self._pq_pack = (pack[0], v)
 
     # -------------------------------------------------------------- sharding
     def _round_capacity(self, capacity: int, block: bool = True) -> int:
@@ -475,13 +496,13 @@ class MemoryIndex:
         if n_cand < k_eff:
             return None
         mask = S.arena_mask(st, jnp.int32(tid), super_filter)
-        book = self._pq_book
-        if self.pq_serving and book is not None:
+        pq_pack = self._pq_pack
+        if self.pq_serving and pq_pack is not None:
             from lazzaro_tpu.ops.pq import ivf_pq_search
 
-            codes = self._pq_codes_for(st, book)
+            codes = self._pq_codes_for(st, pq_pack)
             scores, rows = ivf_pq_search(
-                ivf.centroids, ivf.members, residual, book.centroids,
+                ivf.centroids, ivf.members, residual, pq_pack[0].centroids,
                 codes, st.emb, mask, S.normalize(q_pad), k_eff,
                 nprobe=self.ivf_nprobe, r=max(4 * k_eff, 64))
         else:
@@ -529,24 +550,31 @@ class MemoryIndex:
         self._ivf_pack = (ivf, ())
         if self.pq_serving:
             # (re)train the member codebook on the same build cadence; the
-            # codes shadow re-encodes lazily on the serving path
+            # codes shadow re-encodes lazily on the serving path. ONE pack
+            # swap: a reader sees the old (book, codes) pair or the new
+            # book awaiting codes — never old codes under a new book.
             from lazzaro_tpu.ops.pq import train_pq
-            self._pq_book = train_pq(st.emb, mask_np)
             self._pq_dirty = True
+            self._pq_pack = (train_pq(st.emb, mask_np), None)
         return True
 
-    def _pq_codes_for(self, st: S.ArenaState, book):
+    def _pq_codes_for(self, st: S.ArenaState, pack):
         """Lazy re-encode of the PQ code shadow from ONE arena snapshot
         (same contract as the int8 shadow: invalidated by add/grow,
-        cleared only when no writer raced past ``st``)."""
-        codes = self._pq_codes
+        cleared only when no writer raced past ``st``). Codes are encoded
+        with — and published next to — ``pack``'s book; if a maintenance
+        retrain raced us, the fresh codes are still returned for THIS
+        serve (they match the local book) but never published against the
+        newer book (r5 review: that pairing scores garbage)."""
+        book, codes = pack
         if (self._pq_dirty or codes is None
                 or codes.shape[0] != st.emb.shape[0]):
             from lazzaro_tpu.ops.pq import encode_pq
             codes = encode_pq(book.centroids, st.emb)
-            self._pq_codes = codes
-            if self.state is st:
-                self._pq_dirty = False
+            if self._pq_pack is pack:
+                self._pq_pack = (book, codes)
+                if self.state is st:
+                    self._pq_dirty = False
         return codes
 
     def _ivf_residual_dev(self, ivf, fresh):

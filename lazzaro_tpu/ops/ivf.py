@@ -152,6 +152,30 @@ def build_ivf(emb: jax.Array, mask_np: np.ndarray,
                     residual=jnp.asarray(residual), built_rows=n_alive)
 
 
+def gather_candidates(centroids: jax.Array, members: jax.Array,
+                      residual: jax.Array, mask: jax.Array, q_c: jax.Array,
+                      nprobe: int
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The coarse stage, shared by the exact and PQ member scans: score C
+    centroids, take the ``nprobe`` best clusters, and assemble the
+    candidate row set (their members + the residual). Returns
+    ``(cand [qc, L], safe_rows, valid_mask)`` — both kernels MUST build
+    their candidate set here so the 'identical candidate set' invariant
+    between ``ivf_search`` and ``ops.pq.ivf_pq_search`` is structural,
+    not a docstring promise."""
+    cs = jnp.dot(q_c, centroids.T,
+                 preferred_element_type=jnp.float32)       # [qc, C]
+    _, cids = jax.lax.top_k(cs, nprobe)                    # [qc, P]
+    cand = members[cids].reshape(q_c.shape[0], -1)         # [qc, P*M]
+    cand = jnp.concatenate(
+        [cand, jnp.broadcast_to(residual[None, :],
+                                (q_c.shape[0], residual.shape[0]))],
+        axis=1)                                            # [qc, P*M+R]
+    safe = jnp.maximum(cand, 0)
+    valid = (cand >= 0) & mask[safe]
+    return cand, safe, valid
+
+
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "q_chunk"))
 def ivf_search(centroids: jax.Array, members: jax.Array, residual: jax.Array,
                emb: jax.Array, mask: jax.Array, queries: jax.Array,
@@ -159,27 +183,19 @@ def ivf_search(centroids: jax.Array, members: jax.Array, residual: jax.Array,
                ) -> Tuple[jax.Array, jax.Array]:
     """Coarse (centroid) → fine (member gather) masked top-k.
 
-    Per query: score C centroids, take the ``nprobe`` best clusters,
-    gather their member rows plus the residual, score those candidates
-    exactly, and top-k. Candidate tensors are [q_chunk, nprobe·M + R, d],
-    so queries stream in small chunks to bound the gather footprint."""
+    Per query: the shared coarse stage assembles candidates, which are
+    scored exactly and top-k'd. Candidate tensors are
+    [q_chunk, nprobe·M + R, d], so queries stream in small chunks to
+    bound the gather footprint."""
     q = queries.astype(jnp.float32)
     q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-9)
     nprobe = min(nprobe, centroids.shape[0])
 
     def chunk(q_c):                                        # [qc, d]
-        cs = jnp.dot(q_c, centroids.T,
-                     preferred_element_type=jnp.float32)   # [qc, C]
-        _, cids = jax.lax.top_k(cs, nprobe)                # [qc, P]
-        cand = members[cids].reshape(q_c.shape[0], -1)     # [qc, P*M]
-        cand = jnp.concatenate(
-            [cand, jnp.broadcast_to(residual[None, :],
-                                    (q_c.shape[0], residual.shape[0]))],
-            axis=1)                                        # [qc, P*M+R]
-        safe = jnp.maximum(cand, 0)
+        cand, safe, valid = gather_candidates(centroids, members, residual,
+                                              mask, q_c, nprobe)
         vecs = emb[safe].astype(jnp.float32)               # [qc, L, d]
         scores = jnp.einsum("qld,qd->ql", vecs, q_c)
-        valid = (cand >= 0) & mask[safe]
         scores = jnp.where(valid, scores, NEG_INF)
         ts, pos = jax.lax.top_k(scores, k)
         return ts, jnp.take_along_axis(cand, pos, axis=1)

@@ -44,8 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from lazzaro_tpu.ops.chunking import chunked_map
-
-NEG_INF = -1e30
+from lazzaro_tpu.ops.ivf import NEG_INF, gather_candidates
 
 
 @dataclass
@@ -92,7 +91,11 @@ def train_pq(emb: jax.Array, mask_np: np.ndarray, m: int = None,
     over ≤``sample`` rows, a few hundred ms on either backend."""
     d = emb.shape[1]
     if m is None:
-        m = max(1, d // 8)
+        # largest divisor of d with dsub >= 8 — embed_dim is configurable
+        # (300-d GloVe etc.), so the default must never raise from the
+        # background maintenance hook
+        m = next((cand for cand in range(max(1, d // 8), 0, -1)
+                  if d % cand == 0), 1)
     if d % m != 0:
         raise ValueError(f"dim {d} not divisible by m={m}")
     dsub = d // m
@@ -142,49 +145,50 @@ def ivf_pq_search(centroids: jax.Array, members: jax.Array,
                   ) -> Tuple[jax.Array, jax.Array]:
     """Coarse (IVF centroids) → PQ member scan → exact refine, ONE dispatch.
 
-    Identical candidate set to ``ops.ivf.ivf_search`` (same members +
-    residual tables), but the candidate gather moves m bytes per row
-    instead of d·2: the LUT-gather runs over thousands of candidates, not
-    the whole arena, and the top-``r`` shortlist is re-scored EXACTLY
-    from the bf16 master so the returned scores match the exact path for
-    every hit the shortlist keeps."""
+    The candidate set comes from the SAME shared coarse stage as
+    ``ops.ivf.ivf_search`` (``gather_candidates``), but only the MEMBER
+    candidates are scored through their m-byte codes; the residual
+    (fresh/overflow) rows go straight into the exact refine set, so the
+    IVF freshness invariant — residual rows are scanned exactly — holds
+    under PQ too, at the same gather cost the exact member scan already
+    paid for them. The top-``r`` member shortlist plus the residual are
+    re-scored EXACTLY from the bf16 master: returned scores match the
+    exact path for every row the shortlist keeps."""
     q = queries.astype(jnp.float32)
     q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-9)
     nprobe = min(nprobe, centroids.shape[0])
     m, _, dsub = book_cent.shape
     offs = jnp.arange(m, dtype=jnp.int32) * 256                    # [m]
+    n_res = residual.shape[0]
 
     def chunk(q_c):                                                # [qc, d]
         qc = q_c.shape[0]
-        cs = jnp.dot(q_c, centroids.T,
-                     preferred_element_type=jnp.float32)           # [qc, C]
-        _, cids = jax.lax.top_k(cs, nprobe)
-        cand = members[cids].reshape(qc, -1)                       # [qc, P*M]
-        cand = jnp.concatenate(
-            [cand, jnp.broadcast_to(residual[None, :],
-                                    (qc, residual.shape[0]))], axis=1)
-        safe = jnp.maximum(cand, 0)                                # [qc, L]
-        valid = (cand >= 0) & mask[safe]
-
-        # asymmetric distance: per-query LUT of partial dots + code gather
+        cand, safe, valid = gather_candidates(centroids, members, residual,
+                                              mask, q_c, nprobe)
+        n_mem = cand.shape[1] - n_res                              # members
+        # asymmetric distance over the MEMBER part: per-query LUT of
+        # partial dots + code gather (m bytes per candidate row)
         qs = q_c.reshape(qc, m, dsub)
         lut = jnp.einsum("qmd,mkd->qmk", qs, book_cent)            # [qc, m, 256]
         flat_lut = lut.reshape(qc, -1)                             # [qc, m*256]
-        idx = codes[safe].astype(jnp.int32) + offs[None, None, :]  # [qc, L, m]
+        idx = (codes[safe[:, :n_mem]].astype(jnp.int32)
+               + offs[None, None, :])                              # [qc, Lm, m]
         s = jax.vmap(lambda fl, ix: jnp.take(fl, ix).sum(-1))(
-            flat_lut, idx)                                         # [qc, L]
-        s = jnp.where(valid, s, NEG_INF)
+            flat_lut, idx)                                         # [qc, Lm]
+        s = jnp.where(valid[:, :n_mem], s, NEG_INF)
 
-        # shortlist → exact re-rank from the master arena
+        # member shortlist ∪ residual → exact re-rank from the master
         r_eff = min(r, s.shape[1])
         _, pos = jax.lax.top_k(s, r_eff)
-        short = jnp.take_along_axis(cand, pos, axis=1)             # [qc, R]
+        short = jnp.concatenate(
+            [jnp.take_along_axis(cand[:, :n_mem], pos, axis=1),
+             cand[:, n_mem:]], axis=1)                             # [qc, R+Rres]
         s_safe = jnp.maximum(short, 0)
-        vecs = emb[s_safe].astype(jnp.float32)                     # [qc, R, d]
+        vecs = emb[s_safe].astype(jnp.float32)                     # [qc, ., d]
         exact = jnp.einsum("qrd,qd->qr", vecs, q_c)
         ok = (short >= 0) & mask[s_safe]
         exact = jnp.where(ok, exact, NEG_INF)
-        top_s, tpos = jax.lax.top_k(exact, min(k, r_eff))
+        top_s, tpos = jax.lax.top_k(exact, min(k, short.shape[1]))
         return top_s, jnp.take_along_axis(short, tpos, axis=1)
 
     return chunked_map(chunk, q, chunk=q_chunk)

@@ -168,3 +168,31 @@ def test_system_pq_maintenance_and_snapshot(tmp_path):
     assert ms2.index.pq_serving and ms2.index.ivf_nprobe == 4
     assert ms2.search_memories("what is the user's job?")
     ms2.close()
+
+
+def test_pq_codes_never_published_against_newer_book():
+    """A reader that re-encodes codes for an OLD book while maintenance
+    already published a new one must not overwrite the new pack — codes
+    are meaningless against any other book (r5 review)."""
+    from lazzaro_tpu.core.index import MemoryIndex
+    from lazzaro_tpu.ops.pq import train_pq
+
+    d, n = 32, 5000
+    emb = _clustered(n, d, seed=20)
+    idx = MemoryIndex(dim=d, capacity=n + 64, ivf_nprobe=8, pq_serving=True)
+    idx.add([f"m{i}" for i in range(n)], emb, [0.5] * n, [0.0] * n,
+            ["semantic"] * n, ["default"] * n, "u1")
+    assert idx.ivf_maintenance()
+    old_pack = idx._pq_pack
+
+    # simulate a maintenance retrain racing the reader
+    new_book = train_pq(idx.state.emb, np.ones((idx.state.emb.shape[0],),
+                                               bool), seed=99)
+    idx._pq_dirty = True
+    idx._pq_pack = (new_book, None)
+    new_pack = idx._pq_pack
+
+    codes = idx._pq_codes_for(idx.state, old_pack)   # reader with old pack
+    assert codes is not None
+    assert idx._pq_pack is new_pack                  # not overwritten
+    assert idx._pq_pack[1] is None                   # new book still codeless
