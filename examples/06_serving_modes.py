@@ -1,10 +1,13 @@
-"""Three serving modes over one arena: exact, int8 shadow, IVF coarse-fine.
+"""Four serving modes over one arena: exact, int8, IVF, IVF-PQ.
 
 Retrieval at scale is HBM-bandwidth-bound: an exact 1M×768 bf16 scan
 streams ~1.5 GB per query batch. The int8 shadow halves the bytes
 (~0.4% cosine error, consolidation keeps the exact master); the IVF
 coarse stage visits only the nprobe nearest clusters' rows (~25× less
-traffic, recall set by nprobe, fresh rows exact via a residual).
+traffic, recall set by nprobe, fresh rows exact via a residual); IVF-PQ
+stores members as dim/8-byte codes and re-scores the shortlist exactly
+from the master (LanceDB's default index family, measured curves in
+bench_artifacts/).
 
     python examples/06_serving_modes.py   # offline, CPU or TPU
 """
@@ -36,6 +39,9 @@ for mode, setup in [
                        setattr(idx, "ivf_nprobe", 8),
                        idx.ivf_maintenance())),   # builds run in background
                                                   # maintenance, not queries
+    ("ivfpq", lambda: (setattr(idx, "pq_serving", True),
+                       setattr(idx, "_ivf_pack", None),
+                       idx.ivf_maintenance())),   # retrain WITH the codebook
 ]:
     setup()
     res = idx.search_batch(queries, "demo", k=1)
@@ -43,5 +49,5 @@ for mode, setup in [
     print(f"{mode}: self-lookup recall {hits}/{len(probe)}   "
           f"stats={idx.stats().get('ivf') or idx.stats()['int8_serving']}")
 
-print("\nall three modes answer from the same HBM arena; consolidation's")
+print("\nall four modes answer from the same HBM arena; consolidation's")
 print("dedup/link thresholds always use the exact master (exact=True).")
