@@ -885,7 +885,12 @@ def main():
     # a measured side variant; BENCH_REFDEFAULT=0 skips (e.g. ingest-only
     # prebuild runs).
     ref_default = None
-    if os.environ.get("BENCH_REFDEFAULT", "1") != "0":
+    if _degraded_error and os.environ.get("BENCH_REFDEFAULT", "") == "":
+        # a degraded (tunnel-down) run must fit whatever window the driver
+        # gives it — the side stages' numbers are captured separately by
+        # forced-CPU / watcher runs into bench_artifacts/
+        ref_default = {"skipped": "degraded-cpu fallback; see bench_artifacts/"}
+    elif os.environ.get("BENCH_REFDEFAULT", "1") != "0":
         print("[bench] reference-default stage starting", file=sys.stderr,
               flush=True)
         t0 = time.perf_counter()
@@ -897,7 +902,9 @@ def main():
 
     # 1k-tenant serving stage (BASELINE configs[1]); BENCH_TENANTS=0 skips.
     tenants = None
-    if os.environ.get("BENCH_TENANTS", "1") != "0":
+    if _degraded_error and os.environ.get("BENCH_TENANTS", "") == "":
+        tenants = {"skipped": "degraded-cpu fallback; see bench_artifacts/"}
+    elif os.environ.get("BENCH_TENANTS", "1") != "0":
         print("[bench] multi-tenant stage starting", file=sys.stderr,
               flush=True)
         t0 = time.perf_counter()
