@@ -124,9 +124,10 @@ def test_concurrent_serving_modes_during_async_ingest(tmp_path):
     ms = MemorySystem(enable_async=True, db_dir=str(tmp_path / "db"),
                       verbose=False, load_from_disk=False,
                       config=MemoryConfig(journal=False, int8_serving=True,
-                                          ivf_serving=4))
-    # force the IVF hooks live even though the arena is tiny: build won't
-    # trigger (below _IVF_MIN_ROWS) but the fresh/routed bookkeeping runs
+                                          ivf_serving=4, pq_serving=True))
+    # force the IVF/PQ hooks live even though the arena is tiny: build
+    # won't trigger (below _IVF_MIN_ROWS) but the fresh/routed/pack
+    # bookkeeping runs
     errors = []
     stop = threading.Event()
 
