@@ -179,6 +179,10 @@ def normalize(x: jax.Array) -> jax.Array:
     return (x.astype(jnp.float32) / jnp.maximum(n, 1e-9)).astype(x.dtype)
 
 
+from lazzaro_tpu.ops.chunking import nt_dot  # noqa: E402  (re-export: scans
+#                                              score through this helper)
+
+
 @jax.jit
 def arena_add(
     state: ArenaState,
@@ -354,8 +358,7 @@ def arena_search(
         top_scores, top_rows = masked_topk_arena(state.emb, mask, q, k)
     else:
         def chunk(q_c):
-            scores = jnp.dot(q_c, state.emb.T,
-                             preferred_element_type=jnp.float32)  # [C, cap+1]
+            scores = nt_dot(q_c, state.emb)                       # [C, cap+1]
             return jax.lax.top_k(jnp.where(mask[None, :], scores, NEG_INF), k)
 
         # Big query fleets stream through [512, cap+1] tiles inside ONE
@@ -397,8 +400,7 @@ def arena_link_candidates_multi(
 
     def chunk(rows_c):
         q = state.emb[rows_c]                     # [C, d]
-        scores = jnp.dot(q, state.emb.T,
-                         preferred_element_type=jnp.float32)  # [C, cap+1]
+        scores = nt_dot(q, state.emb)             # [C, cap+1]
         same = None
         outs = []
         for sm in shard_modes:

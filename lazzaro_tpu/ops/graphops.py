@@ -14,7 +14,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from lazzaro_tpu.ops.chunking import chunked_map
+from lazzaro_tpu.ops.chunking import chunked_map, nt_dot
 
 
 @functools.partial(jax.jit, static_argnames=("num_nodes",))
@@ -102,7 +102,7 @@ def pairwise_merge_candidates(emb: jax.Array, mask: jax.Array,
 
     def one_chunk(rows):
         q = emb[rows]
-        scores = jnp.dot(q, emb.T, preferred_element_type=jnp.float32)
+        scores = nt_dot(q, emb)
         upper = col[None, :] > rows[:, None]     # only j > i, no self-pairs
         valid = mask[rows][:, None] & mask[None, :] & upper
         scores = jnp.where(valid, scores, -jnp.inf)
