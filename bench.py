@@ -498,8 +498,12 @@ def bench_llm_loop(on_tpu: bool):
     from lazzaro_tpu.core.providers import OnDeviceLLM
     from lazzaro_tpu.models.llm import LanguageModel, LMConfig
 
-    geometry = os.environ.get("BENCH_LLM_GEOMETRY",
-                              "base2b" if on_tpu else "small")
+    # Default geometry is the compile-cheap "small" even on TPU: the
+    # driver's window must survive this stage, and a fresh process has no
+    # persistent XLA cache — a 2B first-compile through the tunnel can eat
+    # tens of minutes. The watcher's long-budget rung opts into base2b via
+    # BENCH_LLM_GEOMETRY explicitly.
+    geometry = os.environ.get("BENCH_LLM_GEOMETRY", "small")
     cfg = getattr(LMConfig, geometry)()
     lm = LanguageModel(cfg, seed=0)
 

@@ -148,7 +148,7 @@ RUNGS = [
      [sys.executable, "bench.py"],
      {"BENCH_WORKDIR": WORKDIR, "BENCH_INGEST_BUDGET_S": "4000",
       "BENCH_LLM_LOOP": "1", "BENCH_CONSOLIDATE": "1",
-      "BENCH_REFDEFAULT": "1"},
+      "BENCH_REFDEFAULT": "1", "BENCH_LLM_GEOMETRY": "base2b"},
      150 * 60,
      lambda: ingest_complete() and not other_bench_running()),
 ]
