@@ -25,8 +25,10 @@ NEG_INF = -1e30
 def masked_topk(emb: jax.Array, mask: jax.Array, query: jax.Array, k: int
                 ) -> Tuple[jax.Array, jax.Array]:
     """Single-device masked cosine top-k. emb rows must be L2-normalized."""
+    from lazzaro_tpu.ops.chunking import nt_dot
+
     q = jnp.atleast_2d(query).astype(emb.dtype)
-    scores = (q @ emb.T).astype(jnp.float32)
+    scores = nt_dot(q, emb)
     scores = jnp.where(mask[None, :], scores, NEG_INF)
     top_s, top_i = jax.lax.top_k(scores, k)
     if query.ndim == 1:
@@ -75,7 +77,8 @@ def make_sharded_topk(mesh: Mesh, axis: str = "data", k: int = 10,
             return pallas_masked_topk(emb_l, madd, query.astype(emb_l.dtype),
                                       k=k_eff, block_rows=blk,
                                       interpret=not on_tpu)
-        scores = (query.astype(emb_l.dtype) @ emb_l.T).astype(jnp.float32)
+        from lazzaro_tpu.ops.chunking import nt_dot
+        scores = nt_dot(query.astype(emb_l.dtype), emb_l)
         scores = jnp.where(mask_l[None, :], scores, NEG_INF)
         return jax.lax.top_k(scores, k_eff)
 
