@@ -295,14 +295,17 @@ def bench_kernels(on_tpu: bool):
     n_rows = -(-(N + 1) // S.TOPK_BLOCK) * S.TOPK_BLOCK  # arena alignment rule
     key = jax.random.PRNGKey(0)
     emb = S.normalize(jax.random.normal(key, (n_rows, DIM), jnp.bfloat16))
-    zeros_i = jnp.zeros((n_rows,), jnp.int32)
+    # one DISTINCT buffer per column (donated kernels reject a pytree that
+    # aliases the same buffer across leaves — init_arena's contract)
     arena = S.ArenaState(
         emb=emb,
         salience=jnp.full((n_rows,), 0.5, jnp.float32),
         timestamp=jnp.zeros((n_rows,), jnp.float32),
         last_accessed=jnp.zeros((n_rows,), jnp.float32),
-        access_count=zeros_i, type_id=zeros_i, shard_id=zeros_i,
-        tenant_id=zeros_i,
+        access_count=jnp.zeros((n_rows,), jnp.int32),
+        type_id=jnp.zeros((n_rows,), jnp.int32),
+        shard_id=jnp.zeros((n_rows,), jnp.int32),
+        tenant_id=jnp.zeros((n_rows,), jnp.int32),
         alive=jnp.ones((n_rows,), bool).at[N:].set(False),
         is_super=jnp.zeros((n_rows,), bool),
     )
@@ -365,10 +368,21 @@ def bench_kernels(on_tpu: bool):
     args = (jnp.full((B,), 0.5), jnp.zeros((B,)), jnp.zeros((B,), jnp.int32),
             jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
             jnp.zeros((B,), bool))
-    a2 = S.arena_add(arena, rows, add_emb, *args)
+    reps = 20
+    # A/B the donation win: the copying twin first (XLA copies the full
+    # arena per scatter — the pre-donation behavior), then the donated
+    # default (in-place alias; the chain threads ownership forward).
+    a_copy = S.arena_add_copy(arena, rows, add_emb, *args)
+    np.asarray(a_copy.emb[:2])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        a_copy = S.arena_add_copy(a_copy, rows, add_emb, *args)
+    np.asarray(a_copy.emb[:2])           # forced sync closes the timed region
+    scatter_copy_rows = reps * B / (time.perf_counter() - t0)
+    del a_copy
+    a2 = S.arena_add(arena, rows, add_emb, *args)   # consumes `arena`
     np.asarray(a2.emb[:2])
     t0 = time.perf_counter()
-    reps = 20
     for _ in range(reps):
         a2 = S.arena_add(a2, rows, add_emb, *args)
     np.asarray(a2.emb[:2])               # forced sync closes the timed region
@@ -376,7 +390,41 @@ def bench_kernels(on_tpu: bool):
     del arena, a2, emb
     p50s = {impl: float(np.percentile(l, 50)) for impl, l in lat_by_impl.items()}
     p50s["int8"] = int8_p50
-    return p50s, batch64_ms, int8_batch64_ms, n_rows, scatter_rows
+    return (p50s, batch64_ms, int8_batch64_ms, n_rows, scatter_rows,
+            scatter_copy_rows)
+
+
+def bench_fused_ingest(on_tpu: bool):
+    """Fused single-dispatch ingest rate: batches of B facts through
+    ``MemoryIndex.ingest_batch`` — node scatter + dedup merge touch +
+    two-mode link scan + gated edge insert, ONE donated dispatch + ONE
+    packed readback per batch. Timed to the readback inside ingest_batch
+    (its host decode runs after fetch_packed), honest by construction."""
+    from lazzaro_tpu.core.index import MemoryIndex
+
+    n_rows = min(N, 65_536)
+    B = 1024
+    reps = 3
+    rng = np.random.default_rng(17)
+    idx = MemoryIndex(dim=DIM, capacity=n_rows + 64,
+                      edge_capacity=65_535, dtype=jnp.bfloat16)
+
+    def batch(c):
+        emb = rng.standard_normal((B, DIM)).astype(np.float32)
+        ids = [f"f{c}_{i}" for i in range(B)]
+        chains = list(zip(ids, ids[1:]))
+        return ids, emb, chains
+
+    def run(c):
+        ids, emb, chains = batch(c)
+        idx.ingest_batch(ids, emb, [0.5] * B, [0.0] * B, ["semantic"] * B,
+                         ["default"] * B, "u0", chain_pairs=chains)
+
+    run(0)                               # warm/compile outside the timer
+    t0 = time.perf_counter()
+    for c in range(1, reps + 1):
+        run(c)
+    return reps * B / (time.perf_counter() - t0)
 
 
 def bench_reference_default(on_tpu: bool):
@@ -896,7 +944,13 @@ def main():
 
     t_kernel_phase = time.perf_counter()
     (kernel_p50s, batch64_ms, int8_batch64_ms, kernel_rows,
-     scatter_rows) = bench_kernels(on_tpu)
+     scatter_rows, scatter_copy_rows) = bench_kernels(on_tpu)
+    try:
+        fused_ingest_rate = bench_fused_ingest(on_tpu)
+    except Exception as e:   # a failed extra stage must not void the run
+        print(f"[bench] fused-ingest stage failed: {e}", file=sys.stderr,
+              flush=True)
+        fused_ingest_rate = None
     t_kernel_phase = time.perf_counter() - t_kernel_phase
 
     # Reference-default configuration (hierarchy + auto-consolidate ON) as
@@ -1013,7 +1067,16 @@ def main():
             "arena_search_batch64_ms": round(batch64_ms, 4),
             "arena_search_int8_p50_ms": round(kernel_p50s["int8"], 4),
             "arena_search_int8_batch64_ms": round(int8_batch64_ms, 4),
+            # donated (in-place) scatter vs the pre-donation copying twin —
+            # the zero-copy win, tracked per round:
             "arena_scatter_rows_per_sec": round(scatter_rows, 1),
+            "arena_scatter_donated_rows_per_sec": round(scatter_rows, 1),
+            "arena_scatter_copy_rows_per_sec": round(scatter_copy_rows, 1),
+            # fused single-dispatch ingest (scatter + merge touch + 2-mode
+            # link scan + gated edge insert per 1024-fact batch):
+            "ingest_fused_memories_per_sec_per_chip": (
+                round(fused_ingest_rate, 1)
+                if fused_ingest_rate is not None else None),
             "roofline": rl,
             "phase_s": {"ingest": round(t_ingest, 1),
                         "search": round(t_search_phase, 1),

@@ -40,6 +40,16 @@ class MemoryConfig:
     # re-scored exactly from the master, so returned scores stay exact.
     # No effect without ivf_serving.
     pq_serving: bool = False
+    # Fused single-dispatch ingest (core/state.py ingest_fused): the
+    # per-conversation mutation sequence (node scatter, dedup merge touch,
+    # two-mode link scan, gated edge insert) runs as ONE donated device
+    # program + ONE packed readback. Off = the classic four-dispatch
+    # sequence (debug/fallback; semantics are identical).
+    ingest_fused: bool = True
+    # Cross-conversation ingest coalescing cap (utils/batching.py
+    # IngestCoalescer): facts from every buffered conversation merge into
+    # mega-batches of at most this many rows per fused dispatch.
+    ingest_coalesce_max: int = 8192
 
     # --- behavior flags (parity with memory_system.py:63-84) ---------------
     enable_sharding: bool = True

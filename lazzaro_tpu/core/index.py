@@ -14,6 +14,8 @@ device; host transfers are bulk and infrequent.
 
 from __future__ import annotations
 
+import sys
+import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -100,6 +102,10 @@ class MemoryIndex:
             from jax.sharding import NamedSharding, PartitionSpec as P
             self._row_sharding = NamedSharding(mesh, P(shard_axis))
             self._mat_sharding = NamedSharding(mesh, P(shard_axis, None))
+        # Zero-copy mutation gate (see _apply_arena): readers snapshot the
+        # state under this lock, writers check sole ownership and dispatch
+        # under it. Never held across a device readback.
+        self._state_lock = threading.RLock()
         # Timestamps are stored relative to this epoch so f32 keeps sub-second
         # precision (raw unix seconds ~1.7e9 would quantize to ~2 minutes).
         self.epoch = float(epoch if epoch is not None else time.time())
@@ -128,14 +134,36 @@ class MemoryIndex:
     def _ivf(self, v) -> None:
         # Drop ALL per-build state — the residual cache in particular pins
         # the members table and the padded device residual, so leaving it
-        # would defeat the setter's freeing purpose.
+        # would defeat the setter's freeing purpose. A non-None assignment
+        # reconstructs the routed/in-residual bitmaps from the build
+        # (ADVICE r5: leaving them None loses the "never append the same
+        # row twice" guard, so repeated add()s of routed rows would grow
+        # the fresh residual with duplicates).
         self._ivf_res_cache = None
-        self._ivf_routed = None
-        self._ivf_in_residual = None
         self._ivf_stale = 0
         self._pq_pack = None
         self._pq_dirty = True
-        self._ivf_pack = None if v is None else (v, ())
+        if v is None:
+            self._ivf_routed = None
+            self._ivf_in_residual = None
+            self._ivf_pack = None
+            return
+        self._ivf_routed, self._ivf_in_residual = self._routed_bitmaps(v)
+        self._ivf_pack = (v, ())
+
+    def _routed_bitmaps(self, ivf) -> Tuple[np.ndarray, np.ndarray]:
+        """(routed, in_sealed_residual) bool bitmaps over arena rows for a
+        build — the writer-side bookkeeping ``ivf_maintenance`` and the
+        ``_ivf`` compat setter both publish."""
+        n = self.state.emb.shape[0]
+        routed = np.zeros((n,), bool)
+        m = np.asarray(ivf.members).ravel()
+        routed[m[(m >= 0) & (m < n)]] = True
+        r = np.asarray(ivf.residual)
+        in_res = np.zeros((n,), bool)
+        in_res[r[(r >= 0) & (r < n)]] = True
+        routed |= in_res
+        return routed, in_res
 
     @property
     def _ivf_fresh(self) -> List[int]:
@@ -201,7 +229,12 @@ class MemoryIndex:
 
     @property
     def state(self) -> S.ArenaState:
-        return self._state
+        # The lock makes the snapshot atomic w.r.t. the donation gate: a
+        # reader either raises the refcount BEFORE a writer's ownership
+        # check (forcing the copying kernel) or blocks for the few µs of
+        # the dispatch and sees the new state — never a donated-dead one.
+        with self._state_lock:
+            return self._state
 
     @state.setter
     def state(self, s: S.ArenaState) -> None:
@@ -209,11 +242,58 @@ class MemoryIndex:
 
     @property
     def edge_state(self) -> S.EdgeState:
-        return self._edge_state
+        with self._state_lock:
+            return self._edge_state
 
     @edge_state.setter
     def edge_state(self, s: S.EdgeState) -> None:
         self._edge_state = s if self.mesh is None else self._reshard(s)
+
+    # ------------------------------------------------- zero-copy mutations
+    # Mutation kernels donate their input state (core/state.py) so XLA
+    # scatters in place instead of copying the full HBM arena per small
+    # write. Donation deletes EVERY live reference to the old buffers, so
+    # the writer must prove it holds the only one: under _state_lock it
+    # counts the references to the state pytree and falls back to the
+    # non-donating ``*_copy`` twin whenever a concurrent reader (search /
+    # sweep / checkpoint snapshot) still holds it. Single-writer hot paths
+    # therefore run zero-copy; racing readers cost one classic copy.
+    #
+    # References to the pytree at the gate when this index is the sole
+    # owner: the ``_state`` attribute, the ``cur`` local, and
+    # ``sys.getrefcount``'s own argument.
+    _SOLE_REFS = 3
+
+    def _apply_arena(self, donated, copying, *args, **kwargs) -> None:
+        with self._state_lock:
+            cur = self._state
+            fn = donated if sys.getrefcount(cur) <= self._SOLE_REFS else copying
+            out = fn(cur, *args, **kwargs)
+            del cur
+            self.state = out
+
+    def _apply_edges(self, donated, copying, *args, **kwargs) -> None:
+        with self._state_lock:
+            cur = self._edge_state
+            fn = donated if sys.getrefcount(cur) <= self._SOLE_REFS else copying
+            out = fn(cur, *args, **kwargs)
+            del cur
+            self.edge_state = out
+
+    def _apply_fused(self, *args, **kwargs):
+        """Dispatch ``S.ingest_fused`` over BOTH states, donating only when
+        this index holds the sole reference to each; returns the kernel's
+        non-state outputs (the per-mode link triples)."""
+        with self._state_lock:
+            arena, edges = self._state, self._edge_state
+            sole = (sys.getrefcount(arena) <= self._SOLE_REFS
+                    and sys.getrefcount(edges) <= self._SOLE_REFS)
+            fn = S.ingest_fused if sole else S.ingest_fused_copy
+            new_arena, new_edges, link_flat = fn(arena, edges, *args, **kwargs)
+            del arena, edges
+            self.state = new_arena
+            self.edge_state = new_edges
+        return link_flat
 
     # ------------------------------------------------------------------ ids
     def tenant_id(self, name: str) -> int:
@@ -303,8 +383,8 @@ class MemoryIndex:
 
         tid = self.tenant_id(tenant)
         self.tenant_nodes.setdefault(tenant, set()).update(ids)
-        self.state = S.arena_add(
-            self.state,
+        self._apply_arena(
+            S.arena_add, S.arena_add_copy,
             jnp.asarray(padded),
             jnp.asarray(emb),
             jnp.asarray(pad([float(s) for s in saliences])),
@@ -316,28 +396,199 @@ class MemoryIndex:
         )
         self._int8_dirty = True            # emb rows written
         self._pq_dirty = True
-        pack = self._ivf_pack
-        if self.ivf_nprobe and pack is not None:
-            ivf, ivf_fresh = pack
-            routed = self._ivf_routed
-            if routed is not None and len(routed) < self.state.emb.shape[0]:
-                # arena grew since the build: extend the routed bitmap so
-                # grown rows can be marked and never double-append to the
-                # residual (duplicate rows would surface twice in one top-k)
-                grown = np.zeros((self.state.emb.shape[0],), bool)
-                grown[:len(routed)] = routed
-                self._ivf_routed = routed = grown
-            appended = []
-            for r in rows:
-                if routed is None or not routed[r]:
-                    appended.append(r)
-                    if routed is not None:
-                        routed[r] = True   # never append the same row twice
-            if appended:
-                # ONE tuple swap: a concurrent reader sees either the old
-                # or the new (build, fresh) pair, never a torn mix
-                self._ivf_pack = (ivf, ivf_fresh + tuple(appended))
+        self._ivf_note_added(rows)
         return rows
+
+    def _ivf_note_added(self, rows: Sequence[int]) -> None:
+        """Record freshly-written rows in the fresh residual (shared by
+        ``add`` and the fused ingest path)."""
+        pack = self._ivf_pack
+        if not self.ivf_nprobe or pack is None:
+            return
+        ivf, ivf_fresh = pack
+        routed = self._ivf_routed
+        if routed is not None and len(routed) < self.state.emb.shape[0]:
+            # arena grew since the build: extend the routed bitmap so
+            # grown rows can be marked and never double-append to the
+            # residual (duplicate rows would surface twice in one top-k)
+            grown = np.zeros((self.state.emb.shape[0],), bool)
+            grown[:len(routed)] = routed
+            self._ivf_routed = routed = grown
+        appended = []
+        for r in rows:
+            if routed is None or not routed[r]:
+                appended.append(r)
+                if routed is not None:
+                    routed[r] = True       # never append the same row twice
+        if appended:
+            # ONE tuple swap: a concurrent reader sees either the old
+            # or the new (build, fresh) pair, never a torn mix
+            self._ivf_pack = (ivf, ivf_fresh + tuple(appended))
+
+    def ingest_batch(self, ids: Sequence[str], embeddings: np.ndarray,
+                     saliences: Sequence[float], timestamps: Sequence[float],
+                     types: Sequence[str], shard_keys: Sequence[str],
+                     tenant: str, is_super: Optional[Sequence[bool]] = None,
+                     merge_ids: Sequence[str] = (),
+                     merge_saliences: Sequence[float] = (),
+                     chain_pairs: Sequence[Tuple[str, str]] = (),
+                     chain_weight: float = 0.5,
+                     link_k: int = 3, link_gate: float = 0.5,
+                     link_scale: float = 0.8,
+                     shard_modes: Sequence[int] = (1, 0),
+                     now: Optional[float] = None):
+        """Fused zero-copy conversation ingest: insert ``ids``, merge-touch
+        ``merge_ids``, link-scan every new row per shard mode, and insert
+        the chain edges plus every gate-passing similarity edge — ONE
+        donated device dispatch plus ONE packed readback (the unfused
+        sequence pays four dispatches and the same readback).
+
+        Edge slots are pre-allocated for every potential link; the device
+        writes the gate verdict per slot and the host reclaims rejected
+        ones after the readback. ``ids`` should be fresh (the consolidation
+        contract) — a (src, tgt) link key that already exists is skipped
+        host-side defensively, but its pre-written slot is only reclaimed,
+        not cleared, until the next write lands on it.
+
+        Returns ``(rows, candidates, created)``:
+          rows        — arena rows of ``ids``, insert order
+          candidates  — {mode: {id: [(cand_id, score), ...]}} — the full
+                        (ungated) lists, same shape as
+                        ``link_candidates_multi``
+          created     — {mode: [(src_id, tgt_id, weight), ...]} edges the
+                        device inserted, already registered in
+                        ``edge_slots`` (chain edges are registered too but
+                        reported by the caller's own list, not here)
+        """
+        n = len(ids)
+        shard_modes = tuple(shard_modes)
+        if n == 0:
+            if merge_ids:
+                self.merge_touch(merge_ids, merge_saliences, now)
+            return [], {sm: {} for sm in shard_modes}, {sm: [] for sm in shard_modes}
+        if is_super is None:
+            is_super = [False] * n
+        rows: List[int] = []
+        fresh_needed = sum(1 for i in ids if i not in self.id_to_row)
+        fresh = self._alloc_rows(fresh_needed)
+        fi = 0
+        for node_id in ids:
+            if node_id in self.id_to_row:
+                rows.append(self.id_to_row[node_id])
+            else:
+                r = fresh[fi]; fi += 1
+                self.id_to_row[node_id] = r
+                self.row_to_id[r] = node_id
+                rows.append(r)
+        tid = self.tenant_id(tenant)
+        self.tenant_nodes.setdefault(tenant, set()).update(ids)
+
+        t_rows, t_sals = [], []
+        for mid, msal in zip(merge_ids, merge_saliences):
+            r = self.id_to_row.get(mid)
+            if r is not None:
+                t_rows.append(r)
+                t_sals.append(float(msal))
+
+        # One up-front slot allocation: chains + every potential gated link.
+        # Growth (if any) happens HERE, before sentinel indices are baked
+        # into the padded arrays below.
+        k_eff = min(link_k, self.state.capacity)
+        n_modes = len(shard_modes)
+        chain_keys = [(s, t) for s, t in chain_pairs
+                      if s in self.id_to_row and t in self.id_to_row]
+        slots = self._alloc_edge_slots(len(chain_keys) + n_modes * n * k_eff)
+        chain_slot_list = slots[:len(chain_keys)]
+        link_slot_list = slots[len(chain_keys):]
+
+        cap = self.state.capacity
+        ecap = self.edge_state.capacity
+        padded = S.pad_rows(np.asarray(rows, np.int32), cap)
+        b = len(padded)
+
+        def pad(vals, fill=0.0, dt=np.float32):
+            out = np.full((b,), fill, dt)
+            out[:n] = vals
+            return out
+
+        emb = np.zeros((b, self.dim), np.float32)
+        emb[:n] = np.asarray(embeddings, np.float32).reshape(n, self.dim)
+        emb[n:, 0] = 1.0  # sentinel rows get a unit vector (normalizable)
+
+        touch_padded = S.pad_rows(np.asarray(t_rows, np.int32), cap)
+        touch_sal = np.zeros((len(touch_padded),), np.float32)
+        touch_sal[:len(t_sals)] = t_sals
+
+        c_padded = S.pad_rows(np.asarray(chain_slot_list, np.int32), ecap)
+        cb = len(c_padded)
+        c_src = np.full((cb,), -1, np.int32)
+        c_tgt = np.full((cb,), -1, np.int32)
+        c_w = np.zeros((cb,), np.float32)
+        for i, (s, t) in enumerate(chain_keys):
+            c_src[i] = self.id_to_row[s]
+            c_tgt[i] = self.id_to_row[t]
+            c_w[i] = chain_weight
+        link_slots = np.full((n_modes, b, k_eff), ecap, np.int32)
+        link_slots_real = np.asarray(link_slot_list, np.int32
+                                     ).reshape(n_modes, n, k_eff)
+        link_slots[:, :n, :] = link_slots_real
+
+        now_rel = (now if now is not None else time.time()) - self.epoch
+        link_flat = self._apply_fused(
+            jnp.asarray(padded), jnp.asarray(emb),
+            jnp.asarray(pad([float(s) for s in saliences])),
+            jnp.asarray(pad([float(t) - self.epoch for t in timestamps])),
+            jnp.asarray(pad([S.TYPE_IDS.get(t, 0) for t in types], 0, np.int32)),
+            jnp.asarray(pad([self.shard_id(sk or "default")
+                             for sk in shard_keys], -1, np.int32)),
+            jnp.asarray(pad([tid] * n, -1, np.int32)),
+            jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
+            jnp.asarray(touch_padded), jnp.asarray(touch_sal),
+            jnp.asarray(c_padded), jnp.asarray(c_src), jnp.asarray(c_tgt),
+            jnp.asarray(c_w), jnp.asarray(link_slots),
+            jnp.float32(now_rel), jnp.int32(tid),
+            jnp.float32(link_gate), jnp.float32(link_scale),
+            k=k_eff, shard_modes=shard_modes)
+        self._int8_dirty = True
+        self._pq_dirty = True
+        self._ivf_note_added(rows)
+
+        host = fetch_packed(*link_flat)        # the ONE readback
+        candidates: Dict[int, Dict[str, List[Tuple[str, float]]]] = {}
+        created: Dict[int, List[Tuple[str, str, float]]] = {}
+        reclaim: List[int] = []
+        for mi, sm in enumerate(shard_modes):
+            sc, cd, lv = host[3 * mi], host[3 * mi + 1], host[3 * mi + 2]
+            out_m: Dict[str, List[Tuple[str, float]]] = {}
+            made: List[Tuple[str, str, float]] = []
+            for bi in range(n):
+                nid = ids[bi]
+                pairs = []
+                for j in range(k_eff):
+                    slot = int(link_slots_real[mi, bi, j])
+                    s = float(sc[bi, j])
+                    cid = (self.row_to_id.get(int(cd[bi, j]))
+                           if s > S.NEG_INF / 2 else None)
+                    if cid is not None:
+                        pairs.append((cid, s))
+                    key = (nid, cid)
+                    if lv[bi, j] > 0.5 and cid is not None \
+                            and key not in self.edge_slots:
+                        self.edge_slots[key] = slot
+                        made.append((nid, cid,
+                                     min(1.0, max(0.0, s * link_scale))))
+                    else:
+                        reclaim.append(slot)
+                out_m[nid] = pairs
+            candidates[sm] = out_m
+            created[sm] = made
+        for key, slot in zip(chain_keys, chain_slot_list):
+            if key in self.edge_slots:         # defensive: shouldn't happen
+                reclaim.append(slot)
+            else:
+                self.edge_slots[key] = slot
+        self._free_edge_slots.extend(reclaim)
+        return rows, candidates, created
 
     def delete(self, ids: Iterable[str]) -> None:
         ids = list(ids)
@@ -349,8 +600,10 @@ class MemoryIndex:
         for r in rows:
             self.row_to_id.pop(r, None)
         padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
-        self.state = S.arena_delete(self.state, jnp.asarray(padded))
-        self.edge_state = S.edges_delete_for_nodes(self.edge_state, jnp.asarray(padded))
+        self._apply_arena(S.arena_delete, S.arena_delete_copy,
+                          jnp.asarray(padded))
+        self._apply_edges(S.edges_delete_for_nodes,
+                          S.edges_delete_for_nodes_copy, jnp.asarray(padded))
         self._free_rows.extend(rows)
         routed = self._ivf_routed
         if routed is not None:
@@ -428,8 +681,9 @@ class MemoryIndex:
             got = self._ivf_search(q_pad, tid, k_eff, super_filter)
             if got is not None:
                 h_scores, h_rows = got
+                # the device over-fetched k + slack; trim after dedup
                 return decode_topk(h_scores[:nq], h_rows[:nq],
-                                   self.row_to_id, S.NEG_INF)
+                                   self.row_to_id, S.NEG_INF, limit=k_eff)
         if self.mesh is None and self.int8_serving and not exact:
             from lazzaro_tpu.ops.quant import quantized_topk
 
@@ -470,6 +724,10 @@ class MemoryIndex:
     # Below this many live rows an exact scan is trivially cheap and a
     # k-means build would be pure overhead.
     _IVF_MIN_ROWS = 4096
+    # Device top-k over-fetch on the IVF serving path: a reused slot can sit
+    # in both a stale member slot and the residual, and the host-side dedup
+    # in decode_topk would otherwise shrink the result below k (ADVICE r5).
+    _IVF_K_SLACK = 8
 
     def _ivf_search(self, q_pad, tid: int, k_eff: int, super_filter: int):
         """Coarse-to-fine serving scan, or None to fall through to the
@@ -495,6 +753,10 @@ class MemoryIndex:
                   + residual.shape[0])
         if n_cand < k_eff:
             return None
+        # Over-fetch slack: duplicates (reused slot in a stale member slot
+        # AND the residual) consume device top-k positions; the host dedup
+        # then trims back to k without a shortfall.
+        k_fetch = min(k_eff + self._IVF_K_SLACK, n_cand)
         mask = S.arena_mask(st, jnp.int32(tid), super_filter)
         pq_pack = self._pq_pack
         if self.pq_serving and pq_pack is not None:
@@ -503,12 +765,12 @@ class MemoryIndex:
             codes = self._pq_codes_for(st, pq_pack)
             scores, rows = ivf_pq_search(
                 ivf.centroids, ivf.members, residual, pq_pack[0].centroids,
-                codes, st.emb, mask, S.normalize(q_pad), k_eff,
+                codes, st.emb, mask, S.normalize(q_pad), k_fetch,
                 nprobe=self.ivf_nprobe, r=max(4 * k_eff, 64))
         else:
             scores, rows = ivf_search(ivf.centroids, ivf.members, residual,
                                       st.emb, mask, S.normalize(q_pad),
-                                      k_eff, nprobe=self.ivf_nprobe)
+                                      k_fetch, nprobe=self.ivf_nprobe)
         return fetch_packed(scores, rows)      # ONE readback RTT
 
     def ivf_maintenance(self) -> bool:
@@ -534,13 +796,7 @@ class MemoryIndex:
         st = self.state
         mask_np = np.asarray(st.alive)
         ivf = build_ivf(st.emb, mask_np)
-        routed = np.zeros((st.emb.shape[0],), bool)
-        m = np.asarray(ivf.members).ravel()
-        routed[m[m >= 0]] = True
-        r = np.asarray(ivf.residual)
-        in_res = np.zeros((st.emb.shape[0],), bool)
-        in_res[r[r >= 0]] = True
-        routed |= in_res
+        routed, in_res = self._routed_bitmaps(ivf)
         # writer-side bookkeeping first, the reader-visible pack LAST — a
         # reader can only ever observe a fully-initialized build
         self._ivf_routed = routed
@@ -580,11 +836,14 @@ class MemoryIndex:
     def _ivf_residual_dev(self, ivf, fresh):
         """Sealed-build residual + fresh rows as one padded device array,
         re-uploaded only when the (build, fresh) snapshot changed. Cache
-        validity is keyed on the build object identity (pinned by the cache
-        tuple itself) + fresh length, so a rebuild can never serve the old
-        residual against the new member table."""
+        validity is keyed on the IDENTITY of both the build object and the
+        immutable fresh tuple (writers replace the tuple, never mutate it),
+        so a rebuild can never serve the old residual against the new
+        member table — and a delete + re-add that lands in a DIFFERENT
+        freed slot (same fresh length, different contents; ADVICE r5 high)
+        can never serve a stale residual that silently drops the live row."""
         cache = self._ivf_res_cache
-        if cache is not None and cache[0] is ivf and cache[1] == len(fresh):
+        if cache is not None and cache[0] is ivf and cache[1] is fresh:
             return cache[2]
         from lazzaro_tpu.ops.ivf import _pow2
 
@@ -594,7 +853,7 @@ class MemoryIndex:
         padded = np.full((_pow2(len(comb)),), -1, np.int32)
         padded[:len(comb)] = comb
         dev = jnp.asarray(padded)
-        self._ivf_res_cache = (ivf, len(fresh), dev)
+        self._ivf_res_cache = (ivf, fresh, dev)
         return dev
 
     def _int8_shadow_for(self, st: S.ArenaState):
@@ -638,8 +897,9 @@ class MemoryIndex:
         if not rows:
             return
         padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
-        self.state = S.arena_update_access(
-            self.state, jnp.asarray(padded),
+        self._apply_arena(
+            S.arena_update_access, S.arena_update_access_copy,
+            jnp.asarray(padded),
             jnp.float32((now if now is not None else time.time()) - self.epoch),
             jnp.float32(boost))
 
@@ -650,8 +910,8 @@ class MemoryIndex:
         if not rows:
             return
         padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
-        self.state = S.arena_boost(
-            self.state, jnp.asarray(padded),
+        self._apply_arena(
+            S.arena_boost, S.arena_boost_copy, jnp.asarray(padded),
             jnp.float32((now if now is not None else time.time()) - self.epoch),
             jnp.float32(boost))
 
@@ -674,9 +934,9 @@ class MemoryIndex:
         ac_arr[:len(acs)] = acs
         la_arr = np.zeros((b,), np.float32)
         la_arr[:len(las)] = las
-        self.state = S.arena_restore_access(
-            self.state, jnp.asarray(padded), jnp.asarray(ac_arr),
-            jnp.asarray(la_arr))
+        self._apply_arena(
+            S.arena_restore_access, S.arena_restore_access_copy,
+            jnp.asarray(padded), jnp.asarray(ac_arr), jnp.asarray(la_arr))
 
     def merge_touch(self, ids: Sequence[str], candidate_saliences: Sequence[float],
                     now: Optional[float] = None) -> None:
@@ -691,17 +951,19 @@ class MemoryIndex:
         padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
         sal = np.zeros((len(padded),), np.float32)
         sal[:len(sals)] = sals
-        self.state = S.arena_merge_touch(
-            self.state, jnp.asarray(padded), jnp.asarray(sal),
+        self._apply_arena(
+            S.arena_merge_touch, S.arena_merge_touch_copy,
+            jnp.asarray(padded), jnp.asarray(sal),
             jnp.float32((now if now is not None else time.time()) - self.epoch))
 
     def decay(self, tenant: str, rate: float, salience_floor: float = 0.2) -> None:
         tid = self._tenants.get(tenant)
         if tid is None:
             return
-        self.state = S.arena_decay(self.state, jnp.int32(tid), jnp.float32(rate),
-                                   jnp.float32(salience_floor))
-        self.edge_state = S.edges_decay(self.edge_state, jnp.int32(tid), jnp.float32(rate))
+        self._apply_arena(S.arena_decay, S.arena_decay_copy, jnp.int32(tid),
+                          jnp.float32(rate), jnp.float32(salience_floor))
+        self._apply_edges(S.edges_decay, S.edges_decay_copy, jnp.int32(tid),
+                          jnp.float32(rate))
 
     def evict_candidates(self, tenant: str, k: int, now: Optional[float] = None,
                          weights: Tuple[float, float, float] = (0.5, 0.3, 0.2)
@@ -899,8 +1161,9 @@ class MemoryIndex:
                 tgt_r[i] = self.id_to_row[t_id]
                 w[i] = wt
                 live[i] = True
-            self.edge_state = S.edges_add(
-                self.edge_state, jnp.asarray(padded), jnp.asarray(src_r),
+            self._apply_edges(
+                S.edges_add, S.edges_add_copy,
+                jnp.asarray(padded), jnp.asarray(src_r),
                 jnp.asarray(tgt_r), jnp.asarray(w),
                 jnp.ones((b,), jnp.int32), jnp.float32(now),
                 jnp.int32(self.tenant_id(tenant)), jnp.asarray(live))
@@ -908,16 +1171,21 @@ class MemoryIndex:
             slots = [self.edge_slots[s] if isinstance(s, tuple) else s
                      for s in existing]
             padded = S.pad_rows(np.asarray(slots, np.int32), self.edge_state.capacity)
-            self.edge_state = S.edges_reinforce(
-                self.edge_state, jnp.asarray(padded),
-                jnp.float32(reinforce), jnp.float32(now))
+            self._apply_edges(
+                S.edges_reinforce, S.edges_reinforce_copy,
+                jnp.asarray(padded), jnp.float32(reinforce), jnp.float32(now))
 
     def prune_edges(self, tenant: str, threshold: float) -> List[Tuple[str, str]]:
         tid = self._tenants.get(tenant)
         if tid is None:
             return []
-        self.edge_state, pruned = S.edges_prune(self.edge_state, jnp.int32(tid),
-                                                jnp.float32(threshold))
+        with self._state_lock:
+            cur = self._edge_state
+            fn = (S.edges_prune if sys.getrefcount(cur) <= self._SOLE_REFS
+                  else S.edges_prune_copy)
+            new_state, pruned = fn(cur, jnp.int32(tid), jnp.float32(threshold))
+            del cur
+            self.edge_state = new_state
         pruned = np.asarray(pruned)
         removed = []
         for key, slot in list(self.edge_slots.items()):

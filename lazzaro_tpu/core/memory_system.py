@@ -40,6 +40,7 @@ from lazzaro_tpu.core.providers import (HashingEmbedder, HeuristicLLM,
 from lazzaro_tpu.core.query_cache import QueryCache
 from lazzaro_tpu.core.store import ArrowStore
 from lazzaro_tpu.models.graph import Edge, Node
+from lazzaro_tpu.utils.batching import IngestCoalescer
 
 
 class MemorySystem:
@@ -141,6 +142,10 @@ class MemorySystem:
         self.node_counter = 0
         self.consolidation_queue: List[Dict] = []
         self._inflight_batches: List[Dict] = []   # popped but not yet durable
+        # Cross-conversation fact batcher: extracted facts from every
+        # buffered conversation coalesce into bounded mega-batches, each
+        # ingested by ONE fused device dispatch (cfg.ingest_fused).
+        self._ingest_coalescer = IngestCoalescer(cfg.ingest_coalesce_max)
 
         # Incremental persistence state. Mutation paths record which node
         # ids / edge keys changed since the last save; saves then upsert only
@@ -770,6 +775,25 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
 
         memories = [m for m in memories if isinstance(m, dict)]
         self._log(f"✓ Extracted {len(memories)} memory candidates")
+        # Cross-conversation coalescing: this extraction already covers
+        # every queued conversation (one LLM call over the drained queue);
+        # the coalescer merges it with anything still buffered and hands
+        # back bounded mega-batches — each ingested by ONE fused dispatch.
+        # A split (huge extraction) is logged, never silent.
+        self._ingest_coalescer.add_conversation(memories)
+        mega_batches = self._ingest_coalescer.drain()
+        if len(mega_batches) > 1:
+            self._log(f"   (ingest split into {len(mega_batches)} mega-"
+                      f"batches of ≤ {self._ingest_coalescer.max_facts} facts)")
+        new_nodes: List[Tuple[str, str]] = []
+        for facts, _n_convs in mega_batches:
+            new_nodes.extend(self._ingest_facts(facts))
+
+        self._finish_consolidation(new_nodes, start_time)
+
+    def _ingest_facts(self, memories: List[Dict]) -> List[Tuple[str, str]]:
+        """Stage, dedup, and ingest one mega-batch of extracted facts;
+        returns the (node_id, shard_key) pairs created."""
         contents = [m.get("content", "") for m in memories if m.get("content")]
         embeddings = self._batch_embed(contents)
         try:
@@ -899,25 +923,53 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                         "decay_pass": self._decay_pass,
                     })
 
-            # ONE arena scatter for every new node, ONE touch for all merges.
+            # ONE arena scatter for every new node, ONE touch for all merges
+            # — and with ingest_fused, the link scan and edge insert ride in
+            # the SAME donated device program.
             arena_new = [(n, e) for n, e in zip(created, created_embs)
                          if e.size == self.embed_dim]
             # stacked once, shared by the arena scatter AND the store write
             emb_matrix = (np.stack([e for _, e in arena_new])
                           if arena_new else None)
-            if arena_new:
-                self.index.add(
-                    [self._q(n.id) for n, _ in arena_new],
-                    emb_matrix,
-                    [n.salience for n, _ in arena_new],
-                    [n.timestamp for n, _ in arena_new],
-                    [n.type for n, _ in arena_new],
-                    [n.shard_key or "default" for n, _ in arena_new],
-                    self.user_id,
-                    [n.is_super_node for n, _ in arena_new])
-            if merge_ids:
-                self.index.merge_touch([self._q(i) for i in merge_ids],
-                                       merge_sals)
+            chain_edges = self._chain_edges(new_nodes)
+            use_fused = bool(self.config.ingest_fused and arena_new)
+            fused_created = None
+            if use_fused:
+                arena_ids = {n.id for n, _ in arena_new}
+                chain_pairs = [(self._q(e.source), self._q(e.target))
+                               for e in chain_edges
+                               if e.source in arena_ids and e.target in arena_ids]
+                _rows, _cands, fused_created = self.index.ingest_batch(
+                    ids=[self._q(n.id) for n, _ in arena_new],
+                    embeddings=emb_matrix,
+                    saliences=[n.salience for n, _ in arena_new],
+                    timestamps=[n.timestamp for n, _ in arena_new],
+                    types=[n.type for n, _ in arena_new],
+                    shard_keys=[n.shard_key or "default" for n, _ in arena_new],
+                    tenant=self.user_id,
+                    is_super=[n.is_super_node for n, _ in arena_new],
+                    merge_ids=[self._q(i) for i in merge_ids],
+                    merge_saliences=merge_sals,
+                    chain_pairs=chain_pairs,
+                    chain_weight=self.config.chain_link_weight,
+                    link_k=self.config.cross_link_top_k,
+                    link_gate=self.config.link_gate,
+                    link_scale=self.config.link_weight_scale,
+                    shard_modes=(1, 0))
+            else:
+                if arena_new:
+                    self.index.add(
+                        [self._q(n.id) for n, _ in arena_new],
+                        emb_matrix,
+                        [n.salience for n, _ in arena_new],
+                        [n.timestamp for n, _ in arena_new],
+                        [n.type for n, _ in arena_new],
+                        [n.shard_key or "default" for n, _ in arena_new],
+                        self.user_id,
+                        [n.is_super_node for n, _ in arena_new])
+                if merge_ids:
+                    self.index.merge_touch([self._q(i) for i in merge_ids],
+                                           merge_sals)
 
             # Persist fresh nodes: columnar bulk path when the store has it
             # (one flat embedding buffer, no per-row dicts) — ingest hot
@@ -948,14 +1000,34 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             if new_nodes_data:
                 self.store.add_nodes(new_nodes_data, user_id=self.user_id)
 
-            # Both link scans (same-shard + any-shard) in one round trip.
-            link_cands = self.index.link_candidates_multi(
-                [self._q(n) for n, _ in new_nodes], self.user_id,
-                k=self.config.cross_link_top_k,
-                shard_modes=(1, 0)) if new_nodes else {1: {}, 0: {}}
-            self._link_within_shards(new_nodes, link_cands[1])
-            self._link_to_existing_memories(new_nodes, link_cands[0])
+            if use_fused:
+                # The device already inserted every chain + gate-passing
+                # link edge inside the fused dispatch; only the host
+                # bookkeeping (shard placement, Edge objects, dirty marks)
+                # runs here — no second device round trip.
+                def _unq(qid: str) -> str:
+                    return qid.partition(":")[2]
 
+                sim_edges = [Edge(source=_unq(s), target=_unq(t), weight=w)
+                             for sm in (1, 0)
+                             for s, t, w in fused_created.get(sm, [])]
+                self._register_edges_host(chain_edges + sim_edges)
+                n_cross = len(fused_created.get(0, []))
+                if n_cross:
+                    self._log(f"✓ Created {n_cross} cross-conversation links")
+            else:
+                # Both link scans (same-shard + any-shard) in one round trip.
+                link_cands = self.index.link_candidates_multi(
+                    [self._q(n) for n, _ in new_nodes], self.user_id,
+                    k=self.config.cross_link_top_k,
+                    shard_modes=(1, 0)) if new_nodes else {1: {}, 0: {}}
+                self._link_within_shards(new_nodes, link_cands[1],
+                                         chain=chain_edges)
+                self._link_to_existing_memories(new_nodes, link_cands[0])
+        return new_nodes
+
+    def _finish_consolidation(self, new_nodes: List[Tuple[str, str]],
+                              start_time: float) -> None:
         self._enforce_buffer_limit()
 
         if self.enable_hierarchy:
@@ -1000,13 +1072,12 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         """Insert into both the host shard record and the edge arena."""
         self._add_edges_batch([edge])
 
-    def _add_edges_batch(self, edges: List[Edge]) -> None:
-        """Host bookkeeping per edge + ONE device scatter for the whole batch
-        (a consolidation creates O(new_facts) links; per-edge dispatches are
-        what made the reference's ingest loop host-bound)."""
-        if not edges:
-            return
-        triples = []
+    def _register_edges_host(self, edges: List[Edge]) -> None:
+        """Host half of edge insertion: shard placement (O(1) via the
+        placement caches), Edge-object bookkeeping, dirty marks, metrics.
+        The DEVICE half happens elsewhere — ``_add_edges_batch`` follows
+        this with ``index.add_edges``; the fused ingest path has already
+        scattered the rows inside its one dispatch."""
         for edge in edges:
             key = (edge.source, edge.target)
             # Existing edge: reinforce it where it lives. New edge: dispatch
@@ -1019,29 +1090,44 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                     shard = self._get_or_create_shard("default")
             shard.add_edge(edge, reinforce=self.config.edge_reinforce)
             self._edge_shard[key] = shard.shard_key
-            triples.append((self._q(edge.source), self._q(edge.target), edge.weight))
             self._mark_edge_dirty(key)
         self.metrics["edges_linked"] += len(edges)
-        self.index.add_edges(triples, self.user_id,
-                             reinforce=self.config.edge_reinforce)
+
+    def _add_edges_batch(self, edges: List[Edge]) -> None:
+        """Host bookkeeping per edge + ONE device scatter for the whole batch
+        (a consolidation creates O(new_facts) links; per-edge dispatches are
+        what made the reference's ingest loop host-bound)."""
+        if not edges:
+            return
+        self._register_edges_host(edges)
+        self.index.add_edges(
+            [(self._q(e.source), self._q(e.target), e.weight) for e in edges],
+            self.user_id, reinforce=self.config.edge_reinforce)
+
+    def _chain_edges(self, new_nodes: List[Tuple[str, str]]) -> List[Edge]:
+        """Consecutive same-shard new nodes chain with w=0.5 (shared by the
+        fused and classic link passes)."""
+        by_shard: Dict[str, List[str]] = {}
+        for node_id, shard_key in new_nodes:
+            by_shard.setdefault(shard_key, []).append(node_id)
+        batch: List[Edge] = []
+        for _shard_key, node_ids in by_shard.items():
+            if len(node_ids) >= 2:
+                for a, b in zip(node_ids, node_ids[1:]):
+                    batch.append(Edge(source=a, target=b,
+                                      weight=self.config.chain_link_weight))
+        return batch
 
     def _link_within_shards(self, new_nodes: List[Tuple[str, str]],
-                            cands: Optional[Dict] = None) -> None:
+                            cands: Optional[Dict] = None,
+                            chain: Optional[List[Edge]] = None) -> None:
         """Chain consecutive new nodes (w=0.5) + top-3 same-shard cosine>0.5
         links (w=sim·0.8). The similarity scan is one batched matmul on the
         arena (replaces hot loop #2, memory_system.py:797-836); the
         consolidation path precomputes ``cands`` via
         ``link_candidates_multi`` so both link passes share one readback."""
-        by_shard: Dict[str, List[str]] = {}
-        for node_id, shard_key in new_nodes:
-            by_shard.setdefault(shard_key, []).append(node_id)
-
-        batch: List[Edge] = []
-        for shard_key, node_ids in by_shard.items():
-            if len(node_ids) >= 2:
-                for a, b in zip(node_ids, node_ids[1:]):
-                    batch.append(Edge(source=a, target=b,
-                                      weight=self.config.chain_link_weight))
+        batch: List[Edge] = list(chain) if chain is not None \
+            else self._chain_edges(new_nodes)
 
         all_new = [nid for nid, _ in new_nodes]
         if not all_new:

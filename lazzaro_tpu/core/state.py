@@ -17,6 +17,33 @@ Design notes (SURVEY §7.1):
   product and retrieval is one matvec + ``lax.top_k``.
 - ``tenant_id`` is a first-class column: multi-tenant isolation is a vectorized
   mask, replacing the reference's per-user SQL filters (``vector_store.py:118``).
+
+State ownership & donation invariants
+-------------------------------------
+Every mutation kernel below ships as a PAIR of jit specializations over one
+impl: the default export (e.g. ``arena_add``) donates its state argument(s)
+so XLA scatters in place — a small write costs the scatter, not a full-arena
+HBM copy (~1.5 GB at 1M×768 bf16) — and a ``*_copy`` twin keeps the classic
+copy-on-write semantics. Donation consumes the input buffers: after a call
+to the donated variant, EVERY live reference to the old state (the pytree
+AND any leaf array pulled out of it) is deleted, and using one raises
+``RuntimeError: Array has been deleted``.
+
+Who may hold a reference to an ``ArenaState``/``EdgeState``:
+- ``MemoryIndex`` owns the live state and is the only durable holder. Its
+  mutation gate (``core/index.py``) donates ONLY when it can prove, under
+  ``_state_lock``, that it holds the sole reference; otherwise it runs the
+  ``*_copy`` twin, so a concurrent reader's snapshot is never invalidated.
+- Readers (search/link/sweep paths) may snapshot ``index.state`` for the
+  duration of one operation — the gate sees the raised refcount and falls
+  back to copying. They must re-snapshot per operation, never cache across
+  mutations.
+- Direct callers of the donated module-level kernels (bench, tests) own
+  the handoff themselves: treat the argument as consumed, thread the
+  returned state forward, and never touch the old pytree or its leaves.
+- A donated state pytree must hold one DISTINCT buffer per leaf (the
+  runtime rejects donating the same buffer twice). ``init_arena`` /
+  ``init_edges`` guarantee this; hand-built states must too.
 """
 
 from __future__ import annotations
@@ -159,8 +186,17 @@ def grow_edges(state: EdgeState, new_capacity: int) -> EdgeState:
 
 # ---------------------------------------------------------------------------
 # Jitted mutation kernels. Index vectors are sentinel-padded on the host
-# (see pad_rows) so shapes bucket to powers of two.
+# (see pad_rows) so shapes bucket to powers of two. Each kernel is one impl
+# jitted twice: the donated default (zero-copy in-place scatter) and a
+# ``*_copy`` twin for callers that cannot prove sole ownership of the state
+# (see the module docstring's donation invariants).
 # ---------------------------------------------------------------------------
+
+
+def _donated_pair(impl, donate=(0,), **jit_kwargs):
+    """(donated, copying) jit pair over one mutation impl."""
+    return (jax.jit(impl, donate_argnums=donate, **jit_kwargs),
+            jax.jit(impl, **jit_kwargs))
 
 
 def pad_rows(rows: np.ndarray, sentinel: int, min_bucket: int = 8) -> np.ndarray:
@@ -190,8 +226,7 @@ from lazzaro_tpu.ops.chunking import nt_dot  # noqa: E402  (re-export: scans
 #                                              score through this helper)
 
 
-@jax.jit
-def arena_add(
+def _arena_add(
     state: ArenaState,
     rows: jax.Array,        # [B] i32, sentinel-padded
     emb: jax.Array,         # [B, d] (normalized by caller or here)
@@ -217,16 +252,20 @@ def arena_add(
     )
 
 
-@jax.jit
-def arena_delete(state: ArenaState, rows: jax.Array) -> ArenaState:
+arena_add, arena_add_copy = _donated_pair(_arena_add)
+
+
+def _arena_delete(state: ArenaState, rows: jax.Array) -> ArenaState:
     return state.replace(
         alive=state.alive.at[rows].set(False),
         tenant_id=state.tenant_id.at[rows].set(-1),
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cap_salience",))
-def arena_update_access(
+arena_delete, arena_delete_copy = _donated_pair(_arena_delete)
+
+
+def _arena_update_access(
     state: ArenaState,
     rows: jax.Array,
     now: jax.Array,
@@ -246,9 +285,12 @@ def arena_update_access(
     )
 
 
-@jax.jit
-def arena_boost(state: ArenaState, rows: jax.Array, now: jax.Array,
-                boost: jax.Array) -> ArenaState:
+arena_update_access, arena_update_access_copy = _donated_pair(
+    _arena_update_access, static_argnames=("cap_salience",))
+
+
+def _arena_boost(state: ArenaState, rows: jax.Array, now: jax.Array,
+                 boost: jax.Array) -> ArenaState:
     """Associative neighbor boost: salience += boost (cap 1.0) and freshness
     inheritance (last_accessed = now) WITHOUT an access_count bump — exact
     parity with ``_boost_neighbors`` (memory_system.py:242-260)."""
@@ -259,9 +301,11 @@ def arena_boost(state: ArenaState, rows: jax.Array, now: jax.Array,
     )
 
 
-@jax.jit
-def arena_merge_touch(state: ArenaState, rows: jax.Array,
-                      candidate_salience: jax.Array, now: jax.Array) -> ArenaState:
+arena_boost, arena_boost_copy = _donated_pair(_arena_boost)
+
+
+def _arena_merge_touch(state: ArenaState, rows: jax.Array,
+                       candidate_salience: jax.Array, now: jax.Array) -> ArenaState:
     """Dedup-merge bookkeeping: salience = max(salience, candidate),
     access_count += 1, last_accessed = now (memory_system.py:732-741)."""
     sal = state.salience.at[rows].max(candidate_salience)
@@ -272,20 +316,26 @@ def arena_merge_touch(state: ArenaState, rows: jax.Array,
     )
 
 
-@jax.jit
-def arena_set_salience(state: ArenaState, rows: jax.Array, values: jax.Array) -> ArenaState:
+arena_merge_touch, arena_merge_touch_copy = _donated_pair(_arena_merge_touch)
+
+
+def _arena_set_salience(state: ArenaState, rows: jax.Array, values: jax.Array) -> ArenaState:
     return state.replace(salience=state.salience.at[rows].set(values))
 
 
-@jax.jit
-def arena_set_parentage(state: ArenaState, rows: jax.Array, is_super: jax.Array) -> ArenaState:
+arena_set_salience, arena_set_salience_copy = _donated_pair(_arena_set_salience)
+
+
+def _arena_set_parentage(state: ArenaState, rows: jax.Array, is_super: jax.Array) -> ArenaState:
     return state.replace(is_super=state.is_super.at[rows].set(is_super))
 
 
-@jax.jit
-def arena_restore_access(state: ArenaState, rows: jax.Array,
-                         access_count: jax.Array,
-                         last_accessed: jax.Array) -> ArenaState:
+arena_set_parentage, arena_set_parentage_copy = _donated_pair(_arena_set_parentage)
+
+
+def _arena_restore_access(state: ArenaState, rows: jax.Array,
+                          access_count: jax.Array,
+                          last_accessed: jax.Array) -> ArenaState:
     """Reload path: ``arena_add`` zeroes access history for fresh inserts;
     restored rows get their persisted counters back so importance-ranked
     eviction keeps favoring heavily-used memories across restarts."""
@@ -295,9 +345,11 @@ def arena_restore_access(state: ArenaState, rows: jax.Array,
     )
 
 
-@jax.jit
-def arena_decay(state: ArenaState, tenant: jax.Array, rate: jax.Array,
-                floor: jax.Array) -> ArenaState:
+arena_restore_access, arena_restore_access_copy = _donated_pair(_arena_restore_access)
+
+
+def _arena_decay(state: ArenaState, tenant: jax.Array, rate: jax.Array,
+                 floor: jax.Array) -> ArenaState:
     """Asymptotic salience decay toward ``floor``:  s' = floor + (s-floor)(1-rate).
 
     Tenant-masked and vectorized over the whole arena (reference loops per
@@ -306,6 +358,9 @@ def arena_decay(state: ArenaState, tenant: jax.Array, rate: jax.Array,
     decayed = floor + (s - floor) * (1.0 - rate)
     mask = state.alive & (state.tenant_id == tenant)
     return state.replace(salience=jnp.where(mask, decayed, s))
+
+
+arena_decay, arena_decay_copy = _donated_pair(_arena_decay)
 
 
 # ---------------------------------------------------------------------------
@@ -376,8 +431,7 @@ def arena_search(
     return top_scores, top_rows
 
 
-@functools.partial(jax.jit, static_argnames=("k", "shard_modes"))
-def arena_link_candidates_multi(
+def _arena_link_candidates_multi(
     state: ArenaState,
     new_rows: jax.Array,   # [B] i32 rows to find candidates FOR (whole batch)
     excl_rows: jax.Array,  # [E] i32 rows excluded as candidates (ALL new rows)
@@ -421,6 +475,10 @@ def arena_link_candidates_multi(
         return tuple(outs)
 
     return chunked_map(chunk, new_rows)
+
+
+arena_link_candidates_multi = jax.jit(
+    _arena_link_candidates_multi, static_argnames=("k", "shard_modes"))
 
 
 def arena_link_candidates(
@@ -479,10 +537,9 @@ def arena_mean_embedding(state: ArenaState, rows: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def edges_add(state: EdgeState, slots: jax.Array, src: jax.Array, tgt: jax.Array,
-              weight: jax.Array, co: jax.Array, now: jax.Array,
-              tenant: jax.Array, live: jax.Array) -> EdgeState:
+def _edges_add(state: EdgeState, slots: jax.Array, src: jax.Array, tgt: jax.Array,
+               weight: jax.Array, co: jax.Array, now: jax.Array,
+               tenant: jax.Array, live: jax.Array) -> EdgeState:
     """``live`` is False for sentinel-padded positions so the scratch slot
     never becomes an alive phantom edge."""
     return state.replace(
@@ -496,9 +553,11 @@ def edges_add(state: EdgeState, slots: jax.Array, src: jax.Array, tgt: jax.Array
     )
 
 
-@jax.jit
-def edges_reinforce(state: EdgeState, slots: jax.Array, bump: jax.Array,
-                    now: jax.Array) -> EdgeState:
+edges_add, edges_add_copy = _donated_pair(_edges_add)
+
+
+def _edges_reinforce(state: EdgeState, slots: jax.Array, bump: jax.Array,
+                     now: jax.Array) -> EdgeState:
     """Existing edge: weight += bump (capped at 1.0), co_occurrence += 1
     (parity: memory_shard.py:42-52)."""
     w = jnp.minimum(state.weight.at[slots].add(bump), 1.0)
@@ -509,30 +568,117 @@ def edges_reinforce(state: EdgeState, slots: jax.Array, bump: jax.Array,
     )
 
 
-@jax.jit
-def edges_decay(state: EdgeState, tenant: jax.Array, rate: jax.Array) -> EdgeState:
+edges_reinforce, edges_reinforce_copy = _donated_pair(_edges_reinforce)
+
+
+def _edges_decay(state: EdgeState, tenant: jax.Array, rate: jax.Array) -> EdgeState:
     """weight *= (1 - rate) for the tenant's alive edges (memory_shard.py:64-71)."""
     mask = state.alive & (state.tenant_id == tenant)
     w = jnp.where(mask, state.weight * (1.0 - rate), state.weight)
     return state.replace(weight=w)
 
 
-@jax.jit
-def edges_prune(state: EdgeState, tenant: jax.Array,
-                threshold: jax.Array) -> Tuple[EdgeState, jax.Array]:
+edges_decay, edges_decay_copy = _donated_pair(_edges_decay)
+
+
+def _edges_prune(state: EdgeState, tenant: jax.Array,
+                 threshold: jax.Array) -> Tuple[EdgeState, jax.Array]:
     """Kill the tenant's edges with weight < threshold; returns (state, pruned_mask)."""
     pruned = state.alive & (state.tenant_id == tenant) & (state.weight < threshold)
     return state.replace(alive=state.alive & ~pruned), pruned
 
 
-@jax.jit
-def edges_delete_for_nodes(state: EdgeState, node_rows: jax.Array) -> EdgeState:
+edges_prune, edges_prune_copy = _donated_pair(_edges_prune)
+
+
+def _edges_delete_for_nodes(state: EdgeState, node_rows: jax.Array) -> EdgeState:
     """Remove all edges touching any of ``node_rows`` (eviction cleanup,
     memory_system.py:560-570). node_rows is a small sentinel-padded batch, so
     a broadcast membership test [E, B] is one fused VPU pass."""
     touched_src = (state.src[:, None] == node_rows[None, :]).any(axis=1)
     touched_tgt = (state.tgt[:, None] == node_rows[None, :]).any(axis=1)
     return state.replace(alive=state.alive & ~(touched_src | touched_tgt))
+
+
+edges_delete_for_nodes, edges_delete_for_nodes_copy = _donated_pair(
+    _edges_delete_for_nodes)
+
+
+# ---------------------------------------------------------------------------
+# Fused ingest: the whole per-conversation mutation sequence in ONE program
+# ---------------------------------------------------------------------------
+
+
+def _ingest_fused(
+    arena: ArenaState,
+    edges: EdgeState,
+    rows: jax.Array,         # [B] i32 new-node rows, sentinel-padded
+    emb: jax.Array,          # [B, d]
+    salience: jax.Array,     # [B] f32
+    timestamp: jax.Array,    # [B] f32
+    type_id: jax.Array,      # [B] i32
+    shard_id: jax.Array,     # [B] i32
+    tenant_id: jax.Array,    # [B] i32
+    is_super: jax.Array,     # [B] bool
+    touch_rows: jax.Array,   # [M] i32 dedup-merge rows, sentinel-padded
+    touch_sal: jax.Array,    # [M] f32 candidate saliences
+    chain_slots: jax.Array,  # [C] i32 edge slots, sentinel-padded
+    chain_src: jax.Array,    # [C] i32 arena rows (-1 padding)
+    chain_tgt: jax.Array,    # [C] i32
+    chain_w: jax.Array,      # [C] f32
+    link_slots: jax.Array,   # [n_modes, B, k] i32 edge slots (sentinel-padded)
+    now: jax.Array,
+    tenant: jax.Array,
+    link_gate: jax.Array,
+    link_scale: jax.Array,
+    k: int,
+    shard_modes: Tuple[int, ...] = (1, 0),
+) -> Tuple[ArenaState, EdgeState, Tuple[jax.Array, ...]]:
+    """The per-conversation ingest sequence — ``arena_add`` →
+    ``arena_merge_touch`` → ``arena_link_candidates_multi`` → gated
+    ``edges_add`` — fused into ONE donated device program.
+
+    The host pre-allocates one edge slot per chain pair and per potential
+    (mode, new-row, candidate) link; the gate (score > link_gate, valid
+    non-sentinel query row, not a duplicate of an earlier mode's hit) is
+    evaluated ON DEVICE and rejected slots are scattered with live=False
+    (the host reclaims them after the readback). Host round trips per
+    conversation drop from ~4 dispatches + 1 readback to 1 + 1: the
+    returned per-mode ``(scores, cands, live)`` triples are the single
+    packed readback the host needs for id decode and edge bookkeeping."""
+    arena = _arena_add(arena, rows, emb, salience, timestamp, type_id,
+                       shard_id, tenant_id, is_super)
+    arena = _arena_merge_touch(arena, touch_rows, touch_sal, now)
+    link_flat = _arena_link_candidates_multi(arena, rows, rows, tenant, k,
+                                             shard_modes)
+    n_chain = chain_slots.shape[0]
+    edges = _edges_add(edges, chain_slots, chain_src, chain_tgt, chain_w,
+                       jnp.ones((n_chain,), jnp.int32), now, tenant,
+                       chain_src >= 0)
+    valid_q = rows < arena.capacity        # sentinel-padded rows make no edges
+    outs = []
+    prior = []                             # (cands, live) of earlier modes
+    for mi in range(len(shard_modes)):
+        scores, cand = link_flat[2 * mi], link_flat[2 * mi + 1]
+        live = (scores > link_gate) & valid_q[:, None]
+        for p_cand, p_live in prior:
+            # an (src, cand) pair an earlier mode already inserted must not
+            # become a second live edge row (mode masks overlap: every
+            # same-shard candidate is also an any-shard candidate)
+            dup = (cand[:, :, None] == p_cand[:, None, :]) & p_live[:, None, :]
+            live = live & ~dup.any(-1)
+        prior.append((cand, live))
+        src_b = jnp.broadcast_to(rows[:, None], cand.shape)
+        edges = _edges_add(
+            edges, link_slots[mi].reshape(-1), src_b.reshape(-1),
+            cand.reshape(-1), (scores * link_scale).reshape(-1),
+            jnp.ones((live.size,), jnp.int32), now, tenant, live.reshape(-1))
+        outs.extend((scores, cand, live))
+    return arena, edges, tuple(outs)
+
+
+ingest_fused, ingest_fused_copy = _donated_pair(
+    _ingest_fused, donate=(0, 1), static_argnames=("k", "shard_modes"))
 
 
 @functools.partial(jax.jit, static_argnames=("max_neighbors",))

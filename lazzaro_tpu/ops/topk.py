@@ -16,7 +16,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from lazzaro_tpu.utils.compat import shard_map
 
 NEG_INF = -1e30
 

@@ -10,7 +10,7 @@ and pod-sharded indexes.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -65,19 +65,24 @@ def pad_to_pow2(arr: np.ndarray) -> np.ndarray:
 
 
 def decode_topk(scores: np.ndarray, rows: np.ndarray,
-                row_to_id: Dict[int, str], neg_inf: float
+                row_to_id: Dict[int, str], neg_inf: float,
+                limit: Optional[int] = None
                 ) -> List[Tuple[List[str], List[float]]]:
     """Per query: drop NEG_INF sentinels, rows without a live id mapping,
     and repeated rows (a slot reused after delete can appear in both a
     stale IVF member slot and the fresh residual — scores are sorted
     descending, so keeping the first occurrence keeps the best); return
-    (ids, scores) pairs."""
+    (ids, scores) pairs. ``limit`` caps each list AFTER dedup — the IVF
+    serving path over-fetches k + slack so duplicates can't shrink the
+    result below k, then trims back here."""
     out: List[Tuple[List[str], List[float]]] = []
     for qi in range(scores.shape[0]):
         ids: List[str] = []
         sc: List[float] = []
         seen = set()
         for s, r in zip(scores[qi], rows[qi]):
+            if limit is not None and len(ids) >= limit:
+                break
             if s <= neg_inf / 2:
                 continue
             r = int(r)
@@ -96,3 +101,58 @@ def empty_results(n: int) -> List[Tuple[List[str], List[float]]]:
     """n independent ([], []) pairs — NOT `[([], [])] * n`, which aliases
     the same two lists across every entry."""
     return [([], []) for _ in range(n)]
+
+
+class IngestCoalescer:
+    """Cross-conversation ingest batcher for the fused single-dispatch
+    pipeline.
+
+    Consolidation extracts a fact list per drained conversation; this
+    buffer coalesces the lists of EVERY buffered conversation into padded
+    mega-batches so the fused ingest kernel (``state.ingest_fused``)
+    dispatches once per mega-batch instead of once per conversation.
+    Conversations are kept whole when they fit under ``max_facts`` — the
+    cap bounds the padded jit bucket (and the [B, capacity] link-scan
+    tile) — and only oversized single conversations are split.
+
+    ``drain`` returns ``(facts, n_conversations)`` mega-batches and empties
+    the buffer; nothing is ever withheld across a drain, so durability
+    bookkeeping (WAL, in-flight batches) stays with the caller.
+    """
+
+    def __init__(self, max_facts: int = 8192):
+        self.max_facts = max(1, int(max_facts))
+        self._convs: List[List[dict]] = []
+
+    def add_conversation(self, facts: Sequence[dict]) -> None:
+        if facts:
+            self._convs.append(list(facts))
+
+    def __len__(self) -> int:
+        return sum(len(c) for c in self._convs)
+
+    @property
+    def pending_conversations(self) -> int:
+        return len(self._convs)
+
+    def drain(self) -> List[Tuple[List[dict], int]]:
+        batches: List[Tuple[List[dict], int]] = []
+        batch: List[dict] = []
+        n_convs = 0
+        convs, self._convs = self._convs, []
+        for conv in convs:
+            while len(conv) > self.max_facts:          # oversized: split
+                if batch:
+                    batches.append((batch, n_convs))
+                    batch, n_convs = [], 0
+                batches.append((conv[:self.max_facts], 1))
+                conv = conv[self.max_facts:]
+            if batch and len(batch) + len(conv) > self.max_facts:
+                batches.append((batch, n_convs))
+                batch, n_convs = [], 0
+            if conv:
+                batch = batch + conv
+                n_convs += 1
+        if batch:
+            batches.append((batch, n_convs))
+        return batches

@@ -1,0 +1,30 @@
+"""Version shims for the jax APIs this tree uses across toolchain pins.
+
+The graft rigs pin different jax versions: newer ones export
+``jax.shard_map`` (replication-check kwarg ``check_vma``), 0.4.x rigs only
+ship ``jax.experimental.shard_map.shard_map`` (same kwarg named
+``check_rep``). Every shard_map call in the tree imports from here so the
+difference is absorbed in exactly one place.
+"""
+
+from __future__ import annotations
+
+try:                                    # jax >= 0.5: top-level export
+    from jax import shard_map as _shard_map
+    _CHECK_KWARG = "check_vma"
+except ImportError:                     # jax 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KWARG = "check_rep"
+
+_CHECK_NAMES = ("check_vma", "check_rep")
+
+
+def shard_map(f=None, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg translated to
+    whatever this jax version calls it. Accepts either spelling."""
+    for name in _CHECK_NAMES:
+        if name in kwargs and name != _CHECK_KWARG:
+            kwargs[_CHECK_KWARG] = kwargs.pop(name)
+    if f is None:                       # decorator-style usage
+        return lambda g: _shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)

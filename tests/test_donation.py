@@ -1,0 +1,122 @@
+"""Zero-copy mutation discipline (donated arena buffers).
+
+Every mutation kernel donates its state so XLA scatters in place — no
+full-arena HBM copy per small write. These tests pin the three contracts:
+(a) donated kernels genuinely alias (pointer-stable buffers) and consume
+their input; (b) the ``*_copy`` twins genuinely copy; (c) MemoryIndex's
+refcount-gated ownership handoff donates on the sole-owner hot path but
+falls back to copying whenever a reader still holds a snapshot — so no
+live reference ever outlives a donated buffer.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from lazzaro_tpu.core import state as S
+from lazzaro_tpu.core.index import MemoryIndex
+
+
+def _add_args(b=8, d=16):
+    return (jnp.full((b,), 0.5), jnp.zeros((b,)),
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool))
+
+
+def test_donated_kernels_alias_and_consume():
+    arena = S.init_arena(255, 16)
+    rows = jnp.arange(8, dtype=jnp.int32)
+    emb = jnp.ones((8, 16))
+    p_emb = arena.emb.unsafe_buffer_pointer()
+    p_sal = arena.salience.unsafe_buffer_pointer()
+    arena2 = S.arena_add(arena, rows, emb, *_add_args())
+    # in-place: both the scattered leaf and the pass-through leaves keep
+    # their buffers
+    assert arena2.emb.unsafe_buffer_pointer() == p_emb
+    assert arena2.salience.unsafe_buffer_pointer() == p_sal
+    # and the input was consumed
+    assert arena.emb.is_deleted()
+
+    edges = S.init_edges(255)
+    p_src = edges.src.unsafe_buffer_pointer()
+    edges2 = S.edges_add(edges, rows, rows, rows, jnp.full((8,), 0.5),
+                         jnp.ones((8,), jnp.int32), jnp.float32(0.0),
+                         jnp.int32(0), jnp.ones((8,), bool))
+    assert edges2.src.unsafe_buffer_pointer() == p_src
+    assert edges.src.is_deleted()
+
+
+def test_copy_twins_do_not_consume():
+    arena = S.init_arena(255, 16)
+    rows = jnp.arange(8, dtype=jnp.int32)
+    arena2 = S.arena_add_copy(arena, rows, jnp.ones((8, 16)), *_add_args())
+    assert not arena.emb.is_deleted()
+    assert (arena2.emb.unsafe_buffer_pointer()
+            != arena.emb.unsafe_buffer_pointer())
+    # the original is still fully usable
+    assert not np.asarray(arena.alive)[:8].any()
+    assert np.asarray(arena2.alive)[:8].all()
+
+
+def _small_index():
+    idx = MemoryIndex(dim=16, capacity=255)
+    emb = np.eye(16, dtype=np.float32)[:4]
+    idx.add(["a", "b", "c", "d"], emb, [0.5] * 4, [0.0] * 4,
+            ["semantic"] * 4, ["default"] * 4, "u")
+    return idx
+
+
+def test_index_mutations_donate_on_sole_owner_path():
+    """The hot single-writer path must alias, not copy: the arena buffer
+    pointer is stable across every metadata mutation."""
+    idx = _small_index()
+    p_emb = idx.state.emb.unsafe_buffer_pointer()    # transient snapshot
+    idx.update_access(["a"], now=1.0)
+    idx.boost(["b"], now=2.0)
+    idx.merge_touch(["c"], [0.9], now=3.0)
+    idx.decay("u", 0.01)
+    idx.delete(["d"])
+    assert idx.state.emb.unsafe_buffer_pointer() == p_emb
+    # edge arena too
+    idx.add_edges([("a", "b", 0.7)], "u")
+    p_src = idx.edge_state.src.unsafe_buffer_pointer()
+    idx.add_edges([("b", "c", 0.6)], "u")
+    idx.add_edges([("a", "b", 0.7)], "u")            # reinforce path
+    assert idx.edge_state.src.unsafe_buffer_pointer() == p_src
+
+
+def test_reader_snapshot_forces_copy_and_stays_usable():
+    """A concurrent reader's snapshot must survive a writer's mutation:
+    the ownership gate sees the raised refcount and runs the copying twin."""
+    idx = _small_index()
+    snap = idx.state                                  # reader holds the state
+    before = np.asarray(snap.salience).copy()
+    idx.update_access(["a"], boost=0.2, now=5.0)      # writer mutates
+    # the snapshot was NOT donated out from under the reader
+    assert not snap.emb.is_deleted()
+    np.testing.assert_array_equal(np.asarray(snap.salience), before)
+    # and the index really advanced past it
+    row = idx.id_to_row["a"]
+    assert int(np.asarray(idx.state.access_count)[row]) == 1
+    assert float(np.asarray(idx.state.salience)[row]) > float(before[row])
+    del snap
+    # with the reader gone, the next mutation donates in place again
+    p = idx.state.emb.unsafe_buffer_pointer()
+    idx.boost(["b"], now=6.0)
+    assert idx.state.emb.unsafe_buffer_pointer() == p
+
+
+def test_fused_ingest_donates_both_states():
+    idx = _small_index()
+    idx.add_edges([("a", "b", 0.7)], "u")
+    p_emb = idx.state.emb.unsafe_buffer_pointer()
+    p_src = idx.edge_state.src.unsafe_buffer_pointer()
+    emb = np.eye(16, dtype=np.float32)[4:8]
+    rows, cands, created = idx.ingest_batch(
+        ["e", "f", "g", "h"], emb, [0.5] * 4, [0.0] * 4,
+        ["semantic"] * 4, ["default"] * 4, "u",
+        chain_pairs=[("e", "f"), ("f", "g")])
+    assert idx.state.emb.unsafe_buffer_pointer() == p_emb
+    assert idx.edge_state.src.unsafe_buffer_pointer() == p_src
+    assert len(rows) == 4
+    # chain edges registered against real slots
+    assert ("e", "f") in idx.edge_slots and ("f", "g") in idx.edge_slots

@@ -1,0 +1,223 @@
+"""Fused single-dispatch ingest (tier-1 smoke, CPU, tiny arena).
+
+The per-conversation ingest sequence — node scatter, dedup merge touch,
+two-mode link scan, gated edge insert — must run as ONE device program
+(``state.ingest_fused``): these tests count the actual jit entry points
+during an end-to-end ``end_conversation`` and pin exact semantic parity
+with the classic four-dispatch path, so donation/ownership regressions in
+the fused pipeline are caught without the full bench.
+"""
+
+import json
+import tempfile
+
+import numpy as np
+import pytest
+
+from lazzaro_tpu.config import MemoryConfig
+from lazzaro_tpu.core import state as S
+from lazzaro_tpu.core.index import MemoryIndex
+from lazzaro_tpu.core.memory_system import MemorySystem
+from lazzaro_tpu.utils.batching import IngestCoalescer
+
+D = 24
+_DIRS = np.random.default_rng(3).standard_normal((10, D))
+_DIRS /= np.linalg.norm(_DIRS, axis=1, keepdims=True)
+
+
+class ClusteredEmb:
+    """Facts in the same group land ~0.8 cosine apart: above the 0.5 link
+    gate (real gated links), below the 0.95 dedup gate (distinct nodes)."""
+
+    dim = D
+
+    def _v(self, t):
+        try:
+            idx = int(t.split()[1])
+        except (IndexError, ValueError):
+            idx = abs(hash(t)) % 100
+        rng = np.random.default_rng(500 + idx)
+        v = 0.85 * _DIRS[idx % 10] + 0.55 * rng.standard_normal(D)
+        return (v / np.linalg.norm(v)).tolist()
+
+    def embed(self, t):
+        return self._v(t)
+
+    def batch_embed(self, ts):
+        return [self._v(t) for t in ts]
+
+
+class QueueLLM:
+    def __init__(self, per=20):
+        self.c = 0
+        self.per = per
+
+    def completion(self, messages, response_format=None):
+        base = self.c * self.per
+        self.c += 1
+        return json.dumps({"memories": [
+            {"content": f"fact {base + i} body", "type": "semantic",
+             "salience": 0.6,
+             "topic": ["work", "personal", "learning"][(base + i) % 3]}
+            for i in range(self.per)]})
+
+    def completion_stream(self, messages, response_format=None):
+        yield self.completion(messages, response_format)
+
+
+def _system(tmp, fused=True, per=20):
+    return MemorySystem(
+        enable_async=False, db_dir=tmp, verbose=False, load_from_disk=False,
+        llm_provider=QueueLLM(per), embedding_provider=ClusteredEmb(),
+        auto_prune=False, max_buffer_size=10_000,
+        config=MemoryConfig(journal=False, auto_consolidate=False,
+                            ingest_fused=fused, decay_rate=0.0))
+
+
+_COUNTED = ("ingest_fused", "ingest_fused_copy", "arena_add",
+            "arena_add_copy", "arena_merge_touch", "arena_merge_touch_copy",
+            "edges_add", "edges_add_copy", "arena_link_candidates_multi")
+
+
+def _count_dispatches(monkeypatch):
+    calls = {name: 0 for name in _COUNTED}
+    for name in _COUNTED:
+        orig = getattr(S, name)
+
+        def wrapped(*a, __orig=orig, __name=name, **kw):
+            calls[__name] += 1
+            return __orig(*a, **kw)
+
+        monkeypatch.setattr(S, name, wrapped)
+    return calls
+
+
+def test_one_fused_dispatch_per_conversation(monkeypatch):
+    """The jit-call counter: a consolidated conversation costs exactly ONE
+    ingest-path dispatch (the fused program), zero unfused mutation calls."""
+    with tempfile.TemporaryDirectory() as tmp:
+        ms = _system(tmp, fused=True)
+        ms.start_conversation()
+        ms.add_to_short_term("conv 0", "episodic", 0.7)
+        calls = _count_dispatches(monkeypatch)
+        ms.end_conversation()
+        assert calls["ingest_fused"] + calls["ingest_fused_copy"] == 1
+        # the single-writer hot path donated (no reader held the state)
+        assert calls["ingest_fused"] == 1
+        for name in ("arena_add", "arena_add_copy", "arena_merge_touch",
+                     "arena_merge_touch_copy", "edges_add", "edges_add_copy",
+                     "arena_link_candidates_multi"):
+            assert calls[name] == 0, (name, calls)
+        assert ms.buffer.size()[0] == 20
+        ms.close()
+
+
+def test_fused_matches_unfused_exactly():
+    """Node set, host edge set (keys AND weights), device edge arena, and
+    retrieval results must be identical across the two pipelines."""
+    def build(fused):
+        tmp = tempfile.mkdtemp()
+        ms = _system(tmp, fused=fused)
+        for c in range(3):
+            ms.start_conversation()
+            ms.add_to_short_term(f"conv {c}", "episodic", 0.7)
+            ms.end_conversation()
+        return ms
+
+    a, b = build(True), build(False)
+    try:
+        assert a.buffer.size() == b.buffer.size()
+        assert set(a.buffer.nodes) == set(b.buffer.nodes)
+
+        def host_edges(ms):
+            return {(e.source, e.target): round(e.weight, 5)
+                    for s in ms.shards.values() for e in s.edges.values()}
+
+        assert host_edges(a) == host_edges(b)
+        assert set(a.index.edge_slots) == set(b.index.edge_slots)
+        wa, wb = a.index.edge_weights(), b.index.edge_weights()
+        for key in wa:
+            assert wa[key][0] == pytest.approx(wb[key][0], abs=1e-5), key
+            assert wa[key][1] == wb[key][1], key
+        assert a.metrics["edges_linked"] == b.metrics["edges_linked"]
+        for q in ("fact 7 body", "fact 31 body"):
+            ra = [n.id for n in a.search_memories(q)]
+            rb = [n.id for n in b.search_memories(q)]
+            assert ra == rb
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ingest_batch_candidates_match_link_candidates_multi():
+    """The fused kernel's link output is the same scan the classic path
+    runs after its add — byte-identical candidates either way."""
+    rng = np.random.default_rng(11)
+    seed_emb = rng.standard_normal((20, D)).astype(np.float32)
+    new_emb = rng.standard_normal((4, D)).astype(np.float32)
+
+    def seed_index():
+        idx = MemoryIndex(dim=D, capacity=255)
+        idx.add([f"m{i}" for i in range(20)], seed_emb, [0.5] * 20,
+                [0.0] * 20, ["semantic"] * 20, ["default"] * 20, "u")
+        return idx
+    idx1, idx2 = seed_index(), seed_index()
+    new_ids = [f"n{i}" for i in range(4)]
+    common = dict(saliences=[0.5] * 4, timestamps=[0.0] * 4,
+                  types=["semantic"] * 4, shard_keys=["default"] * 4)
+
+    _rows, cands, _created = idx1.ingest_batch(
+        new_ids, new_emb, tenant="u", link_k=3, **common)
+
+    idx2.add(new_ids, new_emb, common["saliences"], common["timestamps"],
+             common["types"], common["shard_keys"], "u")
+    classic = idx2.link_candidates_multi(new_ids, "u", k=3, shard_modes=(1, 0))
+
+    for mode in (1, 0):
+        assert set(cands[mode]) == set(classic[mode])
+        for nid in cands[mode]:
+            got = [(c, round(s, 5)) for c, s in cands[mode][nid]]
+            want = [(c, round(s, 5)) for c, s in classic[mode][nid]]
+            assert got == want, (mode, nid)
+
+
+def test_ingest_batch_reclaims_rejected_slots():
+    """Slots pre-allocated for links the gate rejects go back to the free
+    list; the live edge arena and the slot map stay consistent."""
+    idx = MemoryIndex(dim=D, capacity=255, edge_capacity=1023)
+    emb = np.eye(D, dtype=np.float32)[:8]     # orthogonal: nothing links
+    free_before = len(idx._free_edge_slots)
+    _rows, _cands, created = idx.ingest_batch(
+        [f"o{i}" for i in range(8)], emb, [0.5] * 8, [0.0] * 8,
+        ["semantic"] * 8, ["default"] * 8, "u",
+        chain_pairs=[(f"o{i}", f"o{i+1}") for i in range(7)])
+    assert created == {1: [], 0: []}          # gate rejected every link
+    # only the 7 chain slots stay allocated
+    assert len(idx._free_edge_slots) == free_before - 7
+    assert len(idx.edge_slots) == 7
+    # the edge arena agrees: exactly 7 alive edges
+    assert int(np.asarray(idx.edge_state.alive).sum()) == 7
+
+
+def test_coalescer_merges_and_splits():
+    c = IngestCoalescer(max_facts=10)
+    c.add_conversation([{"content": f"a{i}"} for i in range(4)])
+    c.add_conversation([{"content": f"b{i}"} for i in range(4)])
+    assert len(c) == 8 and c.pending_conversations == 2
+    batches = c.drain()
+    assert len(batches) == 1
+    facts, n_convs = batches[0]
+    assert len(facts) == 8 and n_convs == 2   # cross-conversation mega-batch
+    assert len(c) == 0
+
+    # conversations that don't fit together stay whole but separate
+    c.add_conversation([{"content": f"a{i}"} for i in range(7)])
+    c.add_conversation([{"content": f"b{i}"} for i in range(7)])
+    batches = c.drain()
+    assert [(len(f), n) for f, n in batches] == [(7, 1), (7, 1)]
+
+    # an oversized single conversation splits, nothing dropped
+    c.add_conversation([{"content": f"x{i}"} for i in range(23)])
+    batches = c.drain()
+    assert [len(f) for f, _ in batches] == [10, 10, 3]
+    assert sum(n for _, n in batches) >= 1

@@ -251,3 +251,93 @@ def test_delete_readd_churn_triggers_rebuild_and_serves_new_vector():
     assert idx.ivf_maintenance()
     assert idx._ivf is not built          # genuinely rebuilt
     assert idx._ivf_stale == 0
+
+
+def _built_index(n=5000, d=32, seed=20):
+    from lazzaro_tpu.core.index import MemoryIndex
+
+    rng = np.random.default_rng(seed)
+    emb = rng.standard_normal((n, d)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    idx = MemoryIndex(dim=d, capacity=n + 64, ivf_nprobe=8)
+    idx.add([f"m{i}" for i in range(n)], emb, [0.5] * n, [0.0] * n,
+            ["semantic"] * n, ["default"] * n, "u1")
+    assert idx.ivf_maintenance()
+    return idx, emb
+
+
+def test_stale_residual_cache_cross_slot_churn():
+    """ADVICE r5 high: delete a FRESH row, then re-add into a DIFFERENT
+    freed slot. The fresh tuple returns to its old LENGTH with different
+    CONTENTS — a (build, len) cache key would serve the stale device
+    residual and silently drop the live row from IVF results. The cache is
+    keyed on the fresh tuple's identity, so the re-upload must happen."""
+    d = 32
+    idx, emb = _built_index(d=d)
+
+    fresh_v = np.zeros((1, d), np.float32)
+    fresh_v[0, 5] = 1.0
+    idx.add(["f1"], fresh_v, [0.5], [0.0], ["semantic"], ["default"], "u1")
+    f1_row = idx.id_to_row["f1"]
+    # a search populates the device-residual cache for fresh=(f1_row,)
+    (got, _), = idx.search_batch(fresh_v, "u1", k=1)
+    assert got == ["f1"]
+    assert idx._ivf_res_cache is not None
+
+    # free f1's slot AND a member slot; the LIFO free list hands the
+    # member slot back first, so the re-add lands in a DIFFERENT slot
+    # while len(fresh) returns to exactly 1
+    idx.delete(["f1", "m0"])
+    fresh_v2 = np.zeros((1, d), np.float32)
+    fresh_v2[0, 7] = 1.0
+    idx.add(["f2"], fresh_v2, [0.5], [0.0], ["semantic"], ["default"], "u1")
+    assert idx.id_to_row["f2"] != f1_row      # the cross-slot premise
+    assert len(idx._ivf_fresh) == 1           # same length as the cached snapshot
+
+    (got, _), = idx.search_batch(fresh_v2, "u1", k=1)
+    assert got == ["f2"], "stale cached residual dropped the live row"
+
+
+def test_ivf_setter_reconstructs_routed_bitmaps():
+    """ADVICE r5 low: assigning ``idx._ivf = build`` (tests/bench compat
+    surface) must rebuild the routed/in-residual bitmaps from the build —
+    with them left None, every re-add of an already-routed row appends a
+    duplicate to the fresh residual."""
+    idx, emb = _built_index(seed=21)
+    build = idx._ivf
+    idx._ivf = build                          # compat assignment
+    assert idx._ivf_routed is not None and idx._ivf_routed.any()
+    assert idx._ivf_in_residual is not None
+
+    # re-adding routed rows (same ids, rows already in members/residual)
+    # must never grow the fresh residual
+    for _ in range(3):
+        idx.add(["m1", "m2"], emb[1:3], [0.5] * 2, [0.0] * 2,
+                ["semantic"] * 2, ["default"] * 2, "u1")
+    assert idx._ivf_fresh == []
+
+    # a genuinely new row appends exactly once across repeated adds
+    v = np.zeros((1, emb.shape[1]), np.float32)
+    v[0, 3] = 1.0
+    for _ in range(2):
+        idx.add(["fresh1"], v, [0.5], [0.0], ["semantic"], ["default"], "u1")
+    assert idx._ivf_fresh == [idx.id_to_row["fresh1"]]
+
+
+def test_ivf_duplicate_rows_do_not_shorten_results():
+    """ADVICE r5 low: a slot freed from a member and reused by a re-add
+    sits in BOTH the stale member table and the fresh residual; host dedup
+    used to shrink the result below k. Serving now over-fetches slack, so
+    k distinct live rows still come back."""
+    idx, emb = _built_index(seed=22)
+    row = idx.id_to_row["m0"]
+    idx.delete(["m0"])
+    idx.add(["m0"], emb[:1], [0.5], [0.0], ["semantic"], ["default"], "u1")
+    assert idx.id_to_row["m0"] == row         # LIFO reuses the same slot
+    assert row in idx._ivf_fresh              # and it joined the residual
+
+    k = 5
+    (got, scores), = idx.search_batch(emb[:1], "u1", k=k)
+    assert got[0] == "m0"
+    assert len(got) == k, f"duplicate consumed a top-k slot: {got}"
+    assert len(set(got)) == k
