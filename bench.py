@@ -427,6 +427,89 @@ def bench_fused_ingest(on_tpu: bool):
     return reps * B / (time.perf_counter() - t0)
 
 
+def bench_fused_retrieval(on_tpu: bool):
+    """Fused vs classic serving A/B at batch 64 (ISSUE 2 acceptance): the
+    per-chat-turn retrieval sequence — super gate + ANN top-k + neighbor
+    boost + access boost — as ONE ``search_fused`` dispatch per batch
+    (``MemoryIndex.search_fused_requests``) against the classic sequence
+    (two ``search_batch`` dispatches + ``update_access`` + ``boost``
+    scatters + the host neighbor walk). Both sides serve the same arena,
+    same queries, same boost semantics; timings close with the host-side
+    result decode, honest by construction."""
+    from lazzaro_tpu.core.index import MemoryIndex
+    from lazzaro_tpu.serve import RetrievalRequest
+
+    n_rows = min(N, 65_536)
+    B = 64
+    reps = 5
+    rng = np.random.default_rng(23)
+    idx = MemoryIndex(dim=DIM, capacity=n_rows + 64,
+                      edge_capacity=max(65_535, 2 * n_rows - 1),
+                      dtype=jnp.bfloat16)
+    for c in range(0, n_rows, 8192):
+        m = min(8192, n_rows - c)
+        emb = rng.standard_normal((m, DIM)).astype(np.float32)
+        ids = [f"f{c + i}" for i in range(m)]
+        idx.ingest_batch(ids, emb, [0.5] * m, [0.0] * m, ["semantic"] * m,
+                         ["default"] * m, "u0",
+                         chain_pairs=list(zip(ids, ids[1:])))
+    # host adjacency for the classic neighbor walk (the serving-time analog
+    # of buffer.get_neighbors; built once like the host graph would be)
+    nbr_map = {}
+    for (s, t) in idx.edge_slots:
+        nbr_map.setdefault(s, []).append(t)
+        nbr_map.setdefault(t, []).append(s)
+    queries = rng.standard_normal((B, DIM)).astype(np.float32)
+    reqs = [RetrievalRequest(query=queries[i], tenant="u0", k=10,
+                             gate_enabled=True, boost=True)
+            for i in range(B)]
+    kw = dict(cap_take=5, max_nbr=16, super_gate=0.4,
+              acc_boost=0.05, nbr_boost=0.02)
+
+    def run_fused():
+        return idx.search_fused_requests(reqs, **kw)
+
+    def run_classic():
+        # the per-turn chat sequence, batched where the classic path can:
+        # gate search + ANN search + access boost + neighbor boost = 4
+        # dispatches per batch (vs 1 fused)
+        idx.search_batch(queries, "u0", k=1, super_filter=1, exact=True)
+        per = idx.search_batch(queries, "u0", k=10, super_filter=-1)
+        hit_ids = [i for ids_, _sc in per for i in ids_[:5]]
+        idx.update_access(hit_ids, boost=0.05)
+        retrieved = set(hit_ids)
+        nbrs = {n for i in hit_ids for n in nbr_map.get(i, ())} - retrieved
+        if nbrs:
+            idx.boost(sorted(nbrs), 0.02)
+        return per
+
+    run_fused()                          # warm/compile outside the timers
+    run_classic()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_fused()
+    fused_ms = (time.perf_counter() - t0) * 1e3 / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_classic()
+    classic_ms = (time.perf_counter() - t0) * 1e3 / reps
+    return {
+        "fused_retrieval_qps": round(reps and B / (fused_ms / 1e3), 1),
+        "classic_retrieval_qps": round(B / (classic_ms / 1e3), 1),
+        "fused_batch64_ms": round(fused_ms, 3),
+        "classic_batch64_ms": round(classic_ms, 3),
+        "fused_vs_classic_speedup": round(classic_ms / fused_ms, 2),
+        "batch": B,
+        "arena_rows": n_rows,
+        "roofline": {
+            "fused_retrieval_batch64": _roofline(n_rows, DIM, 2, fused_ms,
+                                                 B, on_tpu),
+            "classic_retrieval_batch64": _roofline(n_rows, DIM, 2,
+                                                   classic_ms, B, on_tpu),
+        },
+    }
+
+
 def bench_reference_default(on_tpu: bool):
     """Reference-DEFAULT configuration, measured (r4 review #4): hierarchy
     ON (super-node creation + the 0.4-gated fast path, ref
@@ -951,6 +1034,12 @@ def main():
         print(f"[bench] fused-ingest stage failed: {e}", file=sys.stderr,
               flush=True)
         fused_ingest_rate = None
+    try:
+        fused_retrieval = bench_fused_retrieval(on_tpu)
+    except Exception as e:   # a failed extra stage must not void the run
+        print(f"[bench] fused-retrieval stage failed: {e}", file=sys.stderr,
+              flush=True)
+        fused_retrieval = None
     t_kernel_phase = time.perf_counter() - t_kernel_phase
 
     # Reference-default configuration (hierarchy + auto-consolidate ON) as
@@ -1077,6 +1166,12 @@ def main():
             "ingest_fused_memories_per_sec_per_chip": (
                 round(fused_ingest_rate, 1)
                 if fused_ingest_rate is not None else None),
+            # fused single-dispatch serving vs the classic multi-dispatch
+            # chat-turn sequence, batch 64 (ISSUE 2 A/B; rooflines inside):
+            "fused_retrieval_qps": (
+                fused_retrieval["fused_retrieval_qps"]
+                if fused_retrieval is not None else None),
+            "fused_retrieval_ab": fused_retrieval,
             "roofline": rl,
             "phase_s": {"ingest": round(t_ingest, 1),
                         "search": round(t_search_phase, 1),

@@ -50,6 +50,47 @@ class MemoryConfig:
     # IngestCoalescer): facts from every buffered conversation merge into
     # mega-batches of at most this many rows per fused dispatch.
     ingest_coalesce_max: int = 8192
+    # Time/size flush policy for the coalescer (utils/batching.FlushPolicy):
+    # > 0 DEFERS small young mega-batches for up to this many seconds so a
+    # steady trickle of single conversations coalesces into dense fused
+    # dispatches instead of draining one conversation at a time. Deferred
+    # facts stay journaled (their source turns remain in the WAL) until
+    # ingested. 0 (default) = eager: every consolidation drains immediately.
+    ingest_flush_wait_s: float = 0.0
+    # Fold the dedup probe into the fused ingest program
+    # (state.ingest_dedup_fused): the masked pre-add top-1 + intra-batch
+    # gram that _ingest_facts otherwise pays a separate search_batch
+    # dispatch+readback for runs INSIDE the same donated dispatch, making
+    # ingest ONE round trip end-to-end. Only effective with ingest_fused.
+    ingest_dedup_fused: bool = True
+
+    # --- serving path (lazzaro_tpu/serve) ----------------------------------
+    # Fused single-dispatch retrieval (core/state.py search_fused): the
+    # per-chat-turn serving sequence — super-node top-1 gate, main-arena
+    # ANN top-k, CSR neighbor gather, neighbor- + access-salience boosts —
+    # runs as ONE donated device program + ONE packed readback, routed
+    # through the cross-request QueryScheduler so concurrent users share
+    # dense device batches. Off = the classic 3-4 dispatch sequence.
+    # Automatically bypassed under a mesh or when int8/IVF serving shadows
+    # are active (those paths have their own optimized scans).
+    serve_fused: bool = True
+    # QueryScheduler flush policy: a pending batch ships when it reaches
+    # serve_batch_max requests OR when its oldest request has waited
+    # serve_flush_us microseconds — bursty load coalesces, a lone request
+    # is never held hostage. Batches pad to power-of-two buckets so jit
+    # specializations stay bounded.
+    serve_batch_max: int = 64
+    serve_flush_us: int = 2000
+    # Neighbor-gather width of the fused retrieval kernel: at most this
+    # many CSR neighbors per retrieved row receive the neighbor-salience
+    # boost on device. Nodes with higher degree get a truncated boost set
+    # (bounded device work is the contract; raise for denser graphs).
+    serve_max_nbr: int = 32
+    # Deferred-boost accumulator cap: cache-hit chat turns queue (access,
+    # neighbor) boost counts host-side and flush them as ONE scatter at
+    # conversation end / save; the flush also triggers early past this
+    # many distinct nodes.
+    serve_boost_flush_max: int = 4096
 
     # --- behavior flags (parity with memory_system.py:63-84) ---------------
     enable_sharding: bool = True

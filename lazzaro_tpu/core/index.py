@@ -26,7 +26,7 @@ import numpy as np
 from lazzaro_tpu.core import state as S
 from lazzaro_tpu.ops import graphops
 from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
-                                        fetch_packed, pad_to_pow2)
+                                        fetch_packed, next_pow2, pad_to_pow2)
 
 
 class MemoryIndex:
@@ -122,6 +122,13 @@ class MemoryIndex:
         self._shards: Dict[str, int] = {}
         self.tenant_nodes: Dict[str, set] = {}
         self._mesh_topk_cache: Dict[int, object] = {}
+        # CSR adjacency shadow for the fused retrieval kernel: a device
+        # (indptr, neighbors) pair built from the HOST edge map (edge_slots
+        # + id_to_row — no device readback needed), invalidated by edge
+        # topology changes only (reinforce/decay touch weights, which the
+        # neighbor-boost semantics don't read).
+        self._csr_cache = None             # (rows, indptr_dev, nbr_dev)
+        self._csr_dirty = True
 
     # Compat views over the atomic pack (tests/bench poke these; assigning
     # ``_ivf = None`` drops the whole build, freeing members + residual).
@@ -588,7 +595,195 @@ class MemoryIndex:
             else:
                 self.edge_slots[key] = slot
         self._free_edge_slots.extend(reclaim)
+        self._csr_dirty = True
         return rows, candidates, created
+
+    def _apply_dedup_fused(self, *args, **kwargs):
+        """Dispatch ``S.ingest_dedup_fused`` over BOTH states under the
+        ownership gate (mirror of ``_apply_fused``); returns the kernel's
+        non-state outputs."""
+        with self._state_lock:
+            arena, edges = self._state, self._edge_state
+            sole = (sys.getrefcount(arena) <= self._SOLE_REFS
+                    and sys.getrefcount(edges) <= self._SOLE_REFS)
+            fn = S.ingest_dedup_fused if sole else S.ingest_dedup_fused_copy
+            new_arena, new_edges, flat = fn(arena, edges, *args, **kwargs)
+            del arena, edges
+            self.state = new_arena
+            self.edge_state = new_edges
+        return flat
+
+    def ingest_batch_dedup(self, embeddings: np.ndarray,
+                           saliences: Sequence[float],
+                           timestamps: Sequence[float],
+                           types: Sequence[str],
+                           shard_keys: Sequence[str],
+                           tenant: str,
+                           dedup_gate: float,
+                           chain_weight: float = 0.5,
+                           link_k: int = 3, link_gate: float = 0.5,
+                           link_scale: float = 0.8,
+                           shard_modes: Sequence[int] = (1, 0),
+                           now: Optional[float] = None) -> Optional[dict]:
+        """Truly single-round-trip ingest: the dedup probe (masked top-1
+        against the pre-add arena + intra-batch gram) that ``_ingest_facts``
+        used to pay a separate ``search_batch`` dispatch for runs INSIDE
+        the fused program (ROADMAP open item 2). Duplicate facts never
+        become nodes — the device merges them into their targets — and
+        chain edges connect consecutive LIVE same-shard facts on device.
+
+        Node ids are assigned by the caller AFTER the readback (so the id
+        counter advances exactly like the classic path, which only names
+        surviving facts): this method dispatches and returns a pending
+        dict; ``commit_ingest_dedup`` finishes the host bookkeeping."""
+        n = len(saliences)
+        shard_modes = tuple(shard_modes)
+        if n == 0:
+            return None
+        rows = self._alloc_rows(n)
+        tid = self.tenant_id(tenant)
+        k_eff = min(link_k, self.state.capacity)
+        n_modes = len(shard_modes)
+        slots = self._alloc_edge_slots(n + n_modes * n * k_eff)
+        chain_slot_list = slots[:n]
+        link_slot_list = slots[n:]
+
+        cap = self.state.capacity
+        ecap = self.edge_state.capacity
+        padded = S.pad_rows(np.asarray(rows, np.int32), cap)
+        b = len(padded)
+
+        def pad(vals, fill=0.0, dt=np.float32):
+            out = np.full((b,), fill, dt)
+            out[:n] = vals
+            return out
+
+        emb = np.zeros((b, self.dim), np.float32)
+        emb[:n] = np.asarray(embeddings, np.float32).reshape(n, self.dim)
+        emb[n:, 0] = 1.0  # sentinel rows get a unit vector (normalizable)
+
+        # densified chain group per fact: consecutive live facts of one
+        # shard group chain on device (dup facts bridge their neighbors)
+        gid_of: Dict[str, int] = {}
+        gids = [gid_of.setdefault(k or "default", len(gid_of))
+                for k in shard_keys]
+        chain_slots = np.full((b,), ecap, np.int32)
+        chain_slots[:n] = chain_slot_list
+        link_slots = np.full((n_modes, b, k_eff), ecap, np.int32)
+        link_slots_real = np.asarray(link_slot_list, np.int32
+                                     ).reshape(n_modes, n, k_eff)
+        link_slots[:, :n, :] = link_slots_real
+
+        now_abs = now if now is not None else time.time()
+        flat = self._apply_dedup_fused(
+            jnp.asarray(padded), jnp.asarray(emb),
+            jnp.asarray(pad([float(s) for s in saliences])),
+            jnp.asarray(pad([float(t) - self.epoch for t in timestamps])),
+            jnp.asarray(pad([S.TYPE_IDS.get(t, 0) for t in types], 0,
+                            np.int32)),
+            jnp.asarray(pad([self.shard_id(sk or "default")
+                             for sk in shard_keys], -1, np.int32)),
+            jnp.asarray(pad([tid] * n, -1, np.int32)),
+            jnp.asarray(pad([False] * n, False, bool)),
+            jnp.asarray(pad(gids, -1, np.int32)),
+            jnp.asarray(chain_slots), jnp.asarray(link_slots),
+            jnp.float32(now_abs - self.epoch), jnp.int32(tid),
+            jnp.float32(dedup_gate), jnp.float32(chain_weight),
+            jnp.float32(link_gate), jnp.float32(link_scale),
+            k=k_eff, shard_modes=shard_modes)
+        self._int8_dirty = True
+        self._pq_dirty = True
+        host = fetch_packed(*flat)             # the ONE readback
+        return {
+            "rows": rows, "n": n, "k_eff": k_eff,
+            "shard_modes": shard_modes, "link_scale": link_scale,
+            "tenant": tenant,
+            "dup": host[0][:n, 0] > 0,
+            "target_rows": host[1][:n, 0],
+            "chain_src": host[2][:n, 0],
+            "chain_slots": chain_slot_list,
+            "link_slots": link_slots_real,
+            "link_host": host[3:],
+        }
+
+    def commit_ingest_dedup(self, pending: dict, ids: Sequence[Optional[str]]
+                            ) -> Tuple[Dict, Dict, List, List]:
+        """Finish host bookkeeping for ``ingest_batch_dedup``: register the
+        surviving facts' ids, free duplicate rows, keep/reclaim edge slots
+        per the device's gate verdicts. ``ids[i]`` names fact ``i`` and is
+        ignored (may be None) where the device found a duplicate.
+
+        Returns ``(candidates, created, merges, chains)``:
+          candidates — {mode: {id: [(cand_id, score), ...]}} full lists
+          created    — {mode: [(src_id, tgt_id, weight), ...]} link edges
+          merges     — [(fact_idx, target_id)] device-merged duplicates
+          chains     — [(src_id, tgt_id)] chain edges the device inserted
+        """
+        n = pending["n"]
+        rows = pending["rows"]
+        dup = pending["dup"]
+        tenant = pending["tenant"]
+        reclaim: List[int] = []
+        live_rows: List[int] = []
+        for i in range(n):
+            if dup[i]:
+                self._free_rows.append(rows[i])   # never became alive
+                continue
+            qid = ids[i]
+            self.id_to_row[qid] = rows[i]
+            self.row_to_id[rows[i]] = qid
+            live_rows.append(rows[i])
+        self.tenant_nodes.setdefault(tenant, set()).update(
+            ids[i] for i in range(n) if not dup[i])
+        merges = [(i, self.row_to_id.get(int(pending["target_rows"][i])))
+                  for i in range(n) if dup[i]]
+        chains: List[Tuple[str, str]] = []
+        chain_src = pending["chain_src"]
+        for i, slot in enumerate(pending["chain_slots"]):
+            src_id = (self.row_to_id.get(int(chain_src[i]))
+                      if chain_src[i] >= 0 else None)
+            key = (src_id, ids[i]) if src_id and not dup[i] else None
+            if key is not None and key not in self.edge_slots:
+                self.edge_slots[key] = slot
+                chains.append(key)
+            else:
+                reclaim.append(slot)
+        candidates: Dict[int, Dict[str, List[Tuple[str, float]]]] = {}
+        created: Dict[int, List[Tuple[str, str, float]]] = {}
+        host = pending["link_host"]
+        link_slots_real = pending["link_slots"]
+        k_eff = pending["k_eff"]
+        link_scale = pending["link_scale"]
+        for mi, sm in enumerate(pending["shard_modes"]):
+            sc, cd, lv = host[3 * mi], host[3 * mi + 1], host[3 * mi + 2]
+            out_m: Dict[str, List[Tuple[str, float]]] = {}
+            made: List[Tuple[str, str, float]] = []
+            for bi in range(n):
+                nid = ids[bi]
+                pairs = []
+                for j in range(k_eff):
+                    slot = int(link_slots_real[mi, bi, j])
+                    s = float(sc[bi, j])
+                    cid = (self.row_to_id.get(int(cd[bi, j]))
+                           if s > S.NEG_INF / 2 else None)
+                    if cid is not None and not dup[bi]:
+                        pairs.append((cid, s))
+                    key = (nid, cid)
+                    if lv[bi, j] > 0.5 and cid is not None and not dup[bi] \
+                            and key not in self.edge_slots:
+                        self.edge_slots[key] = slot
+                        made.append((nid, cid,
+                                     min(1.0, max(0.0, s * link_scale))))
+                    else:
+                        reclaim.append(slot)
+                if not dup[bi]:
+                    out_m[nid] = pairs
+            candidates[sm] = out_m
+            created[sm] = made
+        self._free_edge_slots.extend(reclaim)
+        self._csr_dirty = True
+        self._ivf_note_added(live_rows)
+        return candidates, created, merges, chains
 
     def delete(self, ids: Iterable[str]) -> None:
         ids = list(ids)
@@ -640,6 +835,7 @@ class MemoryIndex:
                 if k[0] not in self.id_to_row or k[1] not in self.id_to_row]
         for k in dead:
             self._free_edge_slots.append(self.edge_slots.pop(k))
+        self._csr_dirty = True
 
     def search(self, query: np.ndarray, tenant: str, k: int = 10,
                super_filter: int = 0, exact: bool = False
@@ -889,6 +1085,175 @@ class MemoryIndex:
                 if int8 else
                 make_sharded_topk(self.mesh, self.shard_axis, k=k, impl="auto"))
         return self._mesh_topk_cache[key]
+
+    # ------------------------------------------------- fused retrieval path
+    def _csr_for(self, st: S.ArenaState):
+        """Device CSR view of the edge arena for the fused neighbor gather:
+        ``indptr`` [rows+1] i32 and ``nbr`` [E_pad] i32 (bidirectional,
+        -1-padded). Built entirely from host bookkeeping (edge_slots ×
+        id_to_row) — no device readback — and re-uploaded only after an
+        edge-topology change. The dirty flag is cleared BEFORE the build,
+        so a writer racing past us re-dirties and the next serve rebuilds."""
+        n = st.emb.shape[0]
+        cache = self._csr_cache
+        if cache is not None and not self._csr_dirty and cache[0] == n:
+            return cache[1], cache[2]
+        self._csr_dirty = False
+        keys = list(self.edge_slots.keys())
+        src_l, dst_l = [], []
+        for qsrc, qtgt in keys:
+            s = self.id_to_row.get(qsrc)
+            t = self.id_to_row.get(qtgt)
+            if s is None or t is None:
+                continue
+            src_l.append(s)
+            dst_l.append(t)
+        if src_l:
+            a = np.asarray(src_l, np.int64)
+            b = np.asarray(dst_l, np.int64)
+            src = np.concatenate([a, b])
+            dst = np.concatenate([b, a])
+            order = np.argsort(src, kind="stable")
+            src, dst = src[order], dst[order]
+        else:
+            src = dst = np.zeros((0,), np.int64)
+        indptr = np.zeros((n + 1,), np.int32)
+        indptr[1:] = np.cumsum(np.bincount(src, minlength=n))
+        nbr = np.full((max(8, next_pow2(len(dst))),), -1, np.int32)
+        nbr[:len(dst)] = dst
+        dev = (jnp.asarray(indptr), jnp.asarray(nbr))
+        self._csr_cache = (n, dev[0], dev[1])
+        return dev
+
+    def search_fused_requests(self, reqs, *, cap_take: int, max_nbr: int,
+                              super_gate: float, acc_boost: float,
+                              nbr_boost: float,
+                              now: Optional[float] = None) -> List:
+        """Serve a coalesced batch of ``serve.RetrievalRequest``s with ONE
+        device dispatch + ONE packed readback: masked super-node top-1
+        gate, main-arena ANN top-k, CSR neighbor gather, and the neighbor-
+        salience + access-salience boosts for every query that asked
+        (donated scatter, ``*_copy`` twin under the refcount gate — PR 1's
+        ownership rules). Pure-read batches (no boosts requested) take the
+        non-donating ``search_fused_read`` twin. Per-request tenants ride
+        into the kernel as a device column, so one batch can serve many
+        tenants with mask-enforced isolation."""
+        from lazzaro_tpu.serve.scheduler import RetrievalResult
+
+        nq = len(reqs)
+        if nq == 0:
+            return []
+        results = [RetrievalResult() for _ in range(nq)]
+        if not self.id_to_row:
+            return results
+        st = self.state
+        cap = st.capacity
+        dim = self.dim
+        k_eff = max(cap_take, max((min(int(r.k), cap) for r in reqs),
+                                  default=1), 1)
+        k_bucket = min(max(next_pow2(k_eff), 1), cap)
+        q = np.zeros((nq, dim), np.float32)
+        valid = np.zeros((nq,), bool)
+        tenants = np.full((nq,), -1, np.int32)
+        gate_on = np.zeros((nq,), bool)
+        boost_on = np.zeros((nq,), bool)
+        for i, r in enumerate(reqs):
+            v = np.asarray(r.query, np.float32).reshape(-1)
+            tid = self._tenants.get(r.tenant)
+            if v.size != dim or tid is None:
+                continue
+            q[i] = v
+            valid[i] = True
+            tenants[i] = tid
+            gate_on[i] = bool(r.gate_enabled)
+            boost_on[i] = bool(r.boost)
+        if not valid.any():
+            return results
+        qp = pad_to_pow2(q)
+        pad_n = qp.shape[0]
+
+        def padb(arr, fill=False, dt=bool):
+            out = np.full((pad_n,), fill, dt)
+            out[:nq] = arr
+            return out
+
+        indptr, nbr = self._csr_for(st)
+        args = (indptr, nbr, jnp.asarray(qp),
+                jnp.asarray(padb(valid)),
+                jnp.asarray(padb(tenants, -1, np.int32)),
+                jnp.asarray(padb(gate_on)))
+        statics = dict(k=k_bucket, cap_take=cap_take, max_nbr=max_nbr)
+        if boost_on.any():
+            del st      # a live snapshot would trip the sole-owner gate
+            now_rel = (now if now is not None else time.time()) - self.epoch
+            with self._state_lock:
+                cur = self._state
+                fn = (S.search_fused
+                      if sys.getrefcount(cur) <= self._SOLE_REFS
+                      else S.search_fused_copy)
+                new_state, packed = fn(
+                    cur, *args, jnp.asarray(padb(boost_on)),
+                    jnp.float32(now_rel), jnp.float32(super_gate),
+                    jnp.float32(acc_boost), jnp.float32(nbr_boost),
+                    **statics)
+                del cur
+                self.state = new_state
+        else:
+            packed = S.search_fused_read(st, *args,
+                                         jnp.float32(super_gate), **statics)
+        host = np.asarray(packed)              # the ONE readback
+        k = k_bucket
+        ann_s = host[:nq, 2:2 + k]
+        ann_r = np.ascontiguousarray(host[:nq, 2 + k:2 + 2 * k]).view(np.int32)
+        gate_s = host[:nq, 0]
+        gate_r = np.ascontiguousarray(host[:nq, 1:2]).view(np.int32)[:, 0]
+        fast = host[:nq, 2 + 2 * k] > 0.5
+        for i, r in enumerate(reqs):
+            if not valid[i]:
+                continue
+            res = results[i]
+            ids, scores = decode_topk(ann_s[i:i + 1], ann_r[i:i + 1],
+                                      self.row_to_id, S.NEG_INF,
+                                      limit=min(int(r.k), cap))[0]
+            res.ids, res.scores = ids, scores
+            if gate_s[i] > S.NEG_INF / 2:
+                res.gate_id = self.row_to_id.get(int(gate_r[i]))
+                res.gate_score = float(gate_s[i])
+            res.fast = bool(fast[i])
+            res.boosted = bool(boost_on[i] and not fast[i])
+        return results
+
+    def apply_boosts(self, entries: Dict[str, Tuple[int, int, float]],
+                     acc_boost: float, nbr_boost: float) -> None:
+        """Flush deferred (access_count, neighbor_count, latest_now) boost
+        accumulators — many cache-hit chat turns' worth of salience
+        bookkeeping — in ONE donated scatter (``arena_apply_boosts``).
+        Positive capped adds commute, so the summed counts reproduce the
+        serial per-turn sequence exactly."""
+        rows, accs, nbrs, nows = [], [], [], []
+        for qid, (acc, nbr, now) in entries.items():
+            r = self.id_to_row.get(qid)
+            if r is None:
+                continue
+            rows.append(r)
+            accs.append(int(acc))
+            nbrs.append(int(nbr))
+            nows.append(float(now) - self.epoch)
+        if not rows:
+            return
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
+        b = len(padded)
+        acc_arr = np.zeros((b,), np.int32)
+        acc_arr[:len(accs)] = accs
+        nbr_arr = np.zeros((b,), np.int32)
+        nbr_arr[:len(nbrs)] = nbrs
+        now_arr = np.full((b,), S.NEG_INF, np.float32)   # pad: .max() no-op
+        now_arr[:len(nows)] = nows
+        self._apply_arena(
+            S.arena_apply_boosts, S.arena_apply_boosts_copy,
+            jnp.asarray(padded), jnp.asarray(acc_arr), jnp.asarray(nbr_arr),
+            jnp.asarray(now_arr), jnp.float32(acc_boost),
+            jnp.float32(nbr_boost))
 
     # ------------------------------------------------------- numeric sweeps
     def update_access(self, ids: Sequence[str], boost: float = 0.05,
@@ -1149,6 +1514,7 @@ class MemoryIndex:
             slots = self._alloc_edge_slots(len(new))
             for (key, _), slot in zip(new, slots):
                 self.edge_slots[key] = slot
+            self._csr_dirty = True
             cap = self.edge_state.capacity
             padded = S.pad_rows(np.asarray(slots, np.int32), cap)
             b = len(padded)
@@ -1192,6 +1558,8 @@ class MemoryIndex:
             if pruned[slot]:
                 removed.append(key)
                 self._free_edge_slots.append(self.edge_slots.pop(key))
+        if removed:
+            self._csr_dirty = True
         return removed
 
     def edge_weights(self) -> Dict[Tuple[str, str], Tuple[float, int]]:

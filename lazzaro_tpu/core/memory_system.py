@@ -40,6 +40,7 @@ from lazzaro_tpu.core.providers import (HashingEmbedder, HeuristicLLM,
 from lazzaro_tpu.core.query_cache import QueryCache
 from lazzaro_tpu.core.store import ArrowStore
 from lazzaro_tpu.models.graph import Edge, Node
+from lazzaro_tpu.serve import QueryScheduler, RetrievalRequest
 from lazzaro_tpu.utils.batching import IngestCoalescer
 
 
@@ -144,8 +145,21 @@ class MemorySystem:
         self._inflight_batches: List[Dict] = []   # popped but not yet durable
         # Cross-conversation fact batcher: extracted facts from every
         # buffered conversation coalesce into bounded mega-batches, each
-        # ingested by ONE fused device dispatch (cfg.ingest_fused).
-        self._ingest_coalescer = IngestCoalescer(cfg.ingest_coalesce_max)
+        # ingested by ONE fused device dispatch (cfg.ingest_fused). With
+        # ingest_flush_wait_s > 0 the coalescer's time/size policy DEFERS
+        # small young batches so trickle load coalesces too.
+        self._ingest_coalescer = IngestCoalescer(cfg.ingest_coalesce_max,
+                                                 cfg.ingest_flush_wait_s)
+        # Serving path: the cross-request query scheduler (lazy — the
+        # worker thread spawns on first fused retrieval) and the deferred
+        # boost accumulator for cache-hit turns (node_id -> [access_count,
+        # neighbor_count, latest_now]; flushed as ONE scatter).
+        self.query_scheduler: Optional[QueryScheduler] = None
+        self._pending_boosts: Dict[str, List] = {}
+        # Conversations whose facts the ingest flush policy deferred into
+        # the coalescer: their source turns stay journaled (WAL) until the
+        # facts actually land in the arena.
+        self._deferred_batches: List[Dict] = []
 
         # Incremental persistence state. Mutation paths record which node
         # ids / edge keys changed since the last save; saves then upsert only
@@ -247,7 +261,8 @@ class MemorySystem:
         if self._journal is None:
             return
         turns: List[Dict] = []
-        for batch in self._inflight_batches + self.consolidation_queue:
+        for batch in (self._deferred_batches + self._inflight_batches
+                      + self.consolidation_queue):
             turns.extend(batch.get("memories", []))
         if self.conversation_active:
             turns.extend(self.short_term_memory)
@@ -495,6 +510,9 @@ class MemorySystem:
             results.append(f"✓ Consolidation complete. Memory: {nodes} nodes, {edges} edges")
 
         with self._mutex:
+            # Deferred cache-hit boosts land BEFORE the decay sweep, so the
+            # batched flush reproduces the classic boost-then-decay order.
+            self._flush_pending_boosts_locked()
             self.index.decay(self.user_id, self.config.decay_rate,
                              self.config.salience_floor)
             self._decay_pass += 1
@@ -547,13 +565,14 @@ class MemorySystem:
         self.conversation_history.append({"role": "user", "content": user_message})
 
         query_emb = self._get_embedding(user_message)
-        retrieved_ids = self._optimized_retrieval(query_emb, user_message)
-        self._boost_neighbors(retrieved_ids)
+        retrieved_ids, boost_mode = self._retrieve_for_chat(query_emb,
+                                                            user_message)
+        self._boost_neighbors(retrieved_ids, mode=boost_mode)
 
         retrieval_time = (time.time() - start_time) * 1000
         self.metrics["retrieval_times"].append(retrieval_time)
 
-        messages = self._assemble_messages(retrieved_ids)
+        messages = self._assemble_messages(retrieved_ids, mode=boost_mode)
         response = self._call_llm(messages)
         self.add_to_short_term(response, "semantic", salience=0.5)
         self.conversation_history.append({"role": "assistant", "content": response})
@@ -580,8 +599,9 @@ class MemorySystem:
         self.conversation_history.append({"role": "user", "content": user_message})
 
         query_emb = self._get_embedding(user_message)
-        retrieved_ids = self._optimized_retrieval(query_emb, user_message)
-        self._boost_neighbors(retrieved_ids)
+        retrieved_ids, boost_mode = self._retrieve_for_chat(query_emb,
+                                                            user_message)
+        self._boost_neighbors(retrieved_ids, mode=boost_mode)
 
         retrieval_time = (time.time() - start_time) * 1000
         self.metrics["retrieval_times"].append(retrieval_time)
@@ -589,7 +609,7 @@ class MemorySystem:
         yield {"type": "info",
                "content": f"[{emoji} Retrieval: {retrieval_time:.0f}ms, Retrieved: {len(retrieved_ids)} nodes]"}
 
-        messages = self._assemble_messages(retrieved_ids)
+        messages = self._assemble_messages(retrieved_ids, mode=boost_mode)
         self.metrics["llm_calls"] += 1
         chunks: List[str] = []
         if hasattr(self.llm, "completion_stream"):
@@ -604,7 +624,14 @@ class MemorySystem:
         self.add_to_short_term(response, "semantic", salience=0.5)
         self.conversation_history.append({"role": "assistant", "content": response})
 
-    def _assemble_messages(self, retrieved_ids: List[str]) -> List[Dict[str, str]]:
+    def _assemble_messages(self, retrieved_ids: List[str],
+                           mode: str = "classic") -> List[Dict[str, str]]:
+        """``mode`` says who pays the access-boost device scatter:
+        "classic" dispatches it here (the pre-fused behavior), "device"
+        means the fused retrieval kernel already applied it in the same
+        dispatch that found the ids, and "deferred" (query-cache hits)
+        accumulates counts for one batched flush — a cached turn costs
+        ZERO device round trips. Host copies update in every mode."""
         context_parts = []
         profile_context = self.profile.get_context()
         if profile_context and profile_context != "No profile data yet.":
@@ -620,9 +647,14 @@ class MemorySystem:
                     access_ids.append(nid)
             if access_ids:
                 with self._mutex:
-                    self.index.update_access(
-                        [self._q(n) for n in access_ids],
-                        boost=self.config.access_salience_boost)
+                    if mode == "classic":
+                        self.index.update_access(
+                            [self._q(n) for n in access_ids],
+                            boost=self.config.access_salience_boost)
+                    elif mode == "deferred":
+                        now = time.time()
+                        for nid in access_ids:
+                            self._queue_boost(nid, acc=1, now=now)
                     self._mark_dirty(*access_ids)
                 for nid in access_ids:
                     self.buffer.update_access(nid, self.config.access_salience_boost)
@@ -698,7 +730,13 @@ class MemorySystem:
             self.query_cache.set_results(query_text, final)
         return final
 
-    def _boost_neighbors(self, retrieved_ids: List[str]) -> None:
+    def _boost_neighbors(self, retrieved_ids: List[str],
+                         mode: str = "classic") -> None:
+        """Associative neighbor boost. ``mode`` as in
+        ``_assemble_messages``: "device" skips the dispatch (the fused
+        kernel's CSR gather already scattered it), "deferred" queues
+        counts for the batched flush; host-side Node copies and dirty
+        marks update in every mode."""
         neighbors: Set[str] = set()
         for nid in retrieved_ids:
             neighbors.update(self.buffer.get_neighbors(nid))
@@ -707,8 +745,12 @@ class MemorySystem:
             return
         now = time.time()
         with self._mutex:
-            self.index.boost([self._q(n) for n in to_boost],
-                             self.config.neighbor_salience_boost, now)
+            if mode == "classic":
+                self.index.boost([self._q(n) for n in to_boost],
+                                 self.config.neighbor_salience_boost, now)
+            elif mode == "deferred":
+                for n in to_boost:
+                    self._queue_boost(n, nbr=1, now=now)
             self._mark_dirty(*to_boost)
         count = 0
         for nid in to_boost:
@@ -719,6 +761,145 @@ class MemorySystem:
                 count += 1
         if count:
             self._log(f"   (Graph: Boosted {count} neighbor nodes via association)")
+
+    # ----------------------------------------------------------- fused serving
+    def _use_fused_serving(self) -> bool:
+        """Fused retrieval serves the exact single-chip arena: under a mesh
+        the shard_map searcher owns the path, and the int8/IVF serving
+        shadows run their own optimized scans the fused kernel would
+        silently bypass."""
+        return (self.config.serve_fused and self.mesh is None
+                and not self.index.int8_serving
+                and not self.index.ivf_nprobe)
+
+    def _ensure_scheduler(self) -> QueryScheduler:
+        """Lazily spawn the cross-request query scheduler (one worker thread
+        per system; it also keeps donated state mutation single-writer on
+        the serving side)."""
+        sched = self.query_scheduler
+        if sched is not None and not sched.closed:
+            return sched
+        with self._mutex:
+            sched = self.query_scheduler
+            if sched is None or sched.closed:
+                sched = QueryScheduler(
+                    self._serve_requests,
+                    max_batch=self.config.serve_batch_max,
+                    max_wait_us=self.config.serve_flush_us)
+                self.query_scheduler = sched
+        return sched
+
+    def _serve_requests(self, reqs: List[RetrievalRequest]):
+        """Scheduler executor: ONE fused device dispatch + ONE packed
+        readback for the whole coalesced batch."""
+        return self.index.search_fused_requests(
+            reqs, cap_take=self.config.retrieval_cap,
+            max_nbr=self.config.serve_max_nbr,
+            super_gate=self.config.super_node_gate,
+            acc_boost=self.config.access_salience_boost,
+            nbr_boost=self.config.neighbor_salience_boost)
+
+    def _retrieve_for_chat(self, query_emb: List[float],
+                           query_text: str) -> Tuple[List[str], str]:
+        """Chat-turn retrieval front door. Returns ``(ids, boost_mode)``:
+
+        - query-cache hit → "deferred": ZERO device round trips this turn;
+          the access/neighbor boosts accumulate host-side and flush later
+          as one batched scatter (cached hits used to pay the full device
+          boost sequence anyway).
+        - fused serving → "device" when the kernel applied both boosts in
+          the same dispatch that found the ids, or "classic" when the
+          super-gate fired (the host owns the hierarchy fast path and pays
+          the classic boosts for exact parity).
+        - otherwise → the classic multi-dispatch ``_optimized_retrieval``.
+        """
+        if self.query_cache:
+            cached = self.query_cache.get_results(query_text)
+            if cached:
+                return cached, "deferred"
+        if not self._use_fused_serving():
+            return self._optimized_retrieval(query_emb, query_text), "classic"
+        req = RetrievalRequest(
+            query=np.asarray(query_emb, np.float32),
+            tenant=self.user_id, k=self.config.ann_limit,
+            gate_enabled=bool(self.enable_hierarchy and self.super_nodes),
+            boost=True)
+        res = self._ensure_scheduler().submit(req).result()
+        final = self._merge_fused_retrieval(res, query_text)
+        return final, ("device" if res.boosted else "classic")
+
+    def _merge_fused_retrieval(self, res, query_text: str) -> List[str]:
+        """Host half of the fused chat retrieval: the same hierarchy-children
+        expansion and content-dedup merge as ``_optimized_retrieval``, fed
+        from the kernel's packed (gate, ANN) result instead of two separate
+        device searches."""
+        retrieved: List[str] = []
+        if res.fast and res.gate_id is not None:
+            best = self.super_nodes.get(res.gate_id.partition(":")[2])
+            if best is not None:
+                for child_id in best.child_ids[:self.config.hierarchy_children]:
+                    child = self.buffer.get_node(child_id)
+                    if child and not child.is_super_node:
+                        retrieved.append(child_id)
+                if len(retrieved) >= self.config.retrieval_cap:
+                    result = retrieved[:self.config.retrieval_cap]
+                    if self.query_cache:
+                        self.query_cache.set_results(query_text, result)
+                    return result
+        vector_ids = [v.partition(":")[2] for v in res.ids]
+        seen_ids: Set[str] = set(retrieved)
+        seen_content: Set[str] = set()
+        final: List[str] = []
+        for rid in retrieved:
+            node = self.buffer.get_node(rid)
+            if node:
+                seen_content.add(node.content)
+                final.append(rid)
+        for rid in vector_ids:
+            if rid in seen_ids:
+                continue
+            node = self.buffer.get_node(rid)
+            if node and node.content not in seen_content:
+                seen_content.add(node.content)
+                final.append(rid)
+                seen_ids.add(rid)
+        final = final[:self.config.retrieval_cap]
+        if self.query_cache:
+            self.query_cache.set_results(query_text, final)
+        return final
+
+    def _queue_boost(self, node_id: str, acc: int = 0, nbr: int = 0,
+                     now: Optional[float] = None) -> None:
+        """Accumulate a deferred boost for ``node_id`` (callers hold
+        ``self._mutex``). Cache-hit chat turns queue counts here instead of
+        paying a device dispatch; ``_flush_pending_boosts`` applies many
+        turns' worth in ONE donated scatter."""
+        ent = self._pending_boosts.get(node_id)
+        if ent is None:
+            ent = self._pending_boosts[node_id] = [0, 0, 0.0]
+        ent[0] += acc
+        ent[1] += nbr
+        ent[2] = max(ent[2], now if now is not None else time.time())
+        if len(self._pending_boosts) >= self.config.serve_boost_flush_max:
+            self._flush_pending_boosts_locked()
+
+    def _flush_pending_boosts(self) -> None:
+        with self._mutex:
+            self._flush_pending_boosts_locked()
+
+    def _flush_pending_boosts_locked(self) -> None:
+        """Apply every queued (access, neighbor) boost count as one donated
+        scatter. Runs before anything that READS arena salience — decay,
+        eviction scoring, consolidation, and saves (``_sync_from_arena``
+        would otherwise overwrite boosted host copies with stale arena
+        values)."""
+        if not self._pending_boosts:
+            return
+        entries = {self._q(nid): (acc, nbr, ts)
+                   for nid, (acc, nbr, ts) in self._pending_boosts.items()}
+        self._pending_boosts.clear()
+        self.index.apply_boosts(entries, self.config.access_salience_boost,
+                                self.config.neighbor_salience_boost)
 
     # ---------------------------------------------------------- consolidation
     _EXTRACTION_PROMPT = """Extract distinct, atomic facts from this conversation.
@@ -781,6 +962,18 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         # back bounded mega-batches — each ingested by ONE fused dispatch.
         # A split (huge extraction) is logged, never silent.
         self._ingest_coalescer.add_conversation(memories)
+        if not self._ingest_coalescer.should_flush():
+            # Time/size policy says wait (trickle load, ingest_flush_wait_s
+            # > 0): the facts stay buffered for a denser fused dispatch and
+            # their source turns stay journaled via _deferred_batches until
+            # they actually land in the arena.
+            with self._mutex:
+                self._deferred_batches.extend(self._inflight_batches)
+                self._inflight_batches.clear()
+                self._journal_sync()
+            self._log(f"⏳ Ingest deferred: {len(self._ingest_coalescer)} "
+                      "facts buffered by the flush policy")
+            return
         mega_batches = self._ingest_coalescer.drain()
         if len(mega_batches) > 1:
             self._log(f"   (ingest split into {len(mega_batches)} mega-"
@@ -828,6 +1021,14 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 if len(content) < 5:
                     continue
                 staged.append((mem, content, new_emb))
+
+            if (self.config.ingest_fused and self.config.ingest_dedup_fused
+                    and staged
+                    and all(e.size == self.embed_dim for _, _, e in staged)):
+                # Truly single-round-trip ingest: the dedup probe below
+                # (pre-add top-1 + intra-batch gram) rides INSIDE the fused
+                # device program instead of paying its own dispatch.
+                return self._ingest_facts_dedup_fused(staged)
 
             probe: List[Tuple[Optional[str], float]] = [(None, 0.0)] * len(staged)
             probeable = [i for i, (_, _, e) in enumerate(staged)
@@ -1026,6 +1227,106 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 self._link_to_existing_memories(new_nodes, link_cands[0])
         return new_nodes
 
+    def _ingest_facts_dedup_fused(
+            self, staged: List[Tuple[Dict, str, np.ndarray]]
+    ) -> List[Tuple[str, str]]:
+        """Device-dedup mega-batch ingest (caller holds ``self._mutex``):
+        the dedup probe, node scatter, merge touch, chain edges, link scan,
+        and gated edge insert all run in ONE donated device dispatch
+        (``state.ingest_dedup_fused``) with ONE packed readback; the host
+        only finishes id bookkeeping afterwards. Node ids are assigned from
+        the readback's dup verdicts, so the counter advances exactly like
+        the classic path (which only names surviving facts)."""
+        cfg = self.config
+        now = time.time()
+        shard_keys: List[str] = []
+        for mem, content, _ in staged:
+            sk = mem.get("topic") or self._infer_shard_key(content)
+            if sk == "other":
+                sk = self._infer_shard_key(content)
+            shard_keys.append(sk)
+        emb_matrix = np.stack([e for _, _, e in staged]).astype(np.float32)
+        saliences = [float(m.get("salience", 0.5)) for m, _, _ in staged]
+        types = [m.get("type", "semantic") for m, _, _ in staged]
+        pending = self.index.ingest_batch_dedup(
+            emb_matrix, saliences, [now] * len(staged), types, shard_keys,
+            tenant=self.user_id, dedup_gate=cfg.dedup_similarity,
+            chain_weight=cfg.chain_link_weight,
+            link_k=cfg.cross_link_top_k, link_gate=cfg.link_gate,
+            link_scale=cfg.link_weight_scale, shard_modes=(1, 0), now=now)
+        if pending is None:
+            return []
+        dup = pending["dup"]
+        ids = [None if dup[i] else self._q(self._generate_node_id())
+               for i in range(len(staged))]
+        _cands, created, merges, chains = \
+            self.index.commit_ingest_dedup(pending, ids)
+
+        def _unq(qid: str) -> str:
+            return qid.partition(":")[2]
+
+        new_nodes: List[Tuple[str, str]] = []
+        survivors: List[Tuple[Node, np.ndarray]] = []
+        for i, (mem, content, e) in enumerate(staged):
+            if dup[i]:
+                continue
+            node = Node(
+                id=_unq(ids[i]),
+                content=content,
+                embedding=None,          # the arena owns the vector
+                type=types[i],
+                salience=saliences[i],
+                timestamp=now,
+                shard_key=shard_keys[i],
+            )
+            self._get_or_create_shard(shard_keys[i]).add_node(node)
+            survivors.append((node, e))
+            new_nodes.append((node.id, shard_keys[i]))
+        # Device-merged duplicates: mirror the arena's merge touch on the
+        # host copy (max salience, access+1, fresh last_accessed).
+        for i, target_qid in merges:
+            tgt = (self.buffer.get_node(_unq(target_qid))
+                   if target_qid else None)
+            if tgt is None:
+                continue
+            tgt.salience = max(tgt.salience, saliences[i])
+            tgt.last_accessed = now
+            tgt.access_count += 1
+            self._mark_dirty(tgt.id)
+            self._log(f"   (Merged semantic duplicate into {tgt.id})")
+        if survivors:
+            s_matrix = np.stack([e for _, e in survivors])
+            if hasattr(self.store, "add_nodes_columns"):
+                self.store.add_nodes_columns(
+                    ids=[n.id for n, _ in survivors],
+                    contents=[n.content for n, _ in survivors],
+                    embeddings=s_matrix,
+                    types=[n.type for n, _ in survivors],
+                    saliences=[n.salience for n, _ in survivors],
+                    timestamps=[n.timestamp for n, _ in survivors],
+                    shard_keys=[n.shard_key or "" for n, _ in survivors],
+                    decay_pass=self._decay_pass,
+                    user_id=self.user_id)
+            else:
+                self.store.add_nodes([{
+                    "id": n.id, "content": n.content,
+                    "embedding": e.tolist(), "type": n.type,
+                    "salience": n.salience, "shard_key": n.shard_key,
+                    "timestamp": n.timestamp,
+                    "decay_pass": self._decay_pass,
+                } for n, e in survivors], user_id=self.user_id)
+        # Edges the device already inserted — host bookkeeping only.
+        chain_edges = [Edge(source=_unq(s), target=_unq(t),
+                            weight=cfg.chain_link_weight)
+                       for s, t in chains]
+        sim_edges = [Edge(source=_unq(s), target=_unq(t), weight=w)
+                     for sm in (1, 0) for s, t, w in created.get(sm, [])]
+        self._register_edges_host(chain_edges + sim_edges)
+        n_cross = len(created.get(0, []))
+        if n_cross:
+            self._log(f"✓ Created {n_cross} cross-conversation links")
+        return new_nodes
+
     def _finish_consolidation(self, new_nodes: List[Tuple[str, str]],
                               start_time: float) -> None:
         self._enforce_buffer_limit()
@@ -1055,8 +1356,10 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         with self._mutex:
             # The consolidated batches are durable; the WAL shrinks to
             # whatever is still pending (e.g. a conversation started while
-            # the LLM call ran).
+            # the LLM call ran). A drain ingests every deferred fact too,
+            # so the flush-policy backlog retires with it.
             self._inflight_batches.clear()
+            self._deferred_batches.clear()
             self._journal_sync()
 
     def _requeue_inflight(self) -> None:
@@ -1215,6 +1518,8 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             nodes, _ = self.buffer.size()
             if nodes <= self.max_buffer_size:
                 return
+            # eviction scores read arena salience — land queued boosts first
+            self._flush_pending_boosts_locked()
             excess = nodes - self.max_buffer_size
             cands = self.index.evict_candidates(self.user_id, excess)
             removed_ids = []
@@ -1252,6 +1557,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                           persist: bool = True) -> str:
         results = []
         self._log("🔄 Running consolidation...")
+        self._flush_pending_boosts()   # consolidation reads arena salience
 
         if merge_similar:
             merged = self._merge_similar_nodes(self.config.merge_similarity)
@@ -1450,8 +1756,16 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
     # ----------------------------------------------------------------- search
     def search_memories(self, query: str, limit: int = 5) -> List[Node]:
         query_emb = self._get_embedding(query)
-        ids, _ = self.index.search(np.asarray(query_emb, np.float32),
-                                   self.user_id, k=limit, super_filter=-1)
+        if self._use_fused_serving():
+            # Route through the scheduler: a lone call pays at most the
+            # flush wait; concurrent callers coalesce into one dispatch.
+            res = self._ensure_scheduler().submit(RetrievalRequest(
+                query=np.asarray(query_emb, np.float32),
+                tenant=self.user_id, k=limit)).result()
+            ids = res.ids
+        else:
+            ids, _ = self.index.search(np.asarray(query_emb, np.float32),
+                                       self.user_id, k=limit, super_filter=-1)
         results = []
         for qid in ids:
             node = self.buffer.get_node(qid.partition(":")[2])
@@ -1463,12 +1777,21 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                               ) -> List[List[Node]]:
         """Fleet-serving variant of ``search_memories``: ONE batched encoder
         forward + ONE batched top-k kernel for all queries (per-query
-        dispatch amortized — the reason the index lives in HBM)."""
+        dispatch amortized — the reason the index lives in HBM). With fused
+        serving the fleet rides the QueryScheduler, so it shares device
+        batches with any concurrent chat retrievals (submit_many keeps the
+        group contiguous and demuxes results in order)."""
         if not queries:
             return []
         embs = np.asarray(self._batch_embed(list(queries)), np.float32)
-        per_query = self.index.search_batch(embs, self.user_id, k=limit,
-                                            super_filter=-1)
+        if self._use_fused_serving():
+            reqs = [RetrievalRequest(query=embs[i], tenant=self.user_id,
+                                     k=limit) for i in range(len(queries))]
+            futures = self._ensure_scheduler().submit_many(reqs)
+            per_query = [(f.result().ids, f.result().scores) for f in futures]
+        else:
+            per_query = self.index.search_batch(embs, self.user_id, k=limit,
+                                                super_filter=-1)
         results: List[List[Node]] = []
         for ids, _scores in per_query:
             nodes = []
@@ -1522,6 +1845,9 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         protocol-parity stores, or before the first sync): the reference's
         full delete-all + re-insert (memory_system.py:1275-1302)."""
         with self._mutex:
+            # queued boosts must land before _sync_from_arena pulls rows,
+            # or boosted host copies get overwritten with stale values
+            self._flush_pending_boosts_locked()
             if self._supports_incremental and self._store_synced:
                 self._save_incremental()
             else:
@@ -2200,6 +2526,8 @@ Be clinical yet insightful. Do not include conversational filler."""
                 "embedding_calls": self.metrics["embedding_calls"],
             },
             "index": self.index.stats(),
+            "serving": (self.query_scheduler.stats()
+                        if self.query_scheduler is not None else None),
             "providers": {
                 "llm": type(self.llm).__name__,
                 "embedder": type(self.embedder).__name__,
@@ -2260,7 +2588,22 @@ STORAGE:
 
     # ------------------------------------------------------------------- close
     def close(self) -> None:
+        sched = getattr(self, "query_scheduler", None)
+        if sched is not None:
+            sched.close()
+        if getattr(self, "background_executor", None):
+            self.background_executor.shutdown(wait=True)
+        # Facts the ingest flush policy deferred must not wait for a next
+        # session (the WAL would replay their turns, but landing them now
+        # is cheaper than a re-extraction): force one final drain, then
+        # flush any queued cache-hit boosts.
+        if getattr(self, "_ingest_coalescer", None) and len(self._ingest_coalescer):
+            start = time.time()
+            drained: List[Tuple[str, str]] = []
+            for facts, _n_convs in self._ingest_coalescer.drain():
+                drained.extend(self._ingest_facts(facts))
+            self._finish_consolidation(drained, start)
+        if getattr(self, "_pending_boosts", None):
+            self._flush_pending_boosts()
         if hasattr(self, "store") and self.store is not None:
             self.store.close()
-        if self.background_executor:
-            self.background_executor.shutdown(wait=True)

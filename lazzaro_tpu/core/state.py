@@ -56,7 +56,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from lazzaro_tpu.ops.chunking import QUERY_CHUNK, chunked_map
+from lazzaro_tpu.ops.chunking import (QUERY_CHUNK, chunked_map,
+                                      chunked_map_multi)
 
 NEG_INF = -1e30
 
@@ -656,6 +657,23 @@ def _ingest_fused(
                        jnp.ones((n_chain,), jnp.int32), now, tenant,
                        chain_src >= 0)
     valid_q = rows < arena.capacity        # sentinel-padded rows make no edges
+    edges, outs = _gated_link_insert(edges, link_flat, link_slots, rows,
+                                     valid_q, now, tenant, link_gate,
+                                     link_scale, shard_modes)
+    return arena, edges, outs
+
+
+def _gated_link_insert(edges, link_flat, link_slots, src_rows, valid_q, now,
+                       tenant, link_gate, link_scale, shard_modes):
+    """Device-gated similarity-edge insert shared by the fused ingest
+    kernels: per shard mode, slots pre-allocated by the host get a live/
+    dead verdict on device (gate pass, valid source row, not already
+    inserted by an earlier mode) and the readback triples tell the host
+    which slots stuck."""
+    # The link-scan top-k results feed BOTH the gate logic here and the
+    # packed readback; the barrier stops XLA from splitting those consumers
+    # into duplicate full-arena sorts (same fix as _search_fused_scan).
+    link_flat = jax.lax.optimization_barrier(link_flat)
     outs = []
     prior = []                             # (cands, live) of earlier modes
     for mi in range(len(shard_modes)):
@@ -668,17 +686,300 @@ def _ingest_fused(
             dup = (cand[:, :, None] == p_cand[:, None, :]) & p_live[:, None, :]
             live = live & ~dup.any(-1)
         prior.append((cand, live))
-        src_b = jnp.broadcast_to(rows[:, None], cand.shape)
+        src_b = jnp.broadcast_to(src_rows[:, None], cand.shape)
         edges = _edges_add(
             edges, link_slots[mi].reshape(-1), src_b.reshape(-1),
             cand.reshape(-1), (scores * link_scale).reshape(-1),
             jnp.ones((live.size,), jnp.int32), now, tenant, live.reshape(-1))
         outs.extend((scores, cand, live))
-    return arena, edges, tuple(outs)
+    return edges, tuple(outs)
 
 
 ingest_fused, ingest_fused_copy = _donated_pair(
     _ingest_fused, donate=(0, 1), static_argnames=("k", "shard_modes"))
+
+
+# ---------------------------------------------------------------------------
+# Fused ingest WITH device-side dedup: the probe that decides merge-vs-insert
+# runs against the pre-add arena INSIDE the same dispatch (ROADMAP item 2),
+# so ingest is one round trip end-to-end.
+# ---------------------------------------------------------------------------
+
+
+def _ingest_dedup_fused(
+    arena: ArenaState,
+    edges: EdgeState,
+    rows: jax.Array,         # [B] i32 candidate row per fact, sentinel-padded
+    emb: jax.Array,          # [B, d]
+    salience: jax.Array,     # [B] f32 (doubles as the merge-touch candidate)
+    timestamp: jax.Array,    # [B] f32
+    type_id: jax.Array,      # [B] i32
+    shard_id: jax.Array,     # [B] i32
+    tenant_id: jax.Array,    # [B] i32
+    is_super: jax.Array,     # [B] bool
+    chain_gid: jax.Array,    # [B] i32 densified shard-group id, -1 padding
+    chain_slots: jax.Array,  # [B] i32 edge slot per fact, sentinel-padded
+    link_slots: jax.Array,   # [n_modes, B, k] i32 edge slots
+    now: jax.Array,
+    tenant: jax.Array,
+    dedup_gate: jax.Array,   # cosine threshold; > 1.0 disables dedup
+    chain_w: jax.Array,
+    link_gate: jax.Array,
+    link_scale: jax.Array,
+    k: int,
+    shard_modes: Tuple[int, ...] = (1, 0),
+) -> Tuple[ArenaState, EdgeState, Tuple[jax.Array, ...]]:
+    """``_ingest_fused`` plus the dedup probe the classic pipeline pays a
+    separate dispatch+readback for: masked top-1 against the PRE-add arena
+    and an intra-batch gram resolve duplicate facts ON DEVICE, duplicate
+    rows are scattered to the sentinel (never become alive nodes), their
+    merge targets get the merge-touch, and chain edges link consecutive
+    LIVE facts per shard group (a dup in the middle bridges its
+    neighbors, exactly like the host path that skips it). The packed
+    readback adds ``(dup, target, chain_src)`` so the host can finish id
+    bookkeeping — still ONE dispatch + ONE readback per mega-batch."""
+    cap = arena.capacity
+    b = rows.shape[0]
+    valid = rows < cap
+    qf = normalize(emb)                    # f32 — intra gram parity w/ host
+    qd = qf.astype(arena.emb.dtype)        # arena dtype — probe parity
+
+    # Pre-add probe: the same visibility the classic host probe has (its
+    # batch insert also lands after the probe).
+    pmask = arena.alive & (arena.tenant_id == tenant) & ~arena.is_super
+
+    def probe_chunk(q_c):
+        s = nt_dot(q_c, arena.emb)
+        return jax.lax.top_k(jnp.where(pmask[None, :], s, NEG_INF), 1)
+
+    p_s, p_r = chunked_map(probe_chunk, qd)
+    p_s, p_r = p_s[:, 0], p_r[:, 0]
+
+    # Intra-batch gram: best match among EARLIER valid facts (sentinel
+    # padding rows share one unit vector and must never match anything).
+    gram = nt_dot(qf, qf)
+    tril = jnp.where(jnp.tri(b, k=-1, dtype=bool) & valid[None, :],
+                     gram, NEG_INF)
+    g_j = jnp.argmax(tril, axis=1)
+    g_s = tril[jnp.arange(b), g_j]
+
+    # Sequential resolve (one scan, O(B) scalar steps): dup flag + target
+    # row per fact — an intra hit chains through its target so a dup-of-a-
+    # dup merges into the surviving node — and the chain predecessor (last
+    # LIVE fact of the same shard group).
+    def step(carry, i):
+        target, dup, last = carry
+        use_g = g_s[i] > p_s[i]
+        best_s = jnp.where(use_g, g_s[i], p_s[i])
+        best_t = jnp.where(use_g, target[g_j[i]], p_r[i])
+        is_dup = valid[i] & (best_s > dedup_gate)
+        target = target.at[i].set(jnp.where(is_dup, best_t, rows[i]))
+        dup = dup.at[i].set(is_dup)
+        live_i = valid[i] & ~is_dup
+        gid = jnp.maximum(chain_gid[i], 0)
+        prev = jnp.where(chain_gid[i] >= 0, last[gid], -1)
+        src_i = jnp.where(live_i & (prev >= 0), prev, -1)
+        last = last.at[gid].set(jnp.where(live_i, rows[i], last[gid]))
+        return (target, dup, last), src_i
+
+    init = (jnp.full((b,), cap, jnp.int32), jnp.zeros((b,), bool),
+            jnp.full((b,), -1, jnp.int32))
+    (target, dup, _), chain_src = jax.lax.scan(step, init, jnp.arange(b))
+
+    live_new = valid & ~dup
+    add_rows = jnp.where(live_new, rows, cap)
+    arena = _arena_add(arena, add_rows, emb, salience, timestamp, type_id,
+                       shard_id, tenant_id, is_super)
+    touch_rows = jnp.where(dup, target, cap)
+    arena = _arena_merge_touch(arena, touch_rows, salience, now)
+    link_flat = _arena_link_candidates_multi(arena, add_rows, rows, tenant,
+                                             k, shard_modes)
+    chain_live = chain_src >= 0
+    edges = _edges_add(edges, chain_slots, chain_src, rows,
+                       jnp.broadcast_to(chain_w, (b,)),
+                       jnp.ones((b,), jnp.int32), now, tenant, chain_live)
+    edges, outs = _gated_link_insert(edges, link_flat, link_slots, rows,
+                                     live_new, now, tenant, link_gate,
+                                     link_scale, shard_modes)
+    # [B] verdicts broadcast to [B, k] so every readback leaf has one shape
+    # and the host fetches them all in ONE packed transfer
+    wide = tuple(jnp.broadcast_to(a[:, None], (b, k))
+                 for a in (dup.astype(jnp.int32), target, chain_src))
+    return arena, edges, wide + outs
+
+
+ingest_dedup_fused, ingest_dedup_fused_copy = _donated_pair(
+    _ingest_dedup_fused, donate=(0, 1), static_argnames=("k", "shard_modes"))
+
+
+# ---------------------------------------------------------------------------
+# Fused retrieval: the per-chat-turn serving sequence — super-node gate +
+# main-arena ANN + CSR neighbor gather + neighbor/access boosts — in ONE
+# donated device program with ONE packed readback (the serving-side analog
+# of ingest_fused; see ISSUE 2).
+# ---------------------------------------------------------------------------
+
+
+def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
+                       csr_nbr: jax.Array, q: jax.Array, q_valid: jax.Array,
+                       tenant: jax.Array, gate_on: jax.Array,
+                       boost_on: jax.Array, super_gate: jax.Array,
+                       k: int, cap_take: int, max_nbr: int):
+    """Per-chunk compute phase: masked super top-1 + masked main top-k over
+    ONE score matrix (the arena streams from HBM once; the two retrieval
+    tiers are just different masks, same trick as the multi-mode link
+    scan), the device-side gate verdict, and the CSR neighbor gather with
+    per-query dedup. Returns sentinel-padded row lists for the scatter
+    phase (``capacity`` is the sentinel row index)."""
+    cap = state.capacity
+
+    def chunk(q_c, valid_c, tenant_c, gate_c, boost_c):
+        qn = normalize(q_c).astype(state.emb.dtype)
+        scores = nt_dot(qn, state.emb)                        # [C, cap+1] f32
+        alive_t = state.alive[None, :] & (
+            state.tenant_id[None, :] == tenant_c[:, None])
+        sup = state.is_super[None, :]
+        gate_s, gate_r = jax.lax.top_k(
+            jnp.where(alive_t & sup, scores, NEG_INF), 1)
+        ann_s, ann_r = jax.lax.top_k(
+            jnp.where(alive_t & ~sup, scores, NEG_INF), k)
+        # Barrier: the top-k results feed BOTH the packed readback and the
+        # boost gather chain below; without it XLA (CPU at least) splits
+        # the consumers into two full [C, cap] sorts — measured 2.4× on
+        # the whole fused program at 65k rows.
+        gate_s, gate_r, ann_s, ann_r = jax.lax.optimization_barrier(
+            (gate_s, gate_r, ann_s, ann_r))
+        gate_s, gate_r = gate_s[:, 0], gate_r[:, 0]
+        # The hierarchy decision, ON DEVICE: where the gate fires the host
+        # serves super-node children it alone knows, so the device must
+        # NOT boost the ANN rows (the host falls back to the classic boost
+        # for those queries — exact parity on the fast path).
+        fast = gate_c & (gate_s > super_gate)
+        do_boost = boost_c & valid_c & ~fast                  # [C]
+        hit = ann_s[:, :cap_take] > NEG_INF / 2
+        acc_rows = jnp.where(hit & do_boost[:, None],
+                             ann_r[:, :cap_take], cap)        # [C, cap_take]
+        # CSR neighbor gather for the access-boosted rows (sentinel row's
+        # indptr slice is empty, so masked rows gather nothing)
+        start = csr_indptr[acc_rows]
+        end = csr_indptr[acc_rows + 1]
+        idx = start[:, :, None] + jnp.arange(max_nbr)[None, None, :]
+        ok = idx < end[:, :, None]
+        nbr = jnp.where(ok, csr_nbr[jnp.minimum(idx, csr_nbr.shape[0] - 1)],
+                        -1)
+        flat = nbr.reshape(nbr.shape[0], -1)                  # [C, M]
+        m = flat.shape[1]
+        safe = jnp.maximum(flat, 0)
+        valid_n = ((flat >= 0) & state.alive[safe]
+                   & (state.tenant_id[safe] == tenant_c[:, None]))
+        # per-query dedup (keep first occurrence): classic boosts a shared
+        # neighbor ONCE per turn however many retrieved nodes touch it...
+        dup = ((flat[:, :, None] == flat[:, None, :])
+               & jnp.tri(m, k=-1, dtype=bool)[None, :, :]).any(-1)
+        # ...and never boosts a node that was itself retrieved
+        in_res = (flat[:, :, None] == acc_rows[:, None, :]).any(-1)
+        nbr_rows = jnp.where(valid_n & ~dup & ~in_res, flat, cap)
+        return gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows
+
+    return chunked_map_multi(chunk, (q, q_valid, tenant, gate_on, boost_on))
+
+
+def _search_fused(
+    state: ArenaState,
+    csr_indptr: jax.Array,   # [cap+2] i32 neighbor-list offsets per row
+    csr_nbr: jax.Array,      # [E_pad] i32 neighbor rows (bidirectional)
+    q: jax.Array,            # [Q, d] padded query batch
+    q_valid: jax.Array,      # [Q] bool (False for pad rows)
+    tenant: jax.Array,       # [Q] i32 per-query tenant (cross-tenant batch)
+    gate_on: jax.Array,      # [Q] bool hierarchy gate enabled
+    boost_on: jax.Array,     # [Q] bool apply device boosts for this query
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    cap_take: int,           # retrieval cap: how many top rows get boosted
+    max_nbr: int,
+) -> Tuple[ArenaState, Tuple[jax.Array, ...]]:
+    """One dispatch for a padded cross-tenant query batch: gate + ANN +
+    neighbor gather + both boosts. Scatter counts make a mega-batch exact
+    w.r.t. serial classic turns: a row retrieved by two queries gets TWO
+    access bumps (``.add``), while within one query each neighbor is
+    boosted once (the per-query dedup above) — matching what per-turn
+    ``update_access`` + ``_boost_neighbors`` calls would have done."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
+        _search_fused_scan(state, csr_indptr, csr_nbr, q, q_valid, tenant,
+                           gate_on, boost_on, super_gate, k, cap_take,
+                           max_nbr)
+    n = state.emb.shape[0]
+    acc_cnt = (jnp.zeros((n,), jnp.int32).at[acc_rows.reshape(-1)].add(1)
+               .at[n - 1].set(0))
+    nbr_cnt = (jnp.zeros((n,), jnp.int32).at[nbr_rows.reshape(-1)].add(1)
+               .at[n - 1].set(0))
+    sal = (state.salience + acc_cnt.astype(jnp.float32) * acc_boost
+           + nbr_cnt.astype(jnp.float32) * nbr_boost)
+    touched = (acc_cnt > 0) | (nbr_cnt > 0)
+    state = state.replace(
+        salience=jnp.where(touched, jnp.minimum(sal, 1.0), state.salience),
+        access_count=state.access_count + acc_cnt,
+        last_accessed=jnp.where(touched, now, state.last_accessed))
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+def _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast) -> jax.Array:
+    """ONE [Q, 3 + 2k] f32 readback array: [gate_score, gate_row(bitcast),
+    ann_scores..k, ann_rows(bitcast)..k, fast]. Packing happens in-kernel so
+    the host pays exactly one device→host transfer and zero extra
+    dispatches (int rows are bitcast, not cast — undone with a host-side
+    ``.view(int32)``, same trick as ``utils.batching.fetch_packed``)."""
+    bc = lambda a: jax.lax.bitcast_convert_type(a.astype(jnp.int32),  # noqa: E731
+                                                jnp.float32)
+    return jnp.concatenate([
+        gate_s[:, None], bc(gate_r)[:, None], ann_s, bc(ann_r),
+        fast.astype(jnp.float32)[:, None]], axis=1)
+
+
+search_fused, search_fused_copy = _donated_pair(
+    _search_fused, static_argnames=("k", "cap_take", "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "cap_take", "max_nbr"))
+def search_fused_read(state: ArenaState, csr_indptr: jax.Array,
+                      csr_nbr: jax.Array, q: jax.Array, q_valid: jax.Array,
+                      tenant: jax.Array, gate_on: jax.Array,
+                      super_gate: jax.Array, k: int, cap_take: int,
+                      max_nbr: int) -> jax.Array:
+    """Read-only twin of ``search_fused`` for batches where NO query wants
+    boosts (pure ``search_memories`` fleets): same compute, no state
+    mutation, so the ownership/donation dance is skipped entirely."""
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_scan(
+        state, csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+        super_gate, k, cap_take, max_nbr)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+def _arena_apply_boosts(state: ArenaState, rows: jax.Array,
+                        acc_cnt: jax.Array, nbr_cnt: jax.Array,
+                        now_vals: jax.Array, acc_boost: jax.Array,
+                        nbr_boost: jax.Array) -> ArenaState:
+    """Deferred boost flush: cache-hit chat turns accumulate (access,
+    neighbor) boost COUNTS on the host instead of paying a device dispatch
+    per turn; this scatter applies many turns' worth in one program.
+    Positive capped adds commute, so applying the summed counts equals the
+    serial per-turn sequence. ``now_vals`` carries each row's latest
+    queue-time timestamp (padding rows use -inf so ``.max`` is a no-op)."""
+    sal = state.salience.at[rows].add(
+        acc_cnt.astype(jnp.float32) * acc_boost
+        + nbr_cnt.astype(jnp.float32) * nbr_boost)
+    return state.replace(
+        salience=jnp.minimum(sal, 1.0),
+        access_count=state.access_count.at[rows].add(acc_cnt),
+        last_accessed=state.last_accessed.at[rows].max(now_vals))
+
+
+arena_apply_boosts, arena_apply_boosts_copy = _donated_pair(
+    _arena_apply_boosts)
 
 
 @functools.partial(jax.jit, static_argnames=("max_neighbors",))

@@ -35,6 +35,27 @@ def nt_dot(q: jax.Array, rows: jax.Array) -> jax.Array:
                                preferred_element_type=jnp.float32)
 
 
+def chunked_map_multi(fn, arrays, chunk: int = QUERY_CHUNK):
+    """``chunked_map`` over SEVERAL same-leading-dim arrays at once.
+
+    The fused retrieval kernel maps per-query metadata (tenant id, gate
+    flag, boost flag) alongside the query rows; ``lax.map`` happily maps a
+    tuple pytree, so the padding/reshape scaffold is the only thing this
+    adds over :func:`chunked_map`."""
+    b = arrays[0].shape[0]
+    if b <= chunk:
+        return fn(*arrays)
+    nc = -(-b // chunk)
+
+    def prep(a):
+        pad = [(0, nc * chunk - b)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, pad).reshape((nc, chunk) + a.shape[1:])
+
+    outs = jax.lax.map(lambda t: fn(*t), tuple(prep(a) for a in arrays))
+    return jax.tree_util.tree_map(
+        lambda o: o.reshape((nc * chunk,) + o.shape[2:])[:b], outs)
+
+
 def chunked_map(fn, xs: jax.Array, chunk: int = QUERY_CHUNK):
     """Apply ``fn`` ([C, ...] → pytree of [C, ...]) to row-chunks of ``xs``.
 

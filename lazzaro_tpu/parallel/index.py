@@ -182,6 +182,32 @@ class ShardedMemoryIndex:
         return decode_topk(np.asarray(scores)[:nq], np.asarray(rows)[:nq],
                            self.row_to_id, NEG_INF)
 
+    def serve_requests(self, reqs) -> List:
+        """``serve.QueryScheduler`` executor for the pod-sharded path: one
+        coalesced batch of :class:`serve.RetrievalRequest`s becomes one
+        distributed top-k per tenant group (queries for the same tenant
+        share a mask, so they ride one shard_map dispatch; distinct tenants
+        dispatch separately — the lean sharded index masks per batch, not
+        per row like ``MemoryIndex``'s fused kernel). No edge arena lives
+        here, so boost/gate requests serve as plain reads: ``fast`` and
+        ``boosted`` stay False and the orchestrator's classic host path
+        pays any boosts."""
+        from lazzaro_tpu.serve.scheduler import RetrievalResult
+
+        results = [RetrievalResult() for _ in reqs]
+        by_tenant: Dict[str, List[int]] = {}
+        for i, r in enumerate(reqs):
+            by_tenant.setdefault(r.tenant, []).append(i)
+        for tenant, idxs in by_tenant.items():
+            qs = np.stack([np.asarray(reqs[i].query, np.float32).reshape(-1)
+                           for i in idxs])
+            per_query = self.search_batch(qs, tenant)
+            for i, (ids, scores) in zip(idxs, per_query):
+                k = int(reqs[i].k)
+                results[i].ids = ids[:k]
+                results[i].scores = scores[:k]
+        return results
+
     def decay(self, tenant: str, rate: float, floor: float = 0.2) -> None:
         tid = self._tenants.get(tenant)
         if tid is None:

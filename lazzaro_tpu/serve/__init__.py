@@ -1,0 +1,6 @@
+"""Serving-path scheduling: cross-request query batching for fused retrieval."""
+
+from lazzaro_tpu.serve.scheduler import (QueryScheduler, RetrievalRequest,
+                                         RetrievalResult)
+
+__all__ = ["QueryScheduler", "RetrievalRequest", "RetrievalResult"]

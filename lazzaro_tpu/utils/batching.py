@@ -103,6 +103,55 @@ def empty_results(n: int) -> List[Tuple[List[str], List[float]]]:
     return [([], []) for _ in range(n)]
 
 
+class FlushPolicy:
+    """Time/size flush decision shared by ``IngestCoalescer`` (ingest side)
+    and ``serve.QueryScheduler`` (query side).
+
+    A batch flushes when it holds ``max_items`` entries OR when its oldest
+    entry has waited ``max_wait_s`` — so bursty load coalesces into dense
+    device batches while trickle load is never held hostage to a size
+    threshold it will not reach. ``max_wait_s <= 0`` means "flush on every
+    check" (the eager pre-policy behavior)."""
+
+    def __init__(self, max_items: int, max_wait_s: float):
+        self.max_items = max(1, int(max_items))
+        self.max_wait_s = float(max_wait_s)
+        self._oldest: Optional[float] = None
+
+    def note_add(self, now: float) -> None:
+        if self._oldest is None:
+            self._oldest = now
+
+    def should_flush(self, n_items: int, now: float,
+                     oldest: Optional[float] = None) -> bool:
+        """``oldest`` overrides the internally-tracked first-add time —
+        callers that pop partial batches (the query scheduler) know the
+        true head-of-queue age; callers that drain whole buffers (the
+        ingest coalescer) rely on ``note_add``/``reset``."""
+        if n_items <= 0:
+            return False
+        if self.max_wait_s <= 0 or n_items >= self.max_items:
+            return True
+        if oldest is None:
+            oldest = self._oldest
+        return oldest is not None and (now - oldest) >= self.max_wait_s
+
+    def wait_remaining(self, now: float,
+                       oldest: Optional[float] = None) -> float:
+        """Seconds until the oldest entry's deadline (0 when due; a large
+        value when empty — callers use it as a condition-wait timeout)."""
+        if oldest is None:
+            oldest = self._oldest
+        if oldest is None:
+            return 3600.0
+        if self.max_wait_s <= 0:
+            return 0.0
+        return max(0.0, oldest + self.max_wait_s - now)
+
+    def reset(self) -> None:
+        self._oldest = None
+
+
 class IngestCoalescer:
     """Cross-conversation ingest batcher for the fused single-dispatch
     pipeline.
@@ -118,15 +167,34 @@ class IngestCoalescer:
     ``drain`` returns ``(facts, n_conversations)`` mega-batches and empties
     the buffer; nothing is ever withheld across a drain, so durability
     bookkeeping (WAL, in-flight batches) stays with the caller.
+
+    With ``max_wait_s > 0`` the coalescer also carries a time/size flush
+    policy (``FlushPolicy``): ``should_flush`` stays False while the buffer
+    is small AND young, so a steady trickle of single conversations
+    accumulates into one dense fused dispatch instead of draining one
+    conversation at a time (ROADMAP open item 3). The caller decides when
+    to consult the policy and remains responsible for durability of
+    deferred facts (the source turns stay in the WAL until their facts are
+    ingested). ``max_wait_s = 0`` (default) preserves the eager behavior:
+    every check says flush.
     """
 
-    def __init__(self, max_facts: int = 8192):
+    def __init__(self, max_facts: int = 8192, max_wait_s: float = 0.0):
         self.max_facts = max(1, int(max_facts))
+        self.policy = FlushPolicy(self.max_facts, max_wait_s)
         self._convs: List[List[dict]] = []
 
-    def add_conversation(self, facts: Sequence[dict]) -> None:
+    def add_conversation(self, facts: Sequence[dict],
+                         now: Optional[float] = None) -> None:
         if facts:
+            import time as _time
             self._convs.append(list(facts))
+            self.policy.note_add(now if now is not None else _time.time())
+
+    def should_flush(self, now: Optional[float] = None) -> bool:
+        import time as _time
+        return self.policy.should_flush(
+            len(self), now if now is not None else _time.time())
 
     def __len__(self) -> int:
         return sum(len(c) for c in self._convs)
@@ -140,6 +208,7 @@ class IngestCoalescer:
         batch: List[dict] = []
         n_convs = 0
         convs, self._convs = self._convs, []
+        self.policy.reset()
         for conv in convs:
             while len(conv) > self.max_facts:          # oversized: split
                 if batch:

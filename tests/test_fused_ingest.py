@@ -74,9 +74,11 @@ def _system(tmp, fused=True, per=20):
                             ingest_fused=fused, decay_rate=0.0))
 
 
-_COUNTED = ("ingest_fused", "ingest_fused_copy", "arena_add",
+_COUNTED = ("ingest_fused", "ingest_fused_copy", "ingest_dedup_fused",
+            "ingest_dedup_fused_copy", "arena_add",
             "arena_add_copy", "arena_merge_touch", "arena_merge_touch_copy",
-            "edges_add", "edges_add_copy", "arena_link_candidates_multi")
+            "edges_add", "edges_add_copy", "arena_link_candidates_multi",
+            "arena_search")
 
 
 def _count_dispatches(monkeypatch):
@@ -94,22 +96,70 @@ def _count_dispatches(monkeypatch):
 
 def test_one_fused_dispatch_per_conversation(monkeypatch):
     """The jit-call counter: a consolidated conversation costs exactly ONE
-    ingest-path dispatch (the fused program), zero unfused mutation calls."""
+    ingest-path dispatch (the dedup-fused program — the dedup probe rides
+    inside it, so no separate ``arena_search`` dispatch either), zero
+    unfused mutation calls."""
     with tempfile.TemporaryDirectory() as tmp:
         ms = _system(tmp, fused=True)
         ms.start_conversation()
         ms.add_to_short_term("conv 0", "episodic", 0.7)
         calls = _count_dispatches(monkeypatch)
         ms.end_conversation()
-        assert calls["ingest_fused"] + calls["ingest_fused_copy"] == 1
+        assert (calls["ingest_dedup_fused"]
+                + calls["ingest_dedup_fused_copy"]) == 1
         # the single-writer hot path donated (no reader held the state)
-        assert calls["ingest_fused"] == 1
-        for name in ("arena_add", "arena_add_copy", "arena_merge_touch",
+        assert calls["ingest_dedup_fused"] == 1
+        for name in ("ingest_fused", "ingest_fused_copy", "arena_add",
+                     "arena_add_copy", "arena_merge_touch",
                      "arena_merge_touch_copy", "edges_add", "edges_add_copy",
-                     "arena_link_candidates_multi"):
+                     "arena_link_candidates_multi", "arena_search"):
             assert calls[name] == 0, (name, calls)
         assert ms.buffer.size()[0] == 20
         ms.close()
+
+
+def test_one_dispatch_with_device_dedup_duplicates(monkeypatch):
+    """Same counter with REAL duplicates in the batch: the device merges
+    them inside the one dispatch (no probe dispatch, no separate merge
+    touch), and the graph matches the classic host-probe pipeline."""
+    class DupLLM(QueueLLM):
+        def completion(self, messages, response_format=None):
+            out = json.loads(super().completion(messages, response_format))
+            # repeat the first two facts verbatim: exact-cosine duplicates
+            out["memories"] += [dict(out["memories"][0]),
+                                dict(out["memories"][1])]
+            return json.dumps(out)
+
+    def build(dedup_fused):
+        tmp = tempfile.mkdtemp()
+        ms = _system(tmp, fused=True)
+        ms.config.ingest_dedup_fused = dedup_fused
+        ms.llm = DupLLM(8)
+        ms.start_conversation()
+        ms.add_to_short_term("conv 0", "episodic", 0.7)
+        return ms
+
+    ms = build(True)
+    calls = _count_dispatches(monkeypatch)
+    ms.end_conversation()
+    assert calls["ingest_dedup_fused"] == 1
+    assert calls["arena_search"] == 0
+    assert ms.buffer.size()[0] == 8          # 2 duplicates merged, not added
+    classic = build(False)
+    classic.end_conversation()
+    try:
+        assert set(ms.buffer.nodes) == set(classic.buffer.nodes)
+        na = {n: (round(ms.buffer.nodes[n].salience, 5),
+                  ms.buffer.nodes[n].access_count)
+              for n in ms.buffer.nodes}
+        nb = {n: (round(classic.buffer.nodes[n].salience, 5),
+                  classic.buffer.nodes[n].access_count)
+              for n in classic.buffer.nodes}
+        assert na == nb
+        assert set(ms.index.edge_slots) == set(classic.index.edge_slots)
+    finally:
+        ms.close()
+        classic.close()
 
 
 def test_fused_matches_unfused_exactly():
