@@ -34,6 +34,13 @@ class MemoryConfig:
     # recall is controlled by nprobe (== n_clusters is exact). Consolidation
     # gates always use the exact master. Single-chip only.
     ivf_serving: int = 0
+    # Coarse-stage over-fetch slack shared by every two-stage serving path
+    # (MemoryIndex.coarse_slack): the IVF member scan and the int8 fused
+    # kernel both fetch k + slack coarse candidates before exact
+    # rescore/dedup, so duplicate slots (IVF) or int8 ranking error at the
+    # k boundary (quantized fused serving) can never shrink a result below
+    # k live rows.
+    coarse_fetch_slack: int = 8
     # IVF-PQ member storage (ops/pq.py; LanceDB's default index family):
     # with ivf_serving > 0, the member scan reads product-quantized codes
     # (m = dim/8 bytes per row instead of dim·2) and the top shortlist is
@@ -71,8 +78,11 @@ class MemoryConfig:
     # runs as ONE donated device program + ONE packed readback, routed
     # through the cross-request QueryScheduler so concurrent users share
     # dense device batches. Off = the classic 3-4 dispatch sequence.
-    # Automatically bypassed under a mesh or when int8/IVF serving shadows
-    # are active (those paths have their own optimized scans).
+    # With int8_serving on, the fused program streams the int8 shadow for
+    # a coarse top-(k + coarse_fetch_slack) and exactly rescores the
+    # survivors from the master (state.search_fused_quant) — still ONE
+    # dispatch. Automatically bypassed under a mesh or when the IVF coarse
+    # stage is active (that path has its own prefilter scan).
     serve_fused: bool = True
     # QueryScheduler flush policy: a pending batch ships when it reaches
     # serve_batch_max requests OR when its oldest request has waited

@@ -43,9 +43,18 @@ class MemoryIndex:
                  dtype=jnp.float32, epoch: Optional[float] = None,
                  mesh=None, shard_axis: str = "data",
                  int8_serving: bool = False, ivf_nprobe: int = 0,
-                 pq_serving: bool = False):
+                 pq_serving: bool = False, coarse_slack: int = 8):
         self.dim = dim
         self.dtype = dtype
+        # Coarse-stage over-fetch slack, shared by every two-stage serving
+        # path (ISSUE 3 satellite): the IVF member scan over-fetches
+        # k + slack before the host dedup trims (a reused slot can sit in
+        # both a stale member slot and the residual), and the int8 fused
+        # path over-fetches k + slack coarse candidates before the exact
+        # rescore (absorbing the ~1e-2 quantization ranking error at the
+        # k boundary). One knob, one guarantee: neither path can return
+        # fewer than k live rows.
+        self.coarse_slack = max(0, int(coarse_slack))
         # Int8 serving shadow (ops/quant.py): half the HBM bytes per scan.
         # Exact-path callers (dedup/merge thresholds) bypass it. The shadow
         # re-quantizes lazily, invalidated ONLY by embedding-mutating ops
@@ -287,20 +296,51 @@ class MemoryIndex:
             del cur
             self.edge_state = out
 
+    def _ingest_shadow_arg(self):
+        """Int8 shadow to thread through the fused ingest program for
+        incremental code maintenance, or None when there is nothing valid
+        to maintain (int8 off, mesh path, shadow dirty/absent, or the
+        arena grew since the shadow was built). Caller holds _state_lock."""
+        if not self.int8_serving or self.mesh is not None or self._int8_dirty:
+            return None
+        shadow = self._int8_shadow
+        if shadow is None or shadow[0].shape[0] != self._state.emb.shape[0]:
+            return None
+        return shadow
+
+    # References to a shadow ARRAY at the gate when no serve holds it: the
+    # ``(q8, scale)`` tuple's slot plus getrefcount's own argument. A
+    # reader that snapshotted the shadow (``_int8_shadow_for`` hands out
+    # refs under the lock) raises this and forces the copying twin.
+    _SOLE_SHADOW_REFS = 2
+
+    def _shadow_sole(self, shadow) -> bool:
+        return (shadow is None
+                or (sys.getrefcount(shadow[0]) <= self._SOLE_SHADOW_REFS
+                    and sys.getrefcount(shadow[1]) <= self._SOLE_SHADOW_REFS))
+
     def _apply_fused(self, *args, **kwargs):
-        """Dispatch ``S.ingest_fused`` over BOTH states, donating only when
-        this index holds the sole reference to each; returns the kernel's
-        non-state outputs (the per-mode link triples)."""
+        """Dispatch ``S.ingest_fused`` over BOTH states (plus the int8
+        shadow when it is being incrementally maintained), donating only
+        when this index holds the sole reference to each; returns
+        ``(link_flat, shadow_maintained)`` — the kernel's non-state
+        outputs and whether the shadow stayed fresh in-kernel (the caller
+        skips the dirty mark then)."""
         with self._state_lock:
             arena, edges = self._state, self._edge_state
+            shadow = self._ingest_shadow_arg()
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
-                    and sys.getrefcount(edges) <= self._SOLE_REFS)
+                    and sys.getrefcount(edges) <= self._SOLE_REFS
+                    and self._shadow_sole(shadow))
             fn = S.ingest_fused if sole else S.ingest_fused_copy
-            new_arena, new_edges, link_flat = fn(arena, edges, *args, **kwargs)
-            del arena, edges
+            new_arena, new_edges, new_shadow, link_flat = fn(
+                arena, edges, shadow, *args, **kwargs)
+            del arena, edges, shadow
             self.state = new_arena
             self.edge_state = new_edges
-        return link_flat
+            if new_shadow is not None:
+                self._int8_shadow = new_shadow
+        return link_flat, new_shadow is not None
 
     # ------------------------------------------------------------------ ids
     def tenant_id(self, name: str) -> int:
@@ -497,16 +537,19 @@ class MemoryIndex:
                 t_rows.append(r)
                 t_sals.append(float(msal))
 
-        # One up-front slot allocation: chains + every potential gated link.
-        # Growth (if any) happens HERE, before sentinel indices are baked
-        # into the padded arrays below.
+        # One up-front slot allocation: chains + a worst-case POOL for the
+        # gated links. The device prefix-sum compacts accepted links into
+        # the pool's leading slots, so the arena only ever sees accepted
+        # writes and the unused suffix comes back as one slice. Growth (if
+        # any) happens HERE, before sentinel indices are baked into the
+        # padded arrays below.
         k_eff = min(link_k, self.state.capacity)
         n_modes = len(shard_modes)
         chain_keys = [(s, t) for s, t in chain_pairs
                       if s in self.id_to_row and t in self.id_to_row]
         slots = self._alloc_edge_slots(len(chain_keys) + n_modes * n * k_eff)
         chain_slot_list = slots[:len(chain_keys)]
-        link_slot_list = slots[len(chain_keys):]
+        link_pool_list = slots[len(chain_keys):]
 
         cap = self.state.capacity
         ecap = self.edge_state.capacity
@@ -535,13 +578,11 @@ class MemoryIndex:
             c_src[i] = self.id_to_row[s]
             c_tgt[i] = self.id_to_row[t]
             c_w[i] = chain_weight
-        link_slots = np.full((n_modes, b, k_eff), ecap, np.int32)
-        link_slots_real = np.asarray(link_slot_list, np.int32
-                                     ).reshape(n_modes, n, k_eff)
-        link_slots[:, :n, :] = link_slots_real
+        link_pool = self._link_pool_dev(link_pool_list, n_modes * b * k_eff,
+                                        ecap)
 
         now_rel = (now if now is not None else time.time()) - self.epoch
-        link_flat = self._apply_fused(
+        link_flat, shadow_fresh = self._apply_fused(
             jnp.asarray(padded), jnp.asarray(emb),
             jnp.asarray(pad([float(s) for s in saliences])),
             jnp.asarray(pad([float(t) - self.epoch for t in timestamps])),
@@ -552,11 +593,12 @@ class MemoryIndex:
             jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
             jnp.asarray(touch_padded), jnp.asarray(touch_sal),
             jnp.asarray(c_padded), jnp.asarray(c_src), jnp.asarray(c_tgt),
-            jnp.asarray(c_w), jnp.asarray(link_slots),
+            jnp.asarray(c_w), link_pool,
             jnp.float32(now_rel), jnp.int32(tid),
             jnp.float32(link_gate), jnp.float32(link_scale),
             k=k_eff, shard_modes=shard_modes)
-        self._int8_dirty = True
+        if not shadow_fresh:
+            self._int8_dirty = True
         self._pq_dirty = True
         self._ivf_note_added(rows)
 
@@ -564,28 +606,34 @@ class MemoryIndex:
         candidates: Dict[int, Dict[str, List[Tuple[str, float]]]] = {}
         created: Dict[int, List[Tuple[str, str, float]]] = {}
         reclaim: List[int] = []
+        consumed = 0
         for mi, sm in enumerate(shard_modes):
-            sc, cd, lv = host[3 * mi], host[3 * mi + 1], host[3 * mi + 2]
+            sc, cd, ps = host[3 * mi], host[3 * mi + 1], host[3 * mi + 2]
             out_m: Dict[str, List[Tuple[str, float]]] = {}
             made: List[Tuple[str, str, float]] = []
             for bi in range(n):
                 nid = ids[bi]
                 pairs = []
                 for j in range(k_eff):
-                    slot = int(link_slots_real[mi, bi, j])
+                    p = int(ps[bi, j])
+                    consumed = max(consumed, p + 1)
                     s = float(sc[bi, j])
                     cid = (self.row_to_id.get(int(cd[bi, j]))
                            if s > S.NEG_INF / 2 else None)
                     if cid is not None:
                         pairs.append((cid, s))
+                    if p < 0:
+                        continue               # rejected: no slot consumed
                     key = (nid, cid)
-                    if lv[bi, j] > 0.5 and cid is not None \
-                            and key not in self.edge_slots:
-                        self.edge_slots[key] = slot
+                    if cid is not None and key not in self.edge_slots:
+                        self.edge_slots[key] = link_pool_list[p]
                         made.append((nid, cid,
                                      min(1.0, max(0.0, s * link_scale))))
                     else:
-                        reclaim.append(slot)
+                        # device inserted it but the host won't register the
+                        # key (defensive): the slot is reclaimed, not
+                        # cleared, until the next write lands on it
+                        reclaim.append(link_pool_list[p])
                 out_m[nid] = pairs
             candidates[sm] = out_m
             created[sm] = made
@@ -594,24 +642,40 @@ class MemoryIndex:
                 reclaim.append(slot)
             else:
                 self.edge_slots[key] = slot
+        # the compaction win: the untouched pool suffix comes back whole
+        self._free_edge_slots.extend(link_pool_list[consumed:])
         self._free_edge_slots.extend(reclaim)
         self._csr_dirty = True
         return rows, candidates, created
 
+    def _link_pool_dev(self, pool: List[int], padded_len: int, ecap: int):
+        """Device view of the link-slot pool for the compacting fused
+        ingest: real slots first, sentinel (``ecap``) padding up to the
+        jit-bucketed length, and one trailing sentinel entry the kernel
+        routes every rejected candidate through."""
+        arr = np.full((padded_len + 1,), ecap, np.int32)
+        arr[:len(pool)] = pool
+        return jnp.asarray(arr)
+
     def _apply_dedup_fused(self, *args, **kwargs):
-        """Dispatch ``S.ingest_dedup_fused`` over BOTH states under the
-        ownership gate (mirror of ``_apply_fused``); returns the kernel's
-        non-state outputs."""
+        """Dispatch ``S.ingest_dedup_fused`` over BOTH states (plus the
+        maintained int8 shadow) under the ownership gate (mirror of
+        ``_apply_fused``); returns ``(flat, shadow_maintained)``."""
         with self._state_lock:
             arena, edges = self._state, self._edge_state
+            shadow = self._ingest_shadow_arg()
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
-                    and sys.getrefcount(edges) <= self._SOLE_REFS)
+                    and sys.getrefcount(edges) <= self._SOLE_REFS
+                    and self._shadow_sole(shadow))
             fn = S.ingest_dedup_fused if sole else S.ingest_dedup_fused_copy
-            new_arena, new_edges, flat = fn(arena, edges, *args, **kwargs)
-            del arena, edges
+            new_arena, new_edges, new_shadow, flat = fn(
+                arena, edges, shadow, *args, **kwargs)
+            del arena, edges, shadow
             self.state = new_arena
             self.edge_state = new_edges
-        return flat
+            if new_shadow is not None:
+                self._int8_shadow = new_shadow
+        return flat, new_shadow is not None
 
     def ingest_batch_dedup(self, embeddings: np.ndarray,
                            saliences: Sequence[float],
@@ -646,7 +710,7 @@ class MemoryIndex:
         n_modes = len(shard_modes)
         slots = self._alloc_edge_slots(n + n_modes * n * k_eff)
         chain_slot_list = slots[:n]
-        link_slot_list = slots[n:]
+        link_pool_list = slots[n:]
 
         cap = self.state.capacity
         ecap = self.edge_state.capacity
@@ -669,13 +733,11 @@ class MemoryIndex:
                 for k in shard_keys]
         chain_slots = np.full((b,), ecap, np.int32)
         chain_slots[:n] = chain_slot_list
-        link_slots = np.full((n_modes, b, k_eff), ecap, np.int32)
-        link_slots_real = np.asarray(link_slot_list, np.int32
-                                     ).reshape(n_modes, n, k_eff)
-        link_slots[:, :n, :] = link_slots_real
+        link_pool = self._link_pool_dev(link_pool_list, n_modes * b * k_eff,
+                                        ecap)
 
         now_abs = now if now is not None else time.time()
-        flat = self._apply_dedup_fused(
+        flat, shadow_fresh = self._apply_dedup_fused(
             jnp.asarray(padded), jnp.asarray(emb),
             jnp.asarray(pad([float(s) for s in saliences])),
             jnp.asarray(pad([float(t) - self.epoch for t in timestamps])),
@@ -686,12 +748,13 @@ class MemoryIndex:
             jnp.asarray(pad([tid] * n, -1, np.int32)),
             jnp.asarray(pad([False] * n, False, bool)),
             jnp.asarray(pad(gids, -1, np.int32)),
-            jnp.asarray(chain_slots), jnp.asarray(link_slots),
+            jnp.asarray(chain_slots), link_pool,
             jnp.float32(now_abs - self.epoch), jnp.int32(tid),
             jnp.float32(dedup_gate), jnp.float32(chain_weight),
             jnp.float32(link_gate), jnp.float32(link_scale),
             k=k_eff, shard_modes=shard_modes)
-        self._int8_dirty = True
+        if not shadow_fresh:
+            self._int8_dirty = True
         self._pq_dirty = True
         host = fetch_packed(*flat)             # the ONE readback
         return {
@@ -702,7 +765,7 @@ class MemoryIndex:
             "target_rows": host[1][:n, 0],
             "chain_src": host[2][:n, 0],
             "chain_slots": chain_slot_list,
-            "link_slots": link_slots_real,
+            "link_pool": link_pool_list,
             "link_host": host[3:],
         }
 
@@ -751,35 +814,42 @@ class MemoryIndex:
         candidates: Dict[int, Dict[str, List[Tuple[str, float]]]] = {}
         created: Dict[int, List[Tuple[str, str, float]]] = {}
         host = pending["link_host"]
-        link_slots_real = pending["link_slots"]
+        link_pool = pending["link_pool"]
         k_eff = pending["k_eff"]
         link_scale = pending["link_scale"]
+        consumed = 0
         for mi, sm in enumerate(pending["shard_modes"]):
-            sc, cd, lv = host[3 * mi], host[3 * mi + 1], host[3 * mi + 2]
+            sc, cd, ps = host[3 * mi], host[3 * mi + 1], host[3 * mi + 2]
             out_m: Dict[str, List[Tuple[str, float]]] = {}
             made: List[Tuple[str, str, float]] = []
             for bi in range(n):
                 nid = ids[bi]
                 pairs = []
                 for j in range(k_eff):
-                    slot = int(link_slots_real[mi, bi, j])
+                    p = int(ps[bi, j])
+                    consumed = max(consumed, p + 1)
                     s = float(sc[bi, j])
                     cid = (self.row_to_id.get(int(cd[bi, j]))
                            if s > S.NEG_INF / 2 else None)
                     if cid is not None and not dup[bi]:
                         pairs.append((cid, s))
+                    if p < 0:
+                        continue               # rejected: no slot consumed
                     key = (nid, cid)
-                    if lv[bi, j] > 0.5 and cid is not None and not dup[bi] \
+                    if cid is not None and not dup[bi] \
                             and key not in self.edge_slots:
-                        self.edge_slots[key] = slot
+                        self.edge_slots[key] = link_pool[p]
                         made.append((nid, cid,
                                      min(1.0, max(0.0, s * link_scale))))
                     else:
-                        reclaim.append(slot)
+                        reclaim.append(link_pool[p])
                 if not dup[bi]:
                     out_m[nid] = pairs
             candidates[sm] = out_m
             created[sm] = made
+        # compaction: everything past the last accepted position was never
+        # written — reclaim the suffix as one contiguous slice
+        self._free_edge_slots.extend(link_pool[consumed:])
         self._free_edge_slots.extend(reclaim)
         self._csr_dirty = True
         self._ivf_note_added(live_rows)
@@ -920,10 +990,6 @@ class MemoryIndex:
     # Below this many live rows an exact scan is trivially cheap and a
     # k-means build would be pure overhead.
     _IVF_MIN_ROWS = 4096
-    # Device top-k over-fetch on the IVF serving path: a reused slot can sit
-    # in both a stale member slot and the residual, and the host-side dedup
-    # in decode_topk would otherwise shrink the result below k (ADVICE r5).
-    _IVF_K_SLACK = 8
 
     def _ivf_search(self, q_pad, tid: int, k_eff: int, super_filter: int):
         """Coarse-to-fine serving scan, or None to fall through to the
@@ -949,10 +1015,11 @@ class MemoryIndex:
                   + residual.shape[0])
         if n_cand < k_eff:
             return None
-        # Over-fetch slack: duplicates (reused slot in a stale member slot
-        # AND the residual) consume device top-k positions; the host dedup
-        # then trims back to k without a shortfall.
-        k_fetch = min(k_eff + self._IVF_K_SLACK, n_cand)
+        # Over-fetch slack (config-driven, shared with the int8 fused
+        # path): duplicates (reused slot in a stale member slot AND the
+        # residual) consume device top-k positions; the host dedup then
+        # trims back to k without a shortfall.
+        k_fetch = min(k_eff + self.coarse_slack, n_cand)
         mask = S.arena_mask(st, jnp.int32(tid), super_filter)
         pq_pack = self._pq_pack
         if self.pq_serving and pq_pack is not None:
@@ -1056,17 +1123,27 @@ class MemoryIndex:
         """(Re)build the int8 shadow from ONE arena snapshot; under a mesh
         the shadow is constrained to the master's row sharding so the
         per-shard scan never gathers. Clears the dirty flag only when no
-        writer raced past ``st`` (advisor r4)."""
-        shadow = self._int8_shadow
-        if (self._int8_dirty or shadow is None
-                or shadow[0].shape[0] != st.emb.shape[0]):
-            from lazzaro_tpu.ops.quant import quantize_rows
-            shadow = quantize_rows(st.emb)
-            if self.mesh is not None:
-                shadow = (jax.device_put(shadow[0], self._mat_sharding),
-                          jax.device_put(shadow[1], self._row_sharding))
+        writer raced past ``st`` (advisor r4).
+
+        Locking: readers take their array references UNDER ``_state_lock``
+        (the returned pair is built inside the critical section), so the
+        fused-ingest donation gate — which scatters new rows' codes into
+        the shadow in place — can count those references the same way the
+        arena gate does and fall back to the copying twin while a serve is
+        holding the shadow."""
+        with self._state_lock:
+            shadow = self._int8_shadow
+            if (not self._int8_dirty and shadow is not None
+                    and shadow[0].shape[0] == st.emb.shape[0]):
+                return shadow[0], shadow[1]
+        from lazzaro_tpu.ops.quant import quantize_rows
+        shadow = quantize_rows(st.emb)
+        if self.mesh is not None:
+            shadow = (jax.device_put(shadow[0], self._mat_sharding),
+                      jax.device_put(shadow[1], self._row_sharding))
+        with self._state_lock:
             self._int8_shadow = shadow
-            if self.state is st:
+            if self._state is st:
                 # only clear the flag if no writer raced past ``st`` —
                 # otherwise rows added mid-quantize would stay invisible
                 # to int8 serving until the NEXT mutation
@@ -1183,21 +1260,46 @@ class MemoryIndex:
                 jnp.asarray(padb(tenants, -1, np.int32)),
                 jnp.asarray(padb(gate_on)))
         statics = dict(k=k_bucket, cap_take=cap_take, max_nbr=max_nbr)
+        # Quantized fused serving (ISSUE 3): with the int8 shadow active the
+        # SAME single-dispatch program streams the int8 codes for the
+        # coarse top-(k+slack), exactly rescores the survivors from the
+        # master, and runs the gate/CSR/boost tail unchanged — the fused
+        # path no longer steps aside for int8 mode. Only the arena is
+        # donated; the shadow is a read-only replica that the boost scatter
+        # (salience/access/freshness only) can never invalidate.
+        use_quant = bool(self.int8_serving) and self.mesh is None
+        if use_quant:
+            statics["slack"] = self.coarse_slack
         if boost_on.any():
             del st      # a live snapshot would trip the sole-owner gate
             now_rel = (now if now is not None else time.time()) - self.epoch
             with self._state_lock:
                 cur = self._state
-                fn = (S.search_fused
-                      if sys.getrefcount(cur) <= self._SOLE_REFS
-                      else S.search_fused_copy)
-                new_state, packed = fn(
-                    cur, *args, jnp.asarray(padb(boost_on)),
-                    jnp.float32(now_rel), jnp.float32(super_gate),
-                    jnp.float32(acc_boost), jnp.float32(nbr_boost),
-                    **statics)
+                boost_args = (jnp.asarray(padb(boost_on)),
+                              jnp.float32(now_rel), jnp.float32(super_gate),
+                              jnp.float32(acc_boost), jnp.float32(nbr_boost))
+                if use_quant:
+                    # shadow taken against ``cur`` under the lock, so the
+                    # (arena, codes) pair can never tear across a racing
+                    # writer (re-entrant RLock; rebuild is dispatch-only)
+                    q8, scale = self._int8_shadow_for(cur)
+                    fn = (S.search_fused_quant
+                          if sys.getrefcount(cur) <= self._SOLE_REFS
+                          else S.search_fused_quant_copy)
+                    new_state, packed = fn(cur, q8, scale, *args,
+                                           *boost_args, **statics)
+                else:
+                    fn = (S.search_fused
+                          if sys.getrefcount(cur) <= self._SOLE_REFS
+                          else S.search_fused_copy)
+                    new_state, packed = fn(cur, *args, *boost_args, **statics)
                 del cur
                 self.state = new_state
+        elif use_quant:
+            q8, scale = self._int8_shadow_for(st)
+            packed = S.search_fused_quant_read(st, q8, scale, *args,
+                                               jnp.float32(super_gate),
+                                               **statics)
         else:
             packed = S.search_fused_read(st, *args,
                                          jnp.float32(super_gate), **statics)

@@ -132,7 +132,8 @@ class MemorySystem:
                                  dtype=jnp.dtype(cfg.dtype), mesh=mesh,
                                  int8_serving=cfg.int8_serving,
                                  ivf_nprobe=cfg.ivf_serving,
-                                 pq_serving=cfg.pq_serving)
+                                 pq_serving=cfg.pq_serving,
+                                 coarse_slack=cfg.coarse_fetch_slack)
 
         self.query_cache = QueryCache(cfg.cache_size) if self.enable_caching else None
 
@@ -764,12 +765,14 @@ class MemorySystem:
 
     # ----------------------------------------------------------- fused serving
     def _use_fused_serving(self) -> bool:
-        """Fused retrieval serves the exact single-chip arena: under a mesh
-        the shard_map searcher owns the path, and the int8/IVF serving
-        shadows run their own optimized scans the fused kernel would
-        silently bypass."""
+        """Fused retrieval serves the single-chip arena — exact by default,
+        or through the quantized two-stage kernel (int8 coarse scan + exact
+        rescore, ``state.search_fused_quant``) when the int8 serving shadow
+        is on, so quantized mode keeps the one-dispatch turn, cross-request
+        mega-batching, and zero-RTT cache hits. Under a mesh the shard_map
+        searcher owns the path, and the IVF coarse stage still runs its own
+        prefilter scan the fused kernel would silently bypass."""
         return (self.config.serve_fused and self.mesh is None
-                and not self.index.int8_serving
                 and not self.index.ivf_nprobe)
 
     def _ensure_scheduler(self) -> QueryScheduler:
@@ -2287,7 +2290,8 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                                         mesh=self.mesh,
                                         int8_serving=self.config.int8_serving,
                                         ivf_nprobe=self.config.ivf_serving,
-                                        pq_serving=self.config.pq_serving)
+                                        pq_serving=self.config.pq_serving,
+                                        coarse_slack=self.config.coarse_fetch_slack)
             # Pairing check: both halves carry the save's snapshot_id; a
             # mismatch means a crash landed between the two writes and one
             # half is stale. Restore proceeds (both halves are individually

@@ -610,9 +610,27 @@ edges_delete_for_nodes, edges_delete_for_nodes_copy = _donated_pair(
 # ---------------------------------------------------------------------------
 
 
+def _shadow_scatter(shadow, rows: jax.Array, emb_stored: jax.Array):
+    """Incremental int8 serving-shadow maintenance INSIDE the fused ingest
+    program: quantize exactly the rows being written (``emb_stored`` is the
+    normalized arena-dtype embedding the node scatter stores) and scatter
+    their codes + scales into the shadow — an O(batch) update instead of
+    the host-side O(arena) lazy re-quantize the dirty flag used to force.
+    ``shadow`` is ``(q8, scale)`` or None (int8 serving off / shadow not
+    yet built); None passes through untouched."""
+    if shadow is None:
+        return None
+    from lazzaro_tpu.ops.quant import quantize_rows
+
+    q8, scale = shadow
+    q_new, s_new = quantize_rows(emb_stored)
+    return (q8.at[rows].set(q_new), scale.at[rows].set(s_new))
+
+
 def _ingest_fused(
     arena: ArenaState,
     edges: EdgeState,
+    shadow,                  # (q8 [cap+1, d] i8, scale [cap+1] f32) or None
     rows: jax.Array,         # [B] i32 new-node rows, sentinel-padded
     emb: jax.Array,          # [B, d]
     salience: jax.Array,     # [B] f32
@@ -627,28 +645,34 @@ def _ingest_fused(
     chain_src: jax.Array,    # [C] i32 arena rows (-1 padding)
     chain_tgt: jax.Array,    # [C] i32
     chain_w: jax.Array,      # [C] f32
-    link_slots: jax.Array,   # [n_modes, B, k] i32 edge slots (sentinel-padded)
+    link_pool: jax.Array,    # [P+1] i32 compaction slot pool (last = sentinel)
     now: jax.Array,
     tenant: jax.Array,
     link_gate: jax.Array,
     link_scale: jax.Array,
     k: int,
     shard_modes: Tuple[int, ...] = (1, 0),
-) -> Tuple[ArenaState, EdgeState, Tuple[jax.Array, ...]]:
+) -> Tuple[ArenaState, EdgeState, object, Tuple[jax.Array, ...]]:
     """The per-conversation ingest sequence — ``arena_add`` →
     ``arena_merge_touch`` → ``arena_link_candidates_multi`` → gated
     ``edges_add`` — fused into ONE donated device program.
 
-    The host pre-allocates one edge slot per chain pair and per potential
-    (mode, new-row, candidate) link; the gate (score > link_gate, valid
-    non-sentinel query row, not a duplicate of an earlier mode's hit) is
-    evaluated ON DEVICE and rejected slots are scattered with live=False
-    (the host reclaims them after the readback). Host round trips per
-    conversation drop from ~4 dispatches + 1 readback to 1 + 1: the
-    returned per-mode ``(scores, cands, live)`` triples are the single
-    packed readback the host needs for id decode and edge bookkeeping."""
+    The host hands the kernel a POOL of edge slots covering the worst case
+    (every potential (mode, new-row, candidate) link); the gate (score >
+    link_gate, valid non-sentinel query row, not a duplicate of an earlier
+    mode's hit) is evaluated ON DEVICE and accepted edges are prefix-sum
+    compacted into the pool's leading slots — rejected candidates never
+    write the edge arena, and the host reclaims the untouched pool suffix
+    as one slice. Host round trips per conversation drop from ~4
+    dispatches + 1 readback to 1 + 1: the returned per-mode ``(scores,
+    cands, pos)`` triples (pos = pool position, -1 = rejected) are the
+    single packed readback the host needs for id decode and edge
+    bookkeeping. With int8 serving on, the shadow codes for the written
+    rows update in the same program (``_shadow_scatter``)."""
+    emb_stored = normalize(emb).astype(arena.emb.dtype)
     arena = _arena_add(arena, rows, emb, salience, timestamp, type_id,
                        shard_id, tenant_id, is_super)
+    shadow = _shadow_scatter(shadow, rows, emb_stored)
     arena = _arena_merge_touch(arena, touch_rows, touch_sal, now)
     link_flat = _arena_link_candidates_multi(arena, rows, rows, tenant, k,
                                              shard_modes)
@@ -657,24 +681,31 @@ def _ingest_fused(
                        jnp.ones((n_chain,), jnp.int32), now, tenant,
                        chain_src >= 0)
     valid_q = rows < arena.capacity        # sentinel-padded rows make no edges
-    edges, outs = _gated_link_insert(edges, link_flat, link_slots, rows,
+    edges, outs = _gated_link_insert(edges, link_flat, link_pool, rows,
                                      valid_q, now, tenant, link_gate,
                                      link_scale, shard_modes)
-    return arena, edges, outs
+    return arena, edges, shadow, outs
 
 
-def _gated_link_insert(edges, link_flat, link_slots, src_rows, valid_q, now,
+def _gated_link_insert(edges, link_flat, link_pool, src_rows, valid_q, now,
                        tenant, link_gate, link_scale, shard_modes):
-    """Device-gated similarity-edge insert shared by the fused ingest
-    kernels: per shard mode, slots pre-allocated by the host get a live/
-    dead verdict on device (gate pass, valid source row, not already
-    inserted by an earlier mode) and the readback triples tell the host
-    which slots stuck."""
+    """Device-gated similarity-edge insert with prefix-sum slot compaction
+    (ROADMAP ceiling #2), shared by the fused ingest kernels: per shard
+    mode the gate verdict (gate pass, valid source row, not already
+    inserted by an earlier mode) is evaluated on device, then accepted
+    edges across ALL modes pack into a dense PREFIX of the host-provided
+    slot pool via a cumulative sum over the gate mask. Rejected candidates
+    scatter to the sentinel slot — the edge arena never sees speculative
+    dead writes — and ONE ``_edges_add`` covers every mode. The readback
+    triples carry each candidate's pool position (-1 = rejected) so the
+    host can register accepted keys and reclaim the unused pool suffix as
+    a single contiguous slice."""
     # The link-scan top-k results feed BOTH the gate logic here and the
     # packed readback; the barrier stops XLA from splitting those consumers
     # into duplicate full-arena sorts (same fix as _search_fused_scan).
     link_flat = jax.lax.optimization_barrier(link_flat)
-    outs = []
+    pool_cap = link_pool.shape[0] - 1      # last pool entry = sentinel slot
+    per_mode = []
     prior = []                             # (cands, live) of earlier modes
     for mi in range(len(shard_modes)):
         scores, cand = link_flat[2 * mi], link_flat[2 * mi + 1]
@@ -686,17 +717,34 @@ def _gated_link_insert(edges, link_flat, link_slots, src_rows, valid_q, now,
             dup = (cand[:, :, None] == p_cand[:, None, :]) & p_live[:, None, :]
             live = live & ~dup.any(-1)
         prior.append((cand, live))
-        src_b = jnp.broadcast_to(src_rows[:, None], cand.shape)
-        edges = _edges_add(
-            edges, link_slots[mi].reshape(-1), src_b.reshape(-1),
-            cand.reshape(-1), (scores * link_scale).reshape(-1),
-            jnp.ones((live.size,), jnp.int32), now, tenant, live.reshape(-1))
-        outs.extend((scores, cand, live))
+        per_mode.append((scores, cand, live))
+    live_all = jnp.concatenate([lv.reshape(-1) for _, _, lv in per_mode])
+    pos_all = jnp.cumsum(live_all.astype(jnp.int32)) - 1
+    ok = live_all & (pos_all < pool_cap)
+    slots = link_pool[jnp.where(ok, jnp.minimum(pos_all, pool_cap - 1),
+                                pool_cap)]
+    src_all = jnp.concatenate([
+        jnp.broadcast_to(src_rows[:, None], c.shape).reshape(-1)
+        for _, c, _ in per_mode])
+    cand_all = jnp.concatenate([c.reshape(-1) for _, c, _ in per_mode])
+    w_all = jnp.concatenate([(s * link_scale).reshape(-1)
+                             for s, _, _ in per_mode])
+    edges = _edges_add(edges, slots, src_all, cand_all, w_all,
+                       jnp.ones((live_all.size,), jnp.int32), now, tenant,
+                       ok)
+    outs = []
+    off = 0
+    for scores, cand, live in per_mode:
+        m = live.size
+        pos_m = jnp.where(live.reshape(-1), pos_all[off:off + m],
+                          -1).reshape(live.shape)
+        outs.extend((scores, cand, pos_m))
+        off += m
     return edges, tuple(outs)
 
 
 ingest_fused, ingest_fused_copy = _donated_pair(
-    _ingest_fused, donate=(0, 1), static_argnames=("k", "shard_modes"))
+    _ingest_fused, donate=(0, 1, 2), static_argnames=("k", "shard_modes"))
 
 
 # ---------------------------------------------------------------------------
@@ -709,6 +757,7 @@ ingest_fused, ingest_fused_copy = _donated_pair(
 def _ingest_dedup_fused(
     arena: ArenaState,
     edges: EdgeState,
+    shadow,                  # (q8 [cap+1, d] i8, scale [cap+1] f32) or None
     rows: jax.Array,         # [B] i32 candidate row per fact, sentinel-padded
     emb: jax.Array,          # [B, d]
     salience: jax.Array,     # [B] f32 (doubles as the merge-touch candidate)
@@ -719,7 +768,7 @@ def _ingest_dedup_fused(
     is_super: jax.Array,     # [B] bool
     chain_gid: jax.Array,    # [B] i32 densified shard-group id, -1 padding
     chain_slots: jax.Array,  # [B] i32 edge slot per fact, sentinel-padded
-    link_slots: jax.Array,   # [n_modes, B, k] i32 edge slots
+    link_pool: jax.Array,    # [P+1] i32 compaction slot pool (last = sentinel)
     now: jax.Array,
     tenant: jax.Array,
     dedup_gate: jax.Array,   # cosine threshold; > 1.0 disables dedup
@@ -728,7 +777,7 @@ def _ingest_dedup_fused(
     link_scale: jax.Array,
     k: int,
     shard_modes: Tuple[int, ...] = (1, 0),
-) -> Tuple[ArenaState, EdgeState, Tuple[jax.Array, ...]]:
+) -> Tuple[ArenaState, EdgeState, object, Tuple[jax.Array, ...]]:
     """``_ingest_fused`` plus the dedup probe the classic pipeline pays a
     separate dispatch+readback for: masked top-1 against the PRE-add arena
     and an intra-batch gram resolve duplicate facts ON DEVICE, duplicate
@@ -790,6 +839,7 @@ def _ingest_dedup_fused(
     add_rows = jnp.where(live_new, rows, cap)
     arena = _arena_add(arena, add_rows, emb, salience, timestamp, type_id,
                        shard_id, tenant_id, is_super)
+    shadow = _shadow_scatter(shadow, add_rows, qd)
     touch_rows = jnp.where(dup, target, cap)
     arena = _arena_merge_touch(arena, touch_rows, salience, now)
     link_flat = _arena_link_candidates_multi(arena, add_rows, rows, tenant,
@@ -798,18 +848,19 @@ def _ingest_dedup_fused(
     edges = _edges_add(edges, chain_slots, chain_src, rows,
                        jnp.broadcast_to(chain_w, (b,)),
                        jnp.ones((b,), jnp.int32), now, tenant, chain_live)
-    edges, outs = _gated_link_insert(edges, link_flat, link_slots, rows,
+    edges, outs = _gated_link_insert(edges, link_flat, link_pool, rows,
                                      live_new, now, tenant, link_gate,
                                      link_scale, shard_modes)
     # [B] verdicts broadcast to [B, k] so every readback leaf has one shape
     # and the host fetches them all in ONE packed transfer
     wide = tuple(jnp.broadcast_to(a[:, None], (b, k))
                  for a in (dup.astype(jnp.int32), target, chain_src))
-    return arena, edges, wide + outs
+    return arena, edges, shadow, wide + outs
 
 
 ingest_dedup_fused, ingest_dedup_fused_copy = _donated_pair(
-    _ingest_dedup_fused, donate=(0, 1), static_argnames=("k", "shard_modes"))
+    _ingest_dedup_fused, donate=(0, 1, 2),
+    static_argnames=("k", "shard_modes"))
 
 
 # ---------------------------------------------------------------------------
@@ -818,6 +869,54 @@ ingest_dedup_fused, ingest_dedup_fused_copy = _donated_pair(
 # donated device program with ONE packed readback (the serving-side analog
 # of ingest_fused; see ISSUE 2).
 # ---------------------------------------------------------------------------
+
+
+def _csr_neighbor_rows(state: ArenaState, csr_indptr: jax.Array,
+                       csr_nbr: jax.Array, acc_rows: jax.Array,
+                       tenant_c: jax.Array, max_nbr: int) -> jax.Array:
+    """CSR neighbor gather for the access-boosted rows with per-query dedup
+    (sentinel row's indptr slice is empty, so masked rows gather nothing).
+    Shared by the exact and quantized fused serving scans."""
+    cap = state.capacity
+    start = csr_indptr[acc_rows]
+    end = csr_indptr[acc_rows + 1]
+    idx = start[:, :, None] + jnp.arange(max_nbr)[None, None, :]
+    ok = idx < end[:, :, None]
+    nbr = jnp.where(ok, csr_nbr[jnp.minimum(idx, csr_nbr.shape[0] - 1)],
+                    -1)
+    flat = nbr.reshape(nbr.shape[0], -1)                  # [C, M]
+    m = flat.shape[1]
+    safe = jnp.maximum(flat, 0)
+    valid_n = ((flat >= 0) & state.alive[safe]
+               & (state.tenant_id[safe] == tenant_c[:, None]))
+    # per-query dedup (keep first occurrence): classic boosts a shared
+    # neighbor ONCE per turn however many retrieved nodes touch it...
+    dup = ((flat[:, :, None] == flat[:, None, :])
+           & jnp.tri(m, k=-1, dtype=bool)[None, :, :]).any(-1)
+    # ...and never boosts a node that was itself retrieved
+    in_res = (flat[:, :, None] == acc_rows[:, None, :]).any(-1)
+    return jnp.where(valid_n & ~dup & ~in_res, flat, cap)
+
+
+def _gate_and_boost_rows(state: ArenaState, csr_indptr, csr_nbr, gate_s,
+                         gate_r, ann_s, ann_r, valid_c, tenant_c, gate_c,
+                         boost_c, super_gate, cap_take: int, max_nbr: int):
+    """The post-top-k tail both serving scans share: the device-side gate
+    verdict, the access-boost row list, and the CSR neighbor gather.
+
+    The hierarchy decision happens ON DEVICE: where the gate fires the host
+    serves super-node children it alone knows, so the device must NOT boost
+    the ANN rows (the host falls back to the classic boost for those
+    queries — exact parity on the fast path)."""
+    cap = state.capacity
+    fast = gate_c & (gate_s > super_gate)
+    do_boost = boost_c & valid_c & ~fast                  # [C]
+    hit = ann_s[:, :cap_take] > NEG_INF / 2
+    acc_rows = jnp.where(hit & do_boost[:, None],
+                         ann_r[:, :cap_take], cap)        # [C, cap_take]
+    nbr_rows = _csr_neighbor_rows(state, csr_indptr, csr_nbr, acc_rows,
+                                  tenant_c, max_nbr)
+    return fast, acc_rows, nbr_rows
 
 
 def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
@@ -831,7 +930,6 @@ def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
     scan), the device-side gate verdict, and the CSR neighbor gather with
     per-query dedup. Returns sentinel-padded row lists for the scatter
     phase (``capacity`` is the sentinel row index)."""
-    cap = state.capacity
 
     def chunk(q_c, valid_c, tenant_c, gate_c, boost_c):
         qn = normalize(q_c).astype(state.emb.dtype)
@@ -850,35 +948,10 @@ def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
         gate_s, gate_r, ann_s, ann_r = jax.lax.optimization_barrier(
             (gate_s, gate_r, ann_s, ann_r))
         gate_s, gate_r = gate_s[:, 0], gate_r[:, 0]
-        # The hierarchy decision, ON DEVICE: where the gate fires the host
-        # serves super-node children it alone knows, so the device must
-        # NOT boost the ANN rows (the host falls back to the classic boost
-        # for those queries — exact parity on the fast path).
-        fast = gate_c & (gate_s > super_gate)
-        do_boost = boost_c & valid_c & ~fast                  # [C]
-        hit = ann_s[:, :cap_take] > NEG_INF / 2
-        acc_rows = jnp.where(hit & do_boost[:, None],
-                             ann_r[:, :cap_take], cap)        # [C, cap_take]
-        # CSR neighbor gather for the access-boosted rows (sentinel row's
-        # indptr slice is empty, so masked rows gather nothing)
-        start = csr_indptr[acc_rows]
-        end = csr_indptr[acc_rows + 1]
-        idx = start[:, :, None] + jnp.arange(max_nbr)[None, None, :]
-        ok = idx < end[:, :, None]
-        nbr = jnp.where(ok, csr_nbr[jnp.minimum(idx, csr_nbr.shape[0] - 1)],
-                        -1)
-        flat = nbr.reshape(nbr.shape[0], -1)                  # [C, M]
-        m = flat.shape[1]
-        safe = jnp.maximum(flat, 0)
-        valid_n = ((flat >= 0) & state.alive[safe]
-                   & (state.tenant_id[safe] == tenant_c[:, None]))
-        # per-query dedup (keep first occurrence): classic boosts a shared
-        # neighbor ONCE per turn however many retrieved nodes touch it...
-        dup = ((flat[:, :, None] == flat[:, None, :])
-               & jnp.tri(m, k=-1, dtype=bool)[None, :, :]).any(-1)
-        # ...and never boosts a node that was itself retrieved
-        in_res = (flat[:, :, None] == acc_rows[:, None, :]).any(-1)
-        nbr_rows = jnp.where(valid_n & ~dup & ~in_res, flat, cap)
+        fast, acc_rows, nbr_rows = _gate_and_boost_rows(
+            state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
+            valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
+            max_nbr)
         return gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows
 
     return chunked_map_multi(chunk, (q, q_valid, tenant, gate_on, boost_on))
@@ -911,6 +984,17 @@ def _search_fused(
         _search_fused_scan(state, csr_indptr, csr_nbr, q, q_valid, tenant,
                            gate_on, boost_on, super_gate, k, cap_take,
                            max_nbr)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+def _boost_scatter(state: ArenaState, acc_rows: jax.Array,
+                   nbr_rows: jax.Array, now: jax.Array, acc_boost: jax.Array,
+                   nbr_boost: jax.Array) -> ArenaState:
+    """Scatter phase shared by the exact and quantized fused serving
+    kernels: count-weighted access/neighbor salience boosts, capped at 1.0,
+    with freshness inheritance for every touched row."""
     n = state.emb.shape[0]
     acc_cnt = (jnp.zeros((n,), jnp.int32).at[acc_rows.reshape(-1)].add(1)
                .at[n - 1].set(0))
@@ -919,11 +1003,10 @@ def _search_fused(
     sal = (state.salience + acc_cnt.astype(jnp.float32) * acc_boost
            + nbr_cnt.astype(jnp.float32) * nbr_boost)
     touched = (acc_cnt > 0) | (nbr_cnt > 0)
-    state = state.replace(
+    return state.replace(
         salience=jnp.where(touched, jnp.minimum(sal, 1.0), state.salience),
         access_count=state.access_count + acc_cnt,
         last_accessed=jnp.where(touched, now, state.last_accessed))
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
 
 
 def _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast) -> jax.Array:
@@ -956,6 +1039,144 @@ def search_fused_read(state: ArenaState, csr_indptr: jax.Array,
     gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_scan(
         state, csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
         super_gate, k, cap_take, max_nbr)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+# ---------------------------------------------------------------------------
+# Quantized fused serving (ISSUE 3): the same single-dispatch chat-turn
+# program, but the whole-arena scan streams the int8 shadow (half the HBM
+# bytes, int8×int8→int32 on the MXU) for a coarse top-(k+slack), then the
+# few survivors are EXACTLY rescored from the master arena with a gathered-
+# row dot before the gate / CSR gather / boost scatter run unchanged. This
+# is the EdgeRAG two-stage idiom fused into one program: at 1M rows the
+# coarse scan is the bandwidth floor and the rescore is O(Q·(k+slack)·d).
+# ---------------------------------------------------------------------------
+
+
+def _search_fused_quant_scan(state: ArenaState, q8a: jax.Array,
+                             scale_a: jax.Array, csr_indptr: jax.Array,
+                             csr_nbr: jax.Array, q: jax.Array,
+                             q_valid: jax.Array, tenant: jax.Array,
+                             gate_on: jax.Array, boost_on: jax.Array,
+                             super_gate: jax.Array, k: int, slack: int,
+                             cap_take: int, max_nbr: int):
+    """Quantized per-chunk compute phase: int8 coarse scan over the shadow
+    (``q8a`` codes + ``scale_a`` per-row scales, ops/quant.py layout) for
+    BOTH retrieval tiers — super gate candidates and main ANN candidates
+    are different masks over the ONE int8 score matrix — then an exact
+    bf16/f32 rescore of the k+slack survivors via a gathered-row dot. The
+    slack absorbs the ~1e-2 int8 ranking error at the k boundary (ISSUE 3
+    satellite: config-driven, shared with the IVF over-fetch) so the exact
+    top-k can't lose a true member the coarse scan ranked at k+3."""
+    from lazzaro_tpu.ops.quant import quantize_rows
+
+    n = state.emb.shape[0]
+    k_fetch = min(k + slack, n)
+    g_fetch = min(1 + slack, n)
+
+    def chunk(q_c, valid_c, tenant_c, gate_c, boost_c):
+        qn = normalize(q_c)                                   # [C, d] f32
+        qq, qs = quantize_rows(qn)
+        dots = jax.lax.dot_general(
+            qq, q8a, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)                 # [C, cap+1] i32
+        coarse = (dots.astype(jnp.float32)
+                  * qs[:, None] * scale_a[None, :])
+        alive_t = state.alive[None, :] & (
+            state.tenant_id[None, :] == tenant_c[:, None])
+        sup = state.is_super[None, :]
+        cg_s, cg_r = jax.lax.top_k(
+            jnp.where(alive_t & sup, coarse, NEG_INF), g_fetch)
+        ca_s, ca_r = jax.lax.top_k(
+            jnp.where(alive_t & ~sup, coarse, NEG_INF), k_fetch)
+        # Same consumer-split hazard as _search_fused_scan: the coarse
+        # top-k feeds both the rescore gather and (via it) the readback —
+        # without the barrier XLA can duplicate the full-arena sorts.
+        cg_s, cg_r, ca_s, ca_r = jax.lax.optimization_barrier(
+            (cg_s, cg_r, ca_s, ca_r))
+        qd = qn.astype(state.emb.dtype)
+
+        def rescore(rows_c, coarse_s):
+            g = state.emb[rows_c]                             # [C, kf, d]
+            ex = jnp.einsum("cd,ckd->ck", qd, g,
+                            preferred_element_type=jnp.float32)
+            return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
+
+        ann_ex = rescore(ca_r, ca_s)
+        ann_s, sel = jax.lax.top_k(ann_ex, k)
+        ann_r = jnp.take_along_axis(ca_r, sel, axis=1)
+        # The super gate is threshold-sensitive (0.4): its VERDICT uses the
+        # exact rescored score, so quantization error can only cost a gate
+        # candidate ranked below coarse position 1+slack, never flip the
+        # threshold comparison itself.
+        gate_ex = rescore(cg_r, cg_s)
+        g_s, g_sel = jax.lax.top_k(gate_ex, 1)
+        gate_s = g_s[:, 0]
+        gate_r = jnp.take_along_axis(cg_r, g_sel, axis=1)[:, 0]
+        fast, acc_rows, nbr_rows = _gate_and_boost_rows(
+            state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
+            valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
+            max_nbr)
+        return gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows
+
+    return chunked_map_multi(chunk, (q, q_valid, tenant, gate_on, boost_on))
+
+
+def _search_fused_quant(
+    state: ArenaState,
+    q8a: jax.Array,          # [cap+1, d] i8 serving shadow codes
+    scale_a: jax.Array,      # [cap+1] f32 per-row scales
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """``search_fused`` with the int8 coarse scan + exact rescore stage:
+    one donated dispatch + one packed readback per coalesced batch, int8
+    mode included. Only the arena state is donated — the shadow is a
+    long-lived read-only replica (boost scatters touch salience/access/
+    freshness, never the embeddings, so the codes stay valid)."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
+        _search_fused_quant_scan(state, q8a, scale_a, csr_indptr, csr_nbr,
+                                 q, q_valid, tenant, gate_on, boost_on,
+                                 super_gate, k, slack, cap_take, max_nbr)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+search_fused_quant, search_fused_quant_copy = _donated_pair(
+    _search_fused_quant, static_argnames=("k", "slack", "cap_take",
+                                          "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
+                                             "max_nbr"))
+def search_fused_quant_read(state: ArenaState, q8a: jax.Array,
+                            scale_a: jax.Array, csr_indptr: jax.Array,
+                            csr_nbr: jax.Array, q: jax.Array,
+                            q_valid: jax.Array, tenant: jax.Array,
+                            gate_on: jax.Array, super_gate: jax.Array,
+                            k: int, slack: int, cap_take: int,
+                            max_nbr: int) -> jax.Array:
+    """Read-only twin of ``search_fused_quant`` (pure ``search_memories``
+    fleets in int8 mode): same coarse-scan + exact-rescore compute, no
+    state mutation, no donation dance."""
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_quant_scan(
+        state, q8a, scale_a, csr_indptr, csr_nbr, q, q_valid, tenant,
+        gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr)
     return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
 
 

@@ -10,10 +10,22 @@ halving scan bytes AND running the dot products on the MXU's int8 path
 route below the bf16 bandwidth floor, as opposed to a faster clock.
 
 The quantized copy is a SERVING SHADOW: the bf16/f32 arena stays the
-mutable master (scatter updates, decay sweeps, exact merge thresholds);
-``core/index.py`` re-quantizes lazily when enough rows changed. Reference
-analog: LanceDB's ANN index over the raw vectors (vector_store.py:132-140)
-— same split of exact store vs. scan-optimized replica.
+mutable master (scatter updates, decay sweeps, exact merge thresholds).
+Freshness is incremental where it matters: the fused ingest program
+scatters codes+scales for freshly written rows in-kernel
+(``core/state._shadow_scatter`` — O(batch)), and ``core/index.py``
+re-quantizes lazily only when no maintained shadow exists (first build,
+arena growth, mesh path). Reference analog: LanceDB's ANN index over the
+raw vectors (vector_store.py:132-140) — same split of exact store vs.
+scan-optimized replica.
+
+Serving consumes the shadow two ways: the classic ``quantized_topk`` scan
+below (pure int8 ranking; mesh path via ops/topk.make_sharded_int8_topk),
+and since ISSUE 3 the single-dispatch fused chat-turn program
+(``core/state.search_fused_quant``) which uses the int8 scores only as a
+COARSE top-(k+slack) stage and exactly rescores the survivors from the
+master — returned scores and threshold verdicts never carry quantization
+error there.
 
 MEASURED (r5): the win is TPU-specific by design — on the 1-core CPU
 fallback int8 is SLOWER than exact (67.4 ms vs 60.7 ms at 100k×768,

@@ -160,6 +160,55 @@ def make_sharded_int8_topk(mesh: Mesh, axis: str = "data", k: int = 10):
     return search
 
 
+def make_sharded_multitenant_topk(mesh: Mesh, axis: str = "data",
+                                  k: int = 10):
+    """Distributed masked top-k with a PER-QUERY tenant column (ROADMAP
+    ceiling #4): one mixed-tenant mega-batch dispatches ONCE over the pod
+    instead of once per tenant. Each chip scores its local rows for every
+    query, masks with ``alive ∧ (tenant_col == query_tenant)`` — the same
+    [Q, N/n] mask arithmetic the single-chip fused kernel uses — takes a
+    local top-k, and the k-candidate combine rides the usual ICI
+    ``all_gather``.
+
+    Returns ``search(emb, alive, tenant_col, query, query_tenant) ->
+    (scores [Q, k], global_rows [Q, k])`` with ``emb [N, d]``, ``alive
+    [N]``, ``tenant_col [N]`` sharded along ``axis``; the query matrix and
+    its [Q] tenant vector are replicated. Queries whose tenant id is -1
+    (unknown tenant) match nothing and come back all-NEG_INF."""
+    from lazzaro_tpu.ops.chunking import nt_dot
+
+    def local_search(emb_l, alive_l, tenant_l, query, qtenant):
+        shard_idx = jax.lax.axis_index(axis)
+        local_n = emb_l.shape[0]
+        k_eff = min(k, local_n)
+        scores = nt_dot(query.astype(emb_l.dtype), emb_l)       # [Q, N/n]
+        mask = alive_l[None, :] & (tenant_l[None, :] == qtenant[:, None])
+        scores = jnp.where(mask, scores, NEG_INF)
+        top_s, top_i = jax.lax.top_k(scores, k_eff)
+        top_i = top_i + shard_idx * local_n                 # globalize rows
+        all_s = jax.lax.all_gather(top_s, axis)
+        all_i = jax.lax.all_gather(top_i, axis)
+        all_s = jnp.moveaxis(all_s, 0, 1).reshape(top_s.shape[0], -1)
+        all_i = jnp.moveaxis(all_i, 0, 1).reshape(top_s.shape[0], -1)
+        fin_s, fin_pos = jax.lax.top_k(all_s, k)
+        fin_i = jnp.take_along_axis(all_i, fin_pos, axis=1)
+        return fin_s, fin_i
+
+    mapped = shard_map(
+        local_search,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(axis), P(None, None), P(None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def search(emb, alive, tenant_col, query, qtenant):
+        return mapped(emb, alive, tenant_col, jnp.atleast_2d(query), qtenant)
+
+    return search
+
+
 def shard_rows(mesh: Mesh, axis: str = "data") -> NamedSharding:
     """Row-sharding spec for [N, ...] index arrays."""
     return NamedSharding(mesh, P(axis))

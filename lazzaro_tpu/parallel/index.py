@@ -59,7 +59,12 @@ class ShardedMemoryIndex:
         self.row_to_id: Dict[int, str] = {}
         self._tenants: Dict[str, int] = {}
 
+        self._k = k
         self._search = make_sharded_topk(mesh, axis, k=k)
+        # Per-row tenant serving kernel (ROADMAP ceiling #4), built lazily
+        # on the first coalesced serve: pod-scale mixed-tenant batches
+        # dispatch ONCE total instead of once per tenant group.
+        self._serve_search = None
         self._update = jax.jit(self._update_impl, donate_argnums=(0, 1, 2, 3))
         self._decay = jax.jit(self._decay_impl, donate_argnums=(0,))
 
@@ -184,28 +189,47 @@ class ShardedMemoryIndex:
 
     def serve_requests(self, reqs) -> List:
         """``serve.QueryScheduler`` executor for the pod-sharded path: one
-        coalesced batch of :class:`serve.RetrievalRequest`s becomes one
-        distributed top-k per tenant group (queries for the same tenant
-        share a mask, so they ride one shard_map dispatch; distinct tenants
-        dispatch separately — the lean sharded index masks per batch, not
-        per row like ``MemoryIndex``'s fused kernel). No edge arena lives
-        here, so boost/gate requests serve as plain reads: ``fast`` and
-        ``boosted`` stay False and the orchestrator's classic host path
-        pays any boosts."""
+        coalesced batch of :class:`serve.RetrievalRequest`s becomes ONE
+        distributed top-k for the whole mixed-tenant batch — each query
+        carries its tenant id into the kernel as a replicated column and
+        isolation is the per-row ``tenant_col == query_tenant`` mask
+        (ROADMAP ceiling #4; previously the batch dispatched once per
+        tenant group). No edge arena lives here, so boost/gate requests
+        serve as plain reads: ``fast`` and ``boosted`` stay False and the
+        orchestrator's classic host path pays any boosts."""
+        from lazzaro_tpu.ops.topk import make_sharded_multitenant_topk
         from lazzaro_tpu.serve.scheduler import RetrievalResult
+        from lazzaro_tpu.utils.batching import decode_topk, pad_to_pow2
 
         results = [RetrievalResult() for _ in reqs]
-        by_tenant: Dict[str, List[int]] = {}
+        nq = len(reqs)
+        if nq == 0:
+            return results
+        q = np.zeros((nq, self.dim), np.float32)
+        tids = np.full((nq,), -1, np.int32)
         for i, r in enumerate(reqs):
-            by_tenant.setdefault(r.tenant, []).append(i)
-        for tenant, idxs in by_tenant.items():
-            qs = np.stack([np.asarray(reqs[i].query, np.float32).reshape(-1)
-                           for i in idxs])
-            per_query = self.search_batch(qs, tenant)
-            for i, (ids, scores) in zip(idxs, per_query):
-                k = int(reqs[i].k)
-                results[i].ids = ids[:k]
-                results[i].scores = scores[:k]
+            v = np.asarray(r.query, np.float32).reshape(-1)
+            tid = self._tenants.get(r.tenant)
+            if v.size != self.dim or tid is None:
+                continue                    # tenant -1 matches no rows
+            q[i] = v / max(float(np.linalg.norm(v)), 1e-9)
+            tids[i] = tid
+        if (tids < 0).all():
+            return results
+        if self._serve_search is None:
+            self._serve_search = make_sharded_multitenant_topk(
+                self.mesh, self.axis, k=self._k)
+        qp = pad_to_pow2(q)
+        tp = np.full((qp.shape[0],), -1, np.int32)
+        tp[:nq] = tids
+        scores, rows = self._serve_search(self.emb, self.alive, self.tenant,
+                                          jnp.asarray(qp), jnp.asarray(tp))
+        decoded = decode_topk(np.asarray(scores)[:nq], np.asarray(rows)[:nq],
+                              self.row_to_id, NEG_INF)
+        for i, (ids, sc) in enumerate(decoded):
+            k = int(reqs[i].k)
+            results[i].ids = ids[:k]
+            results[i].scores = sc[:k]
         return results
 
     def decay(self, tenant: str, rate: float, floor: float = 0.2) -> None:

@@ -25,9 +25,12 @@ the way Ragged Paged Attention coalesces ragged decode work on TPU:
 
 The scheduler is deliberately generic over its ``executor`` callable:
 ``MemoryIndex`` plugs in the fused single-chip kernel
-(``search_fused_requests``), while ``parallel.index.ShardedMemoryIndex``
-plugs in its shard_map distributed top-k — same coalescing, same policy,
-different device program.
+(``search_fused_requests`` — which itself routes to the exact or the
+quantized two-stage program depending on ``int8_serving``, so int8 mode
+keeps the cross-request mega-batching and the one-dispatch turn), while
+``parallel.index.ShardedMemoryIndex`` plugs in its shard_map distributed
+top-k (per-query tenant column: one pod dispatch per mixed-tenant batch)
+— same coalescing, same policy, different device program.
 """
 
 from __future__ import annotations

@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Guard: fail when a bench artifact records a fused-serving dispatch
+regression.
+
+The fused serving acceptance bar (ISSUE 2/3) is ONE device dispatch per
+coalesced retrieval batch. Bench stages that measure a fused path record a
+MEASURED ``dispatches_per_turn`` in their JSON artifacts (bench.py
+``bench_fused_quant`` wraps the jit entry points and counts); this script
+walks every ``bench_artifacts/*.json`` (or the paths passed as arguments)
+for ``dispatches_per_turn`` keys and exits nonzero if any value != 1 — so
+a refactor that quietly splits the fused program back into multiple
+dispatches turns red in CI instead of shipping.
+
+Usage:
+    python scripts/check_dispatch_counts.py [artifact.json ...]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def _walk(obj, path, hits):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            here = f"{path}.{k}"
+            if k == "dispatches_per_turn":
+                hits.append((here, v))
+            else:
+                _walk(v, here, hits)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _walk(v, f"{path}[{i}]", hits)
+
+
+def main(argv):
+    if argv:
+        paths = argv
+    else:
+        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "bench_artifacts")
+        paths = sorted(glob.glob(os.path.join(root, "*.json")))
+    checked = 0
+    bad = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
+            continue
+        hits = []
+        _walk(data, os.path.basename(p), hits)
+        for loc, v in hits:
+            checked += 1
+            if v != 1:
+                bad.append((loc, v))
+    for loc, v in bad:
+        print(f"REGRESSION: {loc} == {v!r} (expected 1)")
+    print(f"[check] {checked} dispatches_per_turn value(s) across "
+          f"{len(paths)} artifact(s); {len(bad)} regression(s)")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -262,14 +262,15 @@ def test_multi_tenant_batch_isolation():
         ms.close()
 
 
-def test_fused_serving_bypassed_for_shadowed_modes():
-    """int8/IVF serving shadows own their optimized scans — the fused path
-    must step aside instead of silently serving the exact master."""
+def test_fused_serving_covers_int8_but_not_ivf():
+    """Since ISSUE 3 the fused path serves int8 mode itself (the quantized
+    coarse-scan + exact-rescore kernel) — only the IVF coarse stage still
+    owns its own prefilter scan and bypasses the fused program."""
     with tempfile.TemporaryDirectory() as tmp:
         ms = _ingest(_system(tmp))
         assert ms._use_fused_serving()
         ms.index.int8_serving = True
-        assert not ms._use_fused_serving()
+        assert ms._use_fused_serving()     # quant kernel serves this mode
         ms.index.int8_serving = False
         ms.index.ivf_nprobe = 4
         assert not ms._use_fused_serving()

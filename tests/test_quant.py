@@ -105,6 +105,44 @@ def test_system_behavior_parity_with_int8_serving(tmp_path):
     assert any("data engineer" in h for h in int8_hits)
 
 
+def test_fused_ingest_maintains_shadow_incrementally():
+    """ISSUE 3 tentpole invariant: once the shadow exists, the fused ingest
+    scatter keeps the int8 codes fresh IN-KERNEL (O(batch) scatter) — no
+    host-side O(arena) re-quantize on write, no dirty round trip — and the
+    maintained codes are bit-identical to a from-scratch requantize."""
+    from lazzaro_tpu.ops.quant import quantize_rows
+
+    d, n0, n1 = 16, 40, 24
+    rng = np.random.default_rng(3)
+    idx = MemoryIndex(dim=d, capacity=255, int8_serving=True)
+    idx.ingest_batch([f"a{i}" for i in range(n0)],
+                     rng.standard_normal((n0, d)).astype(np.float32),
+                     [0.5] * n0, [0.0] * n0, ["semantic"] * n0,
+                     ["default"] * n0, "u")
+    assert idx._int8_dirty                     # no shadow existed to maintain
+    idx.search_batch(rng.standard_normal((1, d)).astype(np.float32), "u", k=3)
+    assert not idx._int8_dirty                 # lazy build happened
+    idx.ingest_batch([f"b{i}" for i in range(n1)],
+                     rng.standard_normal((n1, d)).astype(np.float32),
+                     [0.5] * n1, [0.0] * n1, ["semantic"] * n1,
+                     ["default"] * n1, "u")
+    assert not idx._int8_dirty                 # maintained in the kernel
+    q8, sc = idx._int8_shadow
+    q8_full, sc_full = quantize_rows(idx.state.emb)
+    np.testing.assert_array_equal(np.asarray(q8), np.asarray(q8_full))
+    np.testing.assert_allclose(np.asarray(sc), np.asarray(sc_full))
+    # the dedup-fused path maintains it too (duplicates scatter nowhere)
+    pending = idx.ingest_batch_dedup(
+        rng.standard_normal((8, d)).astype(np.float32), [0.5] * 8,
+        [0.0] * 8, ["semantic"] * 8, ["default"] * 8, "u", dedup_gate=0.95)
+    idx.commit_ingest_dedup(pending, [f"c{i}" for i in range(8)])
+    assert not idx._int8_dirty
+    q8, sc = idx._int8_shadow
+    q8_full, sc_full = quantize_rows(idx.state.emb)
+    np.testing.assert_array_equal(np.asarray(q8), np.asarray(q8_full))
+    np.testing.assert_allclose(np.asarray(sc), np.asarray(sc_full))
+
+
 def test_int8_serving_survives_snapshot_restore(tmp_path):
     cfg = MemoryConfig(journal=False, int8_serving=True)
     ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
