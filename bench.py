@@ -648,6 +648,204 @@ def bench_fused_quant(on_tpu: bool, rows: int, reps: int = 3,
     return out
 
 
+def bench_fused_ivf(on_tpu: bool, rows: int, reps: int = 3,
+                    edge_rows: int = 100_000, recall_floor: float = 0.9,
+                    nprobe_ladder=(4, 8, 16, 32)):
+    """Fused IVF serving A/B (ISSUE 4 acceptance): batch-64 chat-turn
+    retrieval through three paths over the SAME clustered bf16 arena —
+
+      fused_ivf    : ONE ``search_fused_ivf`` dispatch (centroid prefilter
+                     + member gather + exact candidate scan + gate/CSR/
+                     boost tail, all in-kernel)
+      classic_ivf  : the classic multi-dispatch IVF sequence (exact gate
+                     search + ``_ivf_search`` prefilter scan + access/
+                     neighbor boost scatters + host neighbor walk)
+      fused_quant  : ONE ``search_fused_quant`` dispatch (dense int8
+                     coarse scan + exact rescore — the PR 3 density
+                     champion the IVF gather must beat at this scale)
+
+    The corpus is clustered (spread-scaled noise around √N-ish centers —
+    IVF recall on isotropic noise is meaningless) and queries are
+    perturbed arena rows; recall@10 is measured against the EXACT master
+    scan oracle, and ``nprobe`` walks a ladder until the fused path clears
+    ``recall_floor``. The artifact records the measured
+    ``dispatches_per_turn`` (jit-entry wrap) AND the recall/floor pair —
+    scripts/check_dispatch_counts.py fails CI on either regressing."""
+    from lazzaro_tpu.core import state as S_mod
+    from lazzaro_tpu.core.index import MemoryIndex
+    from lazzaro_tpu.serve import RetrievalRequest
+
+    B = 64
+    k = 10
+    rng = np.random.default_rng(47)
+    n_centers = max(64, 1 << int(np.sqrt(rows)).bit_length() >> 1)
+    centers = rng.standard_normal((n_centers, DIM)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    spread = 0.5 / np.sqrt(DIM)
+    idx = MemoryIndex(dim=DIM, capacity=rows + 64,
+                      edge_capacity=2 * edge_rows + 64, dtype=jnp.bfloat16,
+                      ivf_nprobe=nprobe_ladder[0])
+    q_rows = rng.integers(0, rows, size=B)
+    q_base = np.zeros((B, DIM), np.float32)
+    t0 = time.perf_counter()
+    for c in range(0, rows, 65_536):
+        m = min(65_536, rows - c)
+        lbl = rng.integers(0, n_centers, m)
+        emb = centers[lbl] + spread * rng.standard_normal(
+            (m, DIM)).astype(np.float32)
+        emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+        sel = (q_rows >= c) & (q_rows < c + m)
+        q_base[sel] = emb[q_rows[sel] - c]
+        idx.add([f"f{c + i}" for i in range(m)], emb, [0.5] * m, [0.0] * m,
+                ["semantic"] * m, ["default"] * m, "u0")
+    fill_s = time.perf_counter() - t0
+    ne = min(edge_rows, rows - 1)
+    idx.add_edges([(f"f{i}", f"f{i + 1}", 0.7) for i in range(ne)], "u0")
+    nbr_map = {}
+    for (s, t) in idx.edge_slots:
+        nbr_map.setdefault(s, []).append(t)
+        nbr_map.setdefault(t, []).append(s)
+    t0 = time.perf_counter()
+    assert idx.ivf_maintenance(iters=4)   # short refine: centroids only
+    ivf_build_s = time.perf_counter() - t0   # steer the coarse routing
+
+    queries = q_base + (0.3 / np.sqrt(DIM)) * rng.standard_normal(
+        (B, DIM)).astype(np.float32)
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+    reqs = [RetrievalRequest(query=queries[i], tenant="u0", k=k,
+                             gate_enabled=True, boost=True)
+            for i in range(B)]
+    kw = dict(cap_take=5, max_nbr=16, super_gate=0.4,
+              acc_boost=0.05, nbr_boost=0.02)
+    # exact oracle for recall@10, from the same master the kernels scan
+    oracle = idx.search_batch(queries, "u0", k=k, exact=True)
+    truth = [[idx.id_to_row[i] for i in ids_] for ids_, _ in oracle]
+
+    def run_fused():
+        return idx.search_fused_requests(reqs, **kw)
+
+    def recall_of(res):
+        hits = sum(len(set(idx.id_to_row[i] for i in r.ids) & set(t))
+                   for r, t in zip(res, truth))
+        return hits / (k * B)
+
+    # nprobe ladder: smallest probe count that clears the recall floor
+    # (each step recompiles — done before any timer starts)
+    recall = 0.0
+    recall_by_nprobe = {}
+    for p in nprobe_ladder:
+        idx.ivf_nprobe = p
+        recall = recall_of(run_fused())
+        recall_by_nprobe[p] = round(recall, 4)
+        print(f"[bench] fused-ivf nprobe={p}: recall@10={recall:.3f}",
+              file=sys.stderr, flush=True)
+        if recall >= recall_floor:
+            break
+    nprobe = idx.ivf_nprobe
+
+    def run_classic():
+        # exact gate search + IVF prefilter ANN + access boost + neighbor
+        # boost = 4 dispatches per batch (vs 1 fused)
+        idx.search_batch(queries, "u0", k=1, super_filter=1, exact=True)
+        per = idx.search_batch(queries, "u0", k=k, super_filter=-1)
+        hit_ids = [i for ids_, _sc in per for i in ids_[:5]]
+        idx.update_access(hit_ids, boost=0.05)
+        retrieved = set(hit_ids)
+        nbrs = {x for i in hit_ids for x in nbr_map.get(i, ())} - retrieved
+        if nbrs:
+            idx.boost(sorted(nbrs), 0.02)
+        return per
+
+    def run_quant():
+        # PR 3's dense two-stage path over the same arena (IVF sidelined,
+        # int8 shadow on) — the fused-quant comparator
+        idx.ivf_nprobe = 0
+        idx.int8_serving = True
+        try:
+            return idx.search_fused_requests(reqs, **kw)
+        finally:
+            idx.int8_serving = False
+            idx.ivf_nprobe = nprobe
+
+    # measured dispatch counter over the fused-ivf jit entry points
+    ivf_calls = {"n": 0}
+    wrapped = {}
+    for name in ("search_fused_ivf", "search_fused_ivf_copy",
+                 "search_fused_ivf_read"):
+        orig = getattr(S_mod, name)
+        wrapped[name] = orig
+
+        def counting(*a, __orig=orig, **k2):
+            ivf_calls["n"] += 1
+            return __orig(*a, **k2)
+
+        setattr(S_mod, name, counting)
+
+    run_fused()                          # warm (already compiled above)
+    t0 = time.perf_counter()
+    run_quant()                          # warm/compile + shadow build
+    warm_quant_s = time.perf_counter() - t0
+    run_classic()
+    ivf_calls["n"] = 0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        res = run_fused()
+    fused_ms = (time.perf_counter() - t0) * 1e3 / reps
+    dispatches_per_turn = ivf_calls["n"] / reps
+    recall_measured = recall_of(res)
+    for name, orig in wrapped.items():
+        setattr(S_mod, name, orig)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_classic()
+    classic_ms = (time.perf_counter() - t0) * 1e3 / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_quant()
+    quant_ms = (time.perf_counter() - t0) * 1e3 / reps
+    n_rows = idx.state.emb.shape[0]
+    ivf = idx._ivf
+    tabs = idx._ivf_fused_pack(k)
+    cand_rows = (tabs[3] * tabs[1].shape[1] + tabs[2].shape[0]
+                 if tabs is not None else n_rows)
+    out = {
+        "arena_rows": n_rows,
+        "dim": DIM,
+        "batch": B,
+        "reps": reps,
+        "edge_band": ne,
+        "n_centers": n_centers,
+        "fill_s": round(fill_s, 1),
+        "ivf_build_s": round(ivf_build_s, 1),
+        "warm_quant_s": round(warm_quant_s, 1),
+        "nprobe": nprobe,
+        "n_clusters": ivf.n_clusters if ivf is not None else None,
+        "candidate_rows_per_query": int(cand_rows),
+        "recall_by_nprobe": recall_by_nprobe,
+        "recall_at_10": round(recall_measured, 4),
+        "recall_floor": recall_floor,
+        "dispatches_per_turn": dispatches_per_turn,
+        "fused_ivf_retrieval_qps": round(B / (fused_ms / 1e3), 1),
+        "classic_ivf_retrieval_qps": round(B / (classic_ms / 1e3), 1),
+        "fused_quant_retrieval_qps": round(B / (quant_ms / 1e3), 1),
+        "fused_ivf_batch64_ms": round(fused_ms, 3),
+        "classic_ivf_batch64_ms": round(classic_ms, 3),
+        "fused_quant_batch64_ms": round(quant_ms, 3),
+        "ivf_vs_classic_speedup": round(classic_ms / fused_ms, 2),
+        "ivf_vs_fused_quant_speedup": round(quant_ms / fused_ms, 2),
+        "roofline": {
+            # the IVF win is structural: candidate bytes per query vs the
+            # dense scans' whole-arena stream
+            "fused_ivf_batch64": _roofline(int(cand_rows), DIM, 2, fused_ms,
+                                           B, on_tpu),
+            "fused_quant_batch64": _roofline(n_rows, DIM, 1, quant_ms, B,
+                                             on_tpu),
+        },
+    }
+    del idx
+    return out
+
+
 def bench_reference_default(on_tpu: bool):
     """Reference-DEFAULT configuration, measured (r4 review #4): hierarchy
     ON (super-node creation + the 0.4-gated fast path, ref
@@ -1188,6 +1386,16 @@ def main():
         print(f"[bench] fused-quant stage failed: {e}", file=sys.stderr,
               flush=True)
         fused_quant = None
+    try:
+        # fused-IVF serving A/B at a side size; the full 256k/1M pair
+        # ships via BENCH_FUSED_IVF runs (bench_artifacts/
+        # pr4_fused_ivf_*.json)
+        fused_ivf = bench_fused_ivf(on_tpu, min(N, 65_536),
+                                    edge_rows=20_000)
+    except Exception as e:   # a failed extra stage must not void the run
+        print(f"[bench] fused-ivf stage failed: {e}", file=sys.stderr,
+              flush=True)
+        fused_ivf = None
     t_kernel_phase = time.perf_counter() - t_kernel_phase
 
     # Reference-default configuration (hierarchy + auto-consolidate ON) as
@@ -1328,6 +1536,14 @@ def main():
                 fused_quant["fused_quant_retrieval_qps"]
                 if fused_quant is not None else None),
             "fused_quant_ab": fused_quant,
+            # fused IVF serving (centroid prefilter + member gather inside
+            # the single dispatch) vs the classic multi-dispatch IVF path
+            # and the dense fused-quant scan (ISSUE 4; the 256k/1M
+            # artifacts ride bench_artifacts/pr4_fused_ivf_*.json):
+            "fused_ivf_retrieval_qps": (
+                fused_ivf["fused_ivf_retrieval_qps"]
+                if fused_ivf is not None else None),
+            "fused_ivf_ab": fused_ivf,
             "roofline": rl,
             "phase_s": {"ingest": round(t_ingest, 1),
                         "search": round(t_search_phase, 1),
@@ -1395,10 +1611,46 @@ def fused_quant_stage_main():
                       "sizes": results}))
 
 
+def fused_ivf_stage_main():
+    """Standalone fused-IVF A/B (BENCH_FUSED_IVF=<rows,rows,...> or =1 for
+    the ISSUE 4 pair 262144,1048576): runs ONLY the fused-IVF stage and
+    writes bench_artifacts/pr4_fused_ivf_<size>_<dev>.json. Separate from
+    main() so the multi-hour 1M ingest pipeline isn't a prerequisite for
+    the serving artifact."""
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    spec = os.environ.get("BENCH_FUSED_IVF", "1")
+    sizes = ([262_144, 1_048_576] if spec.strip() in ("", "1")
+             else [int(s) for s in spec.split(",") if s.strip()])
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    dev_tag = "tpu" if on_tpu else "cpu"
+    for rows in sizes:
+        print(f"[bench] fused-ivf stage at {rows} rows", file=sys.stderr,
+              flush=True)
+        t0 = time.perf_counter()
+        out = bench_fused_ivf(on_tpu, rows)
+        out["stage_total_s"] = round(time.perf_counter() - t0, 1)
+        size_tag = "1m" if rows >= 1_000_000 else f"{rows // 1024}k"
+        path = os.path.join(art_dir,
+                            f"pr4_fused_ivf_{size_tag}_{dev_tag}.json")
+        with open(path, "w") as f:
+            json.dump({"metric": "fused_ivf_retrieval_qps",
+                       "value": out["fused_ivf_retrieval_qps"],
+                       "unit": "qps", "device": dev_tag,
+                       "sizes": {size_tag: out}}, f, indent=1)
+        print(f"[bench] wrote {path}", file=sys.stderr, flush=True)
+        print(json.dumps({"metric": "fused_ivf_retrieval_qps",
+                          "sizes": {size_tag: out}}))
+
+
 if __name__ == "__main__":
     try:
         if os.environ.get("BENCH_FUSED_QUANT"):
             fused_quant_stage_main()
+            sys.exit(0)
+        if os.environ.get("BENCH_FUSED_IVF"):
+            fused_ivf_stage_main()
             sys.exit(0)
         main()
     except Exception as e:  # always emit ONE parseable JSON line (weak #6)

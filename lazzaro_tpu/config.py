@@ -64,6 +64,17 @@ class MemoryConfig:
     # facts stay journaled (their source turns remain in the WAL) until
     # ingested. 0 (default) = eager: every consolidation drains immediately.
     ingest_flush_wait_s: float = 0.0
+    # Edge-slot pool sizing hint for the compacting fused ingest (ROADMAP
+    # ceiling #2): the gated link insert pre-allocates ceil(hint · 2·B·k)
+    # edge slots instead of the 2·B·k worst case (2 = shard modes, B =
+    # mega-batch facts, k = cross_link_top_k). Set it near the workload's
+    # measured link-acceptance rate (e.g. 0.25) to stop huge mostly-
+    # rejected batches from transiently draining the edge free list; the
+    # rare batch whose acceptance beats the hint raises an in-kernel
+    # overflow flag and the host re-inserts exactly the overflowed edges
+    # (one extra dispatch for that batch, MemoryIndex.link_pool_overflows
+    # counts them). 1.0 (default) = worst-case pool, never overflows.
+    link_accept_hint: float = 1.0
     # Fold the dedup probe into the fused ingest program
     # (state.ingest_dedup_fused): the masked pre-add top-1 + intra-batch
     # gram that _ingest_facts otherwise pays a separate search_batch
@@ -81,8 +92,12 @@ class MemoryConfig:
     # With int8_serving on, the fused program streams the int8 shadow for
     # a coarse top-(k + coarse_fetch_slack) and exactly rescores the
     # survivors from the master (state.search_fused_quant) — still ONE
-    # dispatch. Automatically bypassed under a mesh or when the IVF coarse
-    # stage is active (that path has its own prefilter scan).
+    # dispatch. With ivf_serving > 0 and a published build, the coarse
+    # stage becomes the IVF centroid prefilter + member gather INSIDE the
+    # same dispatch (state.search_fused_ivf; composes with int8 as
+    # gathered-int8 coarse + exact rescore). Automatically bypassed under
+    # a mesh or with pq_serving (the PQ member scan keeps its classic
+    # multi-dispatch path).
     serve_fused: bool = True
     # QueryScheduler flush policy: a pending batch ships when it reaches
     # serve_batch_max requests OR when its oldest request has waited

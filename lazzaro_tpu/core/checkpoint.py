@@ -287,6 +287,14 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
                         assume_unique=False)
     index._free_rows = free[::-1].tolist()
 
+    # Super-row bookkeeping from the restored is_super column: the fused
+    # IVF serving kernel's extras must carry every super row (exact gate
+    # verdicts), and this path bypasses ``add``'s tracking.
+    sup_rows = np.flatnonzero(np.asarray(arena.is_super)[:cap]
+                              & np.asarray(arena.alive)[:cap])
+    index._super_rows = {int(r) for r in sup_rows}
+    index._super_rows_frozen = tuple(sorted(index._super_rows))
+
     # Edge bookkeeping: map only LIVE slots' rows → ids through a dense
     # row→id table (no per-dead-slot Python work at 1M scale).
     edge_alive = np.asarray(edges.alive)[:edges.capacity]

@@ -94,7 +94,20 @@ class MemoryIndex:
         self._ivf_routed = None            # np bool [rows]: in members/residual
         self._ivf_in_residual = None       # np bool [rows]: in SEALED residual
         self._ivf_stale = 0                # member slots invalidated by delete
-        self._ivf_res_cache = None         # (ivf, len(fresh), device residual)
+        self._ivf_res_cache = None         # (ivf, fresh, residual buf, dev)
+        # Fused IVF serving tables (search_fused_requests): the exact-scan
+        # extras array (sealed residual + fresh rows + super rows) cached
+        # by snapshot identity like the residual cache.
+        self._ivf_serve_cache = None
+        # Super-node rows by host bookkeeping, so the fused IVF kernel's
+        # extras always carry EVERY super row (exact gate verdicts even
+        # when no centroid routes near a super node). The frozen tuple is
+        # rebuilt on change only — cache keys compare it by identity.
+        self._super_rows: set = set()
+        self._super_rows_frozen: tuple = ()
+        # Observability: fused-ingest batches whose accepted links overflowed
+        # the hinted edge-slot pool (each costs one host-side retry insert).
+        self.link_pool_overflows = 0
         # IVF-PQ member storage (ops/pq.py): the member scan reads m-byte
         # codes instead of d·2-byte rows and the shortlist is re-scored
         # exactly from the master. Codebook trains in ivf_maintenance;
@@ -156,6 +169,7 @@ class MemoryIndex:
         # row twice" guard, so repeated add()s of routed rows would grow
         # the fresh residual with duplicates).
         self._ivf_res_cache = None
+        self._ivf_serve_cache = None
         self._ivf_stale = 0
         self._pq_pack = None
         self._pq_dirty = True
@@ -443,8 +457,28 @@ class MemoryIndex:
         )
         self._int8_dirty = True            # emb rows written
         self._pq_dirty = True
+        self._note_super(rows, [bool(x) for x in is_super])
         self._ivf_note_added(rows)
         return rows
+
+    def _note_super(self, rows: Sequence[int], flags: Sequence[bool]) -> None:
+        """Track super-node rows from host bookkeeping (``add``/
+        ``ingest_batch`` flags, ``delete``). The fused IVF serving kernel
+        appends these rows to its exact-scan extras so the in-kernel
+        super-gate top-1 sees every super node regardless of centroid
+        routing. The frozen tuple is replaced only on a real change —
+        serve caches key on its identity."""
+        changed = False
+        for r, f in zip(rows, flags):
+            if f:
+                if r not in self._super_rows:
+                    self._super_rows.add(r)
+                    changed = True
+            elif r in self._super_rows:
+                self._super_rows.discard(r)
+                changed = True
+        if changed:
+            self._super_rows_frozen = tuple(sorted(self._super_rows))
 
     def _ivf_note_added(self, rows: Sequence[int]) -> None:
         """Record freshly-written rows in the fresh residual (shared by
@@ -483,19 +517,27 @@ class MemoryIndex:
                      link_k: int = 3, link_gate: float = 0.5,
                      link_scale: float = 0.8,
                      shard_modes: Sequence[int] = (1, 0),
-                     now: Optional[float] = None):
+                     now: Optional[float] = None,
+                     link_accept_hint: float = 1.0):
         """Fused zero-copy conversation ingest: insert ``ids``, merge-touch
         ``merge_ids``, link-scan every new row per shard mode, and insert
         the chain edges plus every gate-passing similarity edge — ONE
         donated device dispatch plus ONE packed readback (the unfused
         sequence pays four dispatches and the same readback).
 
-        Edge slots are pre-allocated for every potential link; the device
-        writes the gate verdict per slot and the host reclaims rejected
-        ones after the readback. ``ids`` should be fresh (the consolidation
-        contract) — a (src, tgt) link key that already exists is skipped
-        host-side defensively, but its pre-written slot is only reclaimed,
-        not cleared, until the next write lands on it.
+        Edge slots are pre-allocated as a compaction POOL sized by
+        ``link_accept_hint`` (ROADMAP ceiling #2): ``ceil(hint · modes·B·k)``
+        slots instead of the worst case, the device prefix-sum packs
+        accepted links into the pool head, and on the rare batch whose
+        acceptance rate beats the hint the overflowed edges — identified
+        exactly by their readback positions plus the in-kernel overflow
+        flag — are re-inserted host-side (``add_edges``; one extra
+        dispatch for that batch only, counted in
+        ``link_pool_overflows``). ``hint=1.0`` (default) keeps the
+        overflow-free worst case. ``ids`` should be fresh (the
+        consolidation contract) — a (src, tgt) link key that already
+        exists is skipped host-side defensively, but its pre-written slot
+        is only reclaimed, not cleared, until the next write lands on it.
 
         Returns ``(rows, candidates, created)``:
           rows        — arena rows of ``ids``, insert order
@@ -547,7 +589,9 @@ class MemoryIndex:
         n_modes = len(shard_modes)
         chain_keys = [(s, t) for s, t in chain_pairs
                       if s in self.id_to_row and t in self.id_to_row]
-        slots = self._alloc_edge_slots(len(chain_keys) + n_modes * n * k_eff)
+        pool_need = self._link_pool_size(n_modes * n * k_eff,
+                                         link_accept_hint)
+        slots = self._alloc_edge_slots(len(chain_keys) + pool_need)
         chain_slot_list = slots[:len(chain_keys)]
         link_pool_list = slots[len(chain_keys):]
 
@@ -593,19 +637,22 @@ class MemoryIndex:
             jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
             jnp.asarray(touch_padded), jnp.asarray(touch_sal),
             jnp.asarray(c_padded), jnp.asarray(c_src), jnp.asarray(c_tgt),
-            jnp.asarray(c_w), link_pool,
+            jnp.asarray(c_w), link_pool, jnp.int32(len(link_pool_list)),
             jnp.float32(now_rel), jnp.int32(tid),
             jnp.float32(link_gate), jnp.float32(link_scale),
             k=k_eff, shard_modes=shard_modes)
         if not shadow_fresh:
             self._int8_dirty = True
         self._pq_dirty = True
+        self._note_super(rows, [bool(x) for x in is_super])
         self._ivf_note_added(rows)
 
         host = fetch_packed(*link_flat)        # the ONE readback
+        pool_real = len(link_pool_list)
         candidates: Dict[int, Dict[str, List[Tuple[str, float]]]] = {}
         created: Dict[int, List[Tuple[str, str, float]]] = {}
         reclaim: List[int] = []
+        overflowed: List[Tuple[str, str, float]] = []
         consumed = 0
         for mi, sm in enumerate(shard_modes):
             sc, cd, ps = host[3 * mi], host[3 * mi + 1], host[3 * mi + 2]
@@ -616,7 +663,6 @@ class MemoryIndex:
                 pairs = []
                 for j in range(k_eff):
                     p = int(ps[bi, j])
-                    consumed = max(consumed, p + 1)
                     s = float(sc[bi, j])
                     cid = (self.row_to_id.get(int(cd[bi, j]))
                            if s > S.NEG_INF / 2 else None)
@@ -624,11 +670,21 @@ class MemoryIndex:
                         pairs.append((cid, s))
                     if p < 0:
                         continue               # rejected: no slot consumed
+                    w = min(1.0, max(0.0, s * link_scale))
+                    if p >= pool_real:
+                        # accepted by the device gate but past the hinted
+                        # pool: the edge was never written (sentinel slot)
+                        # — queue it for the host-side retry insert below
+                        if cid is not None \
+                                and (nid, cid) not in self.edge_slots:
+                            overflowed.append((nid, cid, w))
+                            made.append((nid, cid, w))
+                        continue
+                    consumed = max(consumed, p + 1)
                     key = (nid, cid)
                     if cid is not None and key not in self.edge_slots:
                         self.edge_slots[key] = link_pool_list[p]
-                        made.append((nid, cid,
-                                     min(1.0, max(0.0, s * link_scale))))
+                        made.append((nid, cid, w))
                     else:
                         # device inserted it but the host won't register the
                         # key (defensive): the slot is reclaimed, not
@@ -646,7 +702,23 @@ class MemoryIndex:
         self._free_edge_slots.extend(link_pool_list[consumed:])
         self._free_edge_slots.extend(reclaim)
         self._csr_dirty = True
+        if overflowed:
+            # the rare overfull batch pays one extra dispatch; the edges
+            # land with the same weights/tenant/timestamp they would have
+            self.link_pool_overflows += 1
+            self.add_edges(overflowed, tenant, now=now)
         return rows, candidates, created
+
+    def _link_pool_size(self, worst: int, hint: float) -> int:
+        """Edge-slot pool sizing for the compacting fused ingest (ROADMAP
+        ceiling #2): ``ceil(hint · worst)`` real slots instead of the
+        worst case — a huge mostly-rejected batch no longer transiently
+        drains the free list — floored at one slot so the overflow
+        machinery (not an empty gather) handles a zero hint."""
+        h = float(hint)
+        if h >= 1.0 or worst <= 0:
+            return worst
+        return min(worst, max(1, int(np.ceil(max(0.0, h) * worst))))
 
     def _link_pool_dev(self, pool: List[int], padded_len: int, ecap: int):
         """Device view of the link-slot pool for the compacting fused
@@ -688,7 +760,8 @@ class MemoryIndex:
                            link_k: int = 3, link_gate: float = 0.5,
                            link_scale: float = 0.8,
                            shard_modes: Sequence[int] = (1, 0),
-                           now: Optional[float] = None) -> Optional[dict]:
+                           now: Optional[float] = None,
+                           link_accept_hint: float = 1.0) -> Optional[dict]:
         """Truly single-round-trip ingest: the dedup probe (masked top-1
         against the pre-add arena + intra-batch gram) that ``_ingest_facts``
         used to pay a separate ``search_batch`` dispatch for runs INSIDE
@@ -708,7 +781,9 @@ class MemoryIndex:
         tid = self.tenant_id(tenant)
         k_eff = min(link_k, self.state.capacity)
         n_modes = len(shard_modes)
-        slots = self._alloc_edge_slots(n + n_modes * n * k_eff)
+        pool_need = self._link_pool_size(n_modes * n * k_eff,
+                                         link_accept_hint)
+        slots = self._alloc_edge_slots(n + pool_need)
         chain_slot_list = slots[:n]
         link_pool_list = slots[n:]
 
@@ -749,6 +824,7 @@ class MemoryIndex:
             jnp.asarray(pad([False] * n, False, bool)),
             jnp.asarray(pad(gids, -1, np.int32)),
             jnp.asarray(chain_slots), link_pool,
+            jnp.int32(len(link_pool_list)),
             jnp.float32(now_abs - self.epoch), jnp.int32(tid),
             jnp.float32(dedup_gate), jnp.float32(chain_weight),
             jnp.float32(link_gate), jnp.float32(link_scale),
@@ -760,7 +836,7 @@ class MemoryIndex:
         return {
             "rows": rows, "n": n, "k_eff": k_eff,
             "shard_modes": shard_modes, "link_scale": link_scale,
-            "tenant": tenant,
+            "tenant": tenant, "now": now_abs,
             "dup": host[0][:n, 0] > 0,
             "target_rows": host[1][:n, 0],
             "chain_src": host[2][:n, 0],
@@ -815,8 +891,10 @@ class MemoryIndex:
         created: Dict[int, List[Tuple[str, str, float]]] = {}
         host = pending["link_host"]
         link_pool = pending["link_pool"]
+        pool_real = len(link_pool)
         k_eff = pending["k_eff"]
         link_scale = pending["link_scale"]
+        overflowed: List[Tuple[str, str, float]] = []
         consumed = 0
         for mi, sm in enumerate(pending["shard_modes"]):
             sc, cd, ps = host[3 * mi], host[3 * mi + 1], host[3 * mi + 2]
@@ -827,7 +905,6 @@ class MemoryIndex:
                 pairs = []
                 for j in range(k_eff):
                     p = int(ps[bi, j])
-                    consumed = max(consumed, p + 1)
                     s = float(sc[bi, j])
                     cid = (self.row_to_id.get(int(cd[bi, j]))
                            if s > S.NEG_INF / 2 else None)
@@ -835,12 +912,21 @@ class MemoryIndex:
                         pairs.append((cid, s))
                     if p < 0:
                         continue               # rejected: no slot consumed
+                    w = min(1.0, max(0.0, s * link_scale))
+                    if p >= pool_real:
+                        # accepted but past the hinted pool (never written)
+                        # — host-side retry insert below
+                        if cid is not None and not dup[bi] \
+                                and (nid, cid) not in self.edge_slots:
+                            overflowed.append((nid, cid, w))
+                            made.append((nid, cid, w))
+                        continue
+                    consumed = max(consumed, p + 1)
                     key = (nid, cid)
                     if cid is not None and not dup[bi] \
                             and key not in self.edge_slots:
                         self.edge_slots[key] = link_pool[p]
-                        made.append((nid, cid,
-                                     min(1.0, max(0.0, s * link_scale))))
+                        made.append((nid, cid, w))
                     else:
                         reclaim.append(link_pool[p])
                 if not dup[bi]:
@@ -853,6 +939,10 @@ class MemoryIndex:
         self._free_edge_slots.extend(reclaim)
         self._csr_dirty = True
         self._ivf_note_added(live_rows)
+        if overflowed:
+            self.link_pool_overflows += 1
+            self.add_edges(overflowed, pending["tenant"],
+                           now=pending["now"])
         return candidates, created, merges, chains
 
     def delete(self, ids: Iterable[str]) -> None:
@@ -870,6 +960,8 @@ class MemoryIndex:
         self._apply_edges(S.edges_delete_for_nodes,
                           S.edges_delete_for_nodes_copy, jnp.asarray(padded))
         self._free_rows.extend(rows)
+        if self._super_rows:
+            self._note_super(rows, [False] * len(rows))
         routed = self._ivf_routed
         if routed is not None:
             # Per-build bookkeeping, by where the freed slot lives:
@@ -1036,12 +1128,14 @@ class MemoryIndex:
                                       k_fetch, nprobe=self.ivf_nprobe)
         return fetch_packed(scores, rows)      # ONE readback RTT
 
-    def ivf_maintenance(self) -> bool:
+    def ivf_maintenance(self, iters: int = 8) -> bool:
         """Build or refresh the coarse index; returns True if a (re)build
         ran. Rebuilds only when the fresh residual outgrows 25% of the
         sealed build. This is the ONLY place the k-means runs — call it
         from background maintenance (the consolidation worker does), never
-        from a serving query."""
+        from a serving query. ``iters`` caps the k-means refinement steps
+        (bench/maintenance knob; centroids only steer the coarse routing,
+        so fewer iters trade a little recall-per-nprobe for build time)."""
         if not self.ivf_nprobe:
             return False
         n_alive = len(self.id_to_row)
@@ -1058,7 +1152,7 @@ class MemoryIndex:
 
         st = self.state
         mask_np = np.asarray(st.alive)
-        ivf = build_ivf(st.emb, mask_np)
+        ivf = build_ivf(st.emb, mask_np, iters=iters)
         routed, in_res = self._routed_bitmaps(ivf)
         # writer-side bookkeeping first, the reader-visible pack LAST — a
         # reader can only ever observe a fully-initialized build
@@ -1066,6 +1160,7 @@ class MemoryIndex:
         self._ivf_in_residual = in_res
         self._ivf_stale = 0
         self._ivf_res_cache = None
+        self._ivf_serve_cache = None
         self._ivf_pack = (ivf, ())
         if self.pq_serving:
             # (re)train the member codebook on the same build cadence; the
@@ -1099,15 +1194,21 @@ class MemoryIndex:
     def _ivf_residual_dev(self, ivf, fresh):
         """Sealed-build residual + fresh rows as one padded device array,
         re-uploaded only when the (build, fresh) snapshot changed. Cache
-        validity is keyed on the IDENTITY of both the build object and the
+        validity is keyed on the IDENTITY of the build object, the
         immutable fresh tuple (writers replace the tuple, never mutate it),
-        so a rebuild can never serve the old residual against the new
-        member table — and a delete + re-add that lands in a DIFFERENT
-        freed slot (same fresh length, different contents; ADVICE r5 high)
-        can never serve a stale residual that silently drops the live row."""
+        AND the residual device buffer itself (ISSUE 4 satellite: an
+        ``IvfIndex`` is a mutable dataclass, so a same-length rebuild that
+        swaps ``ivf.residual`` in place on the SAME build object — without
+        passing through the ``_ivf`` setter — must not keep serving the
+        stale residual rows), so a rebuild can never serve the old
+        residual against the new member table — and a delete + re-add
+        that lands in a DIFFERENT freed slot (same fresh length, different
+        contents; ADVICE r5 high) can never serve a stale residual that
+        silently drops the live row."""
         cache = self._ivf_res_cache
-        if cache is not None and cache[0] is ivf and cache[1] is fresh:
-            return cache[2]
+        if (cache is not None and cache[0] is ivf and cache[1] is fresh
+                and cache[2] is ivf.residual):
+            return cache[3]
         from lazzaro_tpu.ops.ivf import _pow2
 
         base = np.asarray(ivf.residual)
@@ -1116,8 +1217,49 @@ class MemoryIndex:
         padded = np.full((_pow2(len(comb)),), -1, np.int32)
         padded[:len(comb)] = comb
         dev = jnp.asarray(padded)
-        self._ivf_res_cache = (ivf, fresh, dev)
+        self._ivf_res_cache = (ivf, fresh, ivf.residual, dev)
         return dev
+
+    def _ivf_extras_dev(self, ivf, fresh):
+        """Exact-scan extras for the fused IVF serving kernel — sealed
+        residual + fresh rows + super rows (``ops.ivf.pack_extras``) — as
+        one padded device array, re-uploaded only when the (build, fresh,
+        residual-buffer, super-set) snapshot changed. Same identity keying
+        as ``_ivf_residual_dev``; the super tuple is replaced only on a
+        real membership change (``_note_super``)."""
+        supers = self._super_rows_frozen
+        cache = self._ivf_serve_cache
+        if (cache is not None and cache[0] is ivf and cache[1] is fresh
+                and cache[2] is ivf.residual and cache[3] is supers):
+            return cache[4]
+        from lazzaro_tpu.ops.ivf import pack_extras
+
+        n = self.state.emb.shape[0]
+        dev = jnp.asarray(pack_extras(np.asarray(ivf.residual), fresh,
+                                      [r for r in supers if r < n]))
+        self._ivf_serve_cache = (ivf, fresh, ivf.residual, supers, dev)
+        return dev
+
+    def _ivf_fused_pack(self, k_kernel: int):
+        """(centroids, members, extras, nprobe) tables for the fused IVF
+        serving kernel, or None to serve the dense fused path instead.
+        None when: IVF is off (or mesh-disabled), PQ member storage is
+        active (that path keeps its own classic scan), no build exists yet
+        (builds happen in ``ivf_maintenance``, NEVER on the query path),
+        or the visited-cluster + extras candidate count can't fill the
+        kernel's k (the dense scan is trivially cheap there anyway)."""
+        if not self.ivf_nprobe or self.mesh is not None or self.pq_serving:
+            return None
+        pack = self._ivf_pack
+        if pack is None:
+            return None
+        ivf, fresh = pack
+        extras = self._ivf_extras_dev(ivf, fresh)
+        nprobe = min(self.ivf_nprobe, ivf.n_clusters)
+        n_cand = nprobe * ivf.members.shape[1] + extras.shape[0]
+        if n_cand < k_kernel:
+            return None
+        return ivf.centroids, ivf.members, extras, nprobe
 
     def _int8_shadow_for(self, st: S.ArenaState):
         """(Re)build the int8 shadow from ONE arena snapshot; under a mesh
@@ -1212,9 +1354,16 @@ class MemoryIndex:
         salience + access-salience boosts for every query that asked
         (donated scatter, ``*_copy`` twin under the refcount gate — PR 1's
         ownership rules). Pure-read batches (no boosts requested) take the
-        non-donating ``search_fused_read`` twin. Per-request tenants ride
+        non-donating ``*_read`` twins. Per-request tenants ride
         into the kernel as a device column, so one batch can serve many
-        tenants with mask-enforced isolation."""
+        tenants with mask-enforced isolation.
+
+        Coarse-stage routing (all still ONE dispatch + ONE readback):
+        a published IVF build takes ``search_fused_ivf`` (centroid
+        prefilter + member gather, int8-gathered coarse + exact rescore
+        when the shadow is on too); otherwise int8 mode takes
+        ``search_fused_quant`` (dense int8 coarse + exact rescore); else
+        the exact dense ``search_fused``."""
         from lazzaro_tpu.serve.scheduler import RetrievalResult
 
         nq = len(reqs)
@@ -1268,7 +1417,17 @@ class MemoryIndex:
         # donated; the shadow is a read-only replica that the boost scatter
         # (salience/access/freshness only) can never invalidate.
         use_quant = bool(self.int8_serving) and self.mesh is None
-        if use_quant:
+        # Fused IVF serving (ISSUE 4): with a coarse build published,
+        # the single-dispatch program starts from the centroid prefilter +
+        # member gather instead of a whole-arena stream — candidate HBM
+        # traffic ~(C + nprobe·N/C)·d per query — and ``ivf_nprobe > 0``
+        # no longer opts out of fusion. With int8 ALSO on, the candidate
+        # scan itself is two-stage (int8 gathered coarse + exact rescore).
+        ivf_tabs = self._ivf_fused_pack(k_bucket)
+        if ivf_tabs is not None:
+            statics["nprobe"] = ivf_tabs[3]
+            statics["slack"] = self.coarse_slack
+        elif use_quant:
             statics["slack"] = self.coarse_slack
         if boost_on.any():
             del st      # a live snapshot would trip the sole-owner gate
@@ -1278,7 +1437,19 @@ class MemoryIndex:
                 boost_args = (jnp.asarray(padb(boost_on)),
                               jnp.float32(now_rel), jnp.float32(super_gate),
                               jnp.float32(acc_boost), jnp.float32(nbr_boost))
-                if use_quant:
+                if ivf_tabs is not None:
+                    cent, members, extras, _ = ivf_tabs
+                    # shadow (when int8 is on too) taken against ``cur``
+                    # under the lock — the (arena, codes) pair never tears
+                    shadow = (self._int8_shadow_for(cur) if use_quant
+                              else None)
+                    fn = (S.search_fused_ivf
+                          if sys.getrefcount(cur) <= self._SOLE_REFS
+                          else S.search_fused_ivf_copy)
+                    new_state, packed = fn(cur, shadow, cent, members,
+                                           extras, *args, *boost_args,
+                                           **statics)
+                elif use_quant:
                     # shadow taken against ``cur`` under the lock, so the
                     # (arena, codes) pair can never tear across a racing
                     # writer (re-entrant RLock; rebuild is dispatch-only)
@@ -1295,6 +1466,13 @@ class MemoryIndex:
                     new_state, packed = fn(cur, *args, *boost_args, **statics)
                 del cur
                 self.state = new_state
+        elif ivf_tabs is not None:
+            cent, members, extras, _ = ivf_tabs
+            shadow = self._int8_shadow_for(st) if use_quant else None
+            packed = S.search_fused_ivf_read(st, shadow, cent, members,
+                                             extras, *args,
+                                             jnp.float32(super_gate),
+                                             **statics)
         elif use_quant:
             q8, scale = self._int8_shadow_for(st)
             packed = S.search_fused_quant_read(st, q8, scale, *args,

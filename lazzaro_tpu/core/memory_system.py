@@ -766,14 +766,19 @@ class MemorySystem:
     # ----------------------------------------------------------- fused serving
     def _use_fused_serving(self) -> bool:
         """Fused retrieval serves the single-chip arena — exact by default,
-        or through the quantized two-stage kernel (int8 coarse scan + exact
+        through the quantized two-stage kernel (int8 coarse scan + exact
         rescore, ``state.search_fused_quant``) when the int8 serving shadow
-        is on, so quantized mode keeps the one-dispatch turn, cross-request
-        mega-batching, and zero-RTT cache hits. Under a mesh the shard_map
-        searcher owns the path, and the IVF coarse stage still runs its own
-        prefilter scan the fused kernel would silently bypass."""
+        is on, and through the IVF coarse stage (centroid prefilter +
+        member gather INSIDE the dispatch, ``state.search_fused_ivf``)
+        once a build is published — so quantized AND IVF modes keep the
+        one-dispatch turn, cross-request mega-batching, and zero-RTT cache
+        hits (``MemoryIndex.search_fused_requests`` owns the routing; an
+        IVF config with no build yet serves the dense fused path). Under a
+        mesh the shard_map searcher owns the path, and IVF-PQ member
+        storage keeps its own classic prefilter scan the fused kernel
+        does not reproduce."""
         return (self.config.serve_fused and self.mesh is None
-                and not self.index.ivf_nprobe)
+                and not (self.index.ivf_nprobe and self.index.pq_serving))
 
     def _ensure_scheduler(self) -> QueryScheduler:
         """Lazily spawn the cross-request query scheduler (one worker thread
@@ -1159,7 +1164,8 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                     link_k=self.config.cross_link_top_k,
                     link_gate=self.config.link_gate,
                     link_scale=self.config.link_weight_scale,
-                    shard_modes=(1, 0))
+                    shard_modes=(1, 0),
+                    link_accept_hint=self.config.link_accept_hint)
             else:
                 if arena_new:
                     self.index.add(
@@ -1256,7 +1262,8 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             tenant=self.user_id, dedup_gate=cfg.dedup_similarity,
             chain_weight=cfg.chain_link_weight,
             link_k=cfg.cross_link_top_k, link_gate=cfg.link_gate,
-            link_scale=cfg.link_weight_scale, shard_modes=(1, 0), now=now)
+            link_scale=cfg.link_weight_scale, shard_modes=(1, 0), now=now,
+            link_accept_hint=cfg.link_accept_hint)
         if pending is None:
             return []
         dup = pending["dup"]

@@ -646,6 +646,7 @@ def _ingest_fused(
     chain_tgt: jax.Array,    # [C] i32
     chain_w: jax.Array,      # [C] f32
     link_pool: jax.Array,    # [P+1] i32 compaction slot pool (last = sentinel)
+    pool_len: jax.Array,     # scalar i32: REAL slots at the pool head
     now: jax.Array,
     tenant: jax.Array,
     link_gate: jax.Array,
@@ -681,14 +682,15 @@ def _ingest_fused(
                        jnp.ones((n_chain,), jnp.int32), now, tenant,
                        chain_src >= 0)
     valid_q = rows < arena.capacity        # sentinel-padded rows make no edges
-    edges, outs = _gated_link_insert(edges, link_flat, link_pool, rows,
-                                     valid_q, now, tenant, link_gate,
+    edges, outs = _gated_link_insert(edges, link_flat, link_pool, pool_len,
+                                     rows, valid_q, now, tenant, link_gate,
                                      link_scale, shard_modes)
     return arena, edges, shadow, outs
 
 
-def _gated_link_insert(edges, link_flat, link_pool, src_rows, valid_q, now,
-                       tenant, link_gate, link_scale, shard_modes):
+def _gated_link_insert(edges, link_flat, link_pool, pool_len, src_rows,
+                       valid_q, now, tenant, link_gate, link_scale,
+                       shard_modes):
     """Device-gated similarity-edge insert with prefix-sum slot compaction
     (ROADMAP ceiling #2), shared by the fused ingest kernels: per shard
     mode the gate verdict (gate pass, valid source row, not already
@@ -699,7 +701,18 @@ def _gated_link_insert(edges, link_flat, link_pool, src_rows, valid_q, now,
     dead writes — and ONE ``_edges_add`` covers every mode. The readback
     triples carry each candidate's pool position (-1 = rejected) so the
     host can register accepted keys and reclaim the unused pool suffix as
-    a single contiguous slice."""
+    a single contiguous slice.
+
+    ``pool_len`` (device scalar: the count of REAL slots at the pool's
+    head — the tail up to the jit bucket is sentinel padding) lets the
+    host size the pool by its measured link-acceptance rate instead of
+    the 2·B·k worst case (``MemoryConfig.link_accept_hint``): an accepted
+    edge whose prefix-sum position lands past ``pool_len`` scatters to
+    the sentinel slot (never a phantom write), its readback position
+    still carries the TRUE prefix position so the host can identify and
+    re-insert exactly the overflowed edges, and the trailing overflow
+    flag in the packed readback tells the host a retry is needed at
+    all."""
     # The link-scan top-k results feed BOTH the gate logic here and the
     # packed readback; the barrier stops XLA from splitting those consumers
     # into duplicate full-arena sorts (same fix as _search_fused_scan).
@@ -720,9 +733,10 @@ def _gated_link_insert(edges, link_flat, link_pool, src_rows, valid_q, now,
         per_mode.append((scores, cand, live))
     live_all = jnp.concatenate([lv.reshape(-1) for _, _, lv in per_mode])
     pos_all = jnp.cumsum(live_all.astype(jnp.int32)) - 1
-    ok = live_all & (pos_all < pool_cap)
+    ok = live_all & (pos_all < jnp.minimum(pool_len, pool_cap))
     slots = link_pool[jnp.where(ok, jnp.minimum(pos_all, pool_cap - 1),
                                 pool_cap)]
+    overflow = (live_all & ~ok).any()
     src_all = jnp.concatenate([
         jnp.broadcast_to(src_rows[:, None], c.shape).reshape(-1)
         for _, c, _ in per_mode])
@@ -740,6 +754,10 @@ def _gated_link_insert(edges, link_flat, link_pool, src_rows, valid_q, now,
                           -1).reshape(live.shape)
         outs.extend((scores, cand, pos_m))
         off += m
+    # trailing overflow flag, broadcast to the common readback leaf shape
+    # so the whole tuple still fetches in ONE packed transfer
+    outs.append(jnp.broadcast_to(overflow.astype(jnp.int32),
+                                 per_mode[0][2].shape))
     return edges, tuple(outs)
 
 
@@ -769,6 +787,7 @@ def _ingest_dedup_fused(
     chain_gid: jax.Array,    # [B] i32 densified shard-group id, -1 padding
     chain_slots: jax.Array,  # [B] i32 edge slot per fact, sentinel-padded
     link_pool: jax.Array,    # [P+1] i32 compaction slot pool (last = sentinel)
+    pool_len: jax.Array,     # scalar i32: REAL slots at the pool head
     now: jax.Array,
     tenant: jax.Array,
     dedup_gate: jax.Array,   # cosine threshold; > 1.0 disables dedup
@@ -848,8 +867,8 @@ def _ingest_dedup_fused(
     edges = _edges_add(edges, chain_slots, chain_src, rows,
                        jnp.broadcast_to(chain_w, (b,)),
                        jnp.ones((b,), jnp.int32), now, tenant, chain_live)
-    edges, outs = _gated_link_insert(edges, link_flat, link_pool, rows,
-                                     live_new, now, tenant, link_gate,
+    edges, outs = _gated_link_insert(edges, link_flat, link_pool, pool_len,
+                                     rows, live_new, now, tenant, link_gate,
                                      link_scale, shard_modes)
     # [B] verdicts broadcast to [B, k] so every readback leaf has one shape
     # and the host fetches them all in ONE packed transfer
@@ -1177,6 +1196,205 @@ def search_fused_quant_read(state: ArenaState, q8a: jax.Array,
     gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_quant_scan(
         state, q8a, scale_a, csr_indptr, csr_nbr, q, q_valid, tenant,
         gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+# ---------------------------------------------------------------------------
+# Fused IVF serving (ISSUE 4): the same single-dispatch chat-turn program,
+# but the coarse stage is the CENTROID prefilter — the query batch scores
+# C ≈ √N centroids, visits the top-nprobe clusters, gathers ONLY those
+# clusters' member rows (plus the exact-scan extras: sealed+fresh residual
+# and the super rows), and scores just the candidates before the existing
+# super-gate / CSR-gather / boost-scatter tail runs unchanged. Candidate
+# HBM traffic per query drops from N·d to ~(C + nprobe·N/C)·d (~25×
+# analytically at 1M rows) while keeping the ONE-dispatch + ONE-readback
+# invariant the dense and int8 paths already guarantee. With the int8
+# shadow on, the candidate scan itself becomes two-stage (int8 gathered
+# coarse + exact f32 rescore of the k+slack survivors) — PR 3's machinery
+# applied to the gathered rows instead of the whole arena.
+# ---------------------------------------------------------------------------
+
+# Candidate tensors are [q_chunk, nprobe·M + E, d]; small chunks bound the
+# gather footprint the same way ops/ivf.ivf_search's q_chunk does.
+IVF_SERVE_CHUNK = 8
+
+
+def _dedup_topk(scores: jax.Array, rows: jax.Array, sentinel: int, k: int
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Top-k over a small over-fetched candidate list keeping only the
+    FIRST occurrence of each arena row. IVF candidate lists can carry
+    duplicates — a reused slot sitting in both a stale member slot and the
+    residual, or a super row in both its cluster and the extras — and a
+    duplicate must neither consume a result slot (k-shortfall) nor get a
+    double access boost (the classic path dedups host-side in
+    ``decode_topk``). ``scores`` is sorted descending (a top-k output), so
+    keeping the first occurrence keeps the best. Invalid entries are
+    routed to the sentinel row with NEG_INF intact."""
+    r = jnp.where(scores > NEG_INF / 2, rows, sentinel)
+    m = r.shape[1]
+    dup = ((r[:, :, None] == r[:, None, :])
+           & jnp.tri(m, k=-1, dtype=bool)[None, :, :]).any(-1)
+    s = jnp.where(dup, NEG_INF, scores)
+    top_s, sel = jax.lax.top_k(s, k)
+    top_r = jnp.take_along_axis(r, sel, axis=1)
+    return top_s, jnp.where(top_s > NEG_INF / 2, top_r, sentinel)
+
+
+def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
+                           members: jax.Array, extras: jax.Array,
+                           csr_indptr: jax.Array, csr_nbr: jax.Array,
+                           q: jax.Array, q_valid: jax.Array,
+                           tenant: jax.Array, gate_on: jax.Array,
+                           boost_on: jax.Array, super_gate: jax.Array,
+                           k: int, nprobe: int, slack: int, cap_take: int,
+                           max_nbr: int):
+    """IVF per-chunk compute phase: coarse centroid prefilter + member
+    gather (``ops.ivf.gather_rows`` — the same candidate assembly as the
+    classic IVF scan, barrier included), per-query tenant masking over the
+    candidates, candidate scoring (exact bf16/f32, or int8-gathered coarse
+    + exact rescore when ``shadow`` is present), duplicate-row dedup at
+    the top-k boundary, and the shared gate/CSR/boost tail. Both
+    retrieval tiers are masks over the ONE candidate score matrix, same
+    trick as the dense scans."""
+    from lazzaro_tpu.ops.ivf import gather_rows
+
+    cap = state.capacity
+    L = nprobe * members.shape[1] + extras.shape[0]
+    k_fetch = min(k + slack, L)
+    g_fetch = min(1 + slack, L)
+
+    def body(q_c, valid_c, tenant_c, gate_c, boost_c):
+        qn = normalize(q_c)                               # [C, d] f32
+        cand, safe = gather_rows(centroids, members, extras, qn, nprobe)
+        valid = ((cand >= 0) & state.alive[safe]
+                 & (state.tenant_id[safe] == tenant_c[:, None]))
+        sup = state.is_super[safe]
+        qd = qn.astype(state.emb.dtype)
+
+        def rescore(rows_c, coarse_s):
+            g = state.emb[rows_c]                         # [C, kf, d]
+            ex = jnp.einsum("cd,ckd->ck", qd, g,
+                            preferred_element_type=jnp.float32)
+            return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
+
+        if shadow is None:
+            vecs = state.emb[safe]                        # [C, L, d]
+            sc = jnp.einsum("cd,cld->cl", qd, vecs,
+                            preferred_element_type=jnp.float32)
+            a_s0, a_pos = jax.lax.top_k(
+                jnp.where(valid & ~sup, sc, NEG_INF), k_fetch)
+            g_s0, g_pos = jax.lax.top_k(
+                jnp.where(valid & sup, sc, NEG_INF), 1)
+            # Consumer-split hazard (see _search_fused_scan): the top-k
+            # feeds both the packed readback and the boost gather chain.
+            a_s0, a_pos, g_s0, g_pos = jax.lax.optimization_barrier(
+                (a_s0, a_pos, g_s0, g_pos))
+            ann_ex = a_s0
+            a_rows = jnp.take_along_axis(cand, a_pos, axis=1)
+            gate_s = g_s0[:, 0]
+            gate_r0 = jnp.take_along_axis(cand, g_pos, axis=1)[:, 0]
+        else:
+            from lazzaro_tpu.ops.quant import quantize_rows
+
+            q8a, scale_a = shadow
+            qq, qs = quantize_rows(qn)
+            d8 = jnp.einsum("cd,cld->cl", qq, q8a[safe],
+                            preferred_element_type=jnp.int32)
+            coarse = (d8.astype(jnp.float32)
+                      * qs[:, None] * scale_a[safe])      # [C, L]
+            a_s0, a_pos = jax.lax.top_k(
+                jnp.where(valid & ~sup, coarse, NEG_INF), k_fetch)
+            g_s0, g_pos = jax.lax.top_k(
+                jnp.where(valid & sup, coarse, NEG_INF), g_fetch)
+            a_s0, a_pos, g_s0, g_pos = jax.lax.optimization_barrier(
+                (a_s0, a_pos, g_s0, g_pos))
+            # exact rescore of the few survivors from the master — scores
+            # and the 0.4 gate verdict never see quantization error
+            a_rows0 = jnp.take_along_axis(cand, a_pos, axis=1)
+            a_rows_safe = jnp.where(a_s0 > NEG_INF / 2, a_rows0, cap)
+            ann_ex = rescore(a_rows_safe, a_s0)
+            g_rows0 = jnp.take_along_axis(cand, g_pos, axis=1)
+            g_rows_safe = jnp.where(g_s0 > NEG_INF / 2, g_rows0, cap)
+            gate_ex = rescore(g_rows_safe, g_s0)
+            g_s, g_sel = jax.lax.top_k(gate_ex, 1)
+            gate_s = g_s[:, 0]
+            gate_r0 = jnp.take_along_axis(g_rows_safe, g_sel, axis=1)[:, 0]
+            a_rows = a_rows_safe
+
+        ann_s, ann_r = _dedup_topk(ann_ex, a_rows, cap, k)
+        gate_r = jnp.where(gate_s > NEG_INF / 2, gate_r0, cap)
+        fast, acc_rows, nbr_rows = _gate_and_boost_rows(
+            state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
+            valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
+            max_nbr)
+        return gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows
+
+    return chunked_map_multi(body, (q, q_valid, tenant, gate_on, boost_on),
+                             chunk=IVF_SERVE_CHUNK)
+
+
+def _search_fused_ivf(
+    state: ArenaState,
+    shadow,                  # (q8 [cap+1, d] i8, scale [cap+1] f32) or None
+    centroids: jax.Array,    # [C, d] f32 L2-normalized (ops/ivf.py build)
+    members: jax.Array,      # [C, M] i32 arena rows, -1 padded
+    extras: jax.Array,       # [E] i32 residual + fresh + super rows, -1 pad
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    nprobe: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """``search_fused`` with the IVF centroid prefilter + member gather as
+    the coarse stage: ONE donated dispatch + ONE packed readback per
+    coalesced batch in IVF mode. Only the arena state is donated — the
+    centroid/member/extras tables and the optional int8 shadow are
+    long-lived read-only replicas (the boost scatter touches salience/
+    access/freshness, never embeddings or routing)."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
+        _search_fused_ivf_scan(state, shadow, centroids, members, extras,
+                               csr_indptr, csr_nbr, q, q_valid, tenant,
+                               gate_on, boost_on, super_gate, k, nprobe,
+                               slack, cap_take, max_nbr)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+search_fused_ivf, search_fused_ivf_copy = _donated_pair(
+    _search_fused_ivf, static_argnames=("k", "nprobe", "slack", "cap_take",
+                                        "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
+                                             "cap_take", "max_nbr"))
+def search_fused_ivf_read(state: ArenaState, shadow, centroids: jax.Array,
+                          members: jax.Array, extras: jax.Array,
+                          csr_indptr: jax.Array, csr_nbr: jax.Array,
+                          q: jax.Array, q_valid: jax.Array,
+                          tenant: jax.Array, gate_on: jax.Array,
+                          super_gate: jax.Array, k: int, nprobe: int,
+                          slack: int, cap_take: int, max_nbr: int
+                          ) -> jax.Array:
+    """Read-only twin of ``search_fused_ivf`` (pure ``search_memories``
+    fleets in IVF mode): same coarse prefilter + candidate scan, no state
+    mutation, no donation dance."""
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_ivf_scan(
+        state, shadow, centroids, members, extras, csr_indptr, csr_nbr, q,
+        q_valid, tenant, gate_on, boost_off, super_gate, k, nprobe, slack,
+        cap_take, max_nbr)
     return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
 
 

@@ -152,28 +152,69 @@ def build_ivf(emb: jax.Array, mask_np: np.ndarray,
                     residual=jnp.asarray(residual), built_rows=n_alive)
 
 
+def gather_rows(centroids: jax.Array, members: jax.Array,
+                extras: jax.Array, q_c: jax.Array, nprobe: int
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Device-friendly coarse gather, the single place EVERY member scan —
+    the classic ``ivf_search``, ``ops.pq.ivf_pq_search``, and the fused
+    serving kernel (``core.state.search_fused_ivf``) — assembles its
+    candidate row set, so the 'identical candidate set' invariant between
+    the paths is structural, not a docstring promise: score C centroids,
+    take the ``nprobe`` best clusters, and return their member rows plus
+    ``extras`` (the sealed residual, and for the fused path the fresh
+    residual + super rows appended by the host).
+
+    The ``optimization_barrier`` after the cluster top-k is the PR 2
+    consumer-split fix: the visited-cluster ids feed both the member
+    gather and (through the scores built on it) the packed readback —
+    without the barrier XLA may clone the full [qc, C] centroid sort per
+    consumer.
+
+    Returns ``(cand [qc, L], safe [qc, L])`` with L = nprobe·M + len
+    (extras); ``safe = max(cand, 0)`` is the gather-legal view (padding
+    is -1). Callers apply their own validity mask (single-tenant kernels
+    a [N] mask, the fused kernel a per-query tenant column)."""
+    cs = jnp.dot(q_c, centroids.T,
+                 preferred_element_type=jnp.float32)       # [qc, C]
+    _, cids = jax.lax.top_k(cs, nprobe)                    # [qc, P]
+    cids = jax.lax.optimization_barrier(cids)
+    cand = members[cids].reshape(q_c.shape[0], -1)         # [qc, P*M]
+    cand = jnp.concatenate(
+        [cand, jnp.broadcast_to(extras[None, :],
+                                (q_c.shape[0], extras.shape[0]))],
+        axis=1)                                            # [qc, P*M+E]
+    return cand, jnp.maximum(cand, 0)
+
+
 def gather_candidates(centroids: jax.Array, members: jax.Array,
                       residual: jax.Array, mask: jax.Array, q_c: jax.Array,
                       nprobe: int
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """The coarse stage, shared by the exact and PQ member scans: score C
-    centroids, take the ``nprobe`` best clusters, and assemble the
-    candidate row set (their members + the residual). Returns
-    ``(cand [qc, L], safe_rows, valid_mask)`` — both kernels MUST build
-    their candidate set here so the 'identical candidate set' invariant
-    between ``ivf_search`` and ``ops.pq.ivf_pq_search`` is structural,
-    not a docstring promise."""
-    cs = jnp.dot(q_c, centroids.T,
-                 preferred_element_type=jnp.float32)       # [qc, C]
-    _, cids = jax.lax.top_k(cs, nprobe)                    # [qc, P]
-    cand = members[cids].reshape(q_c.shape[0], -1)         # [qc, P*M]
-    cand = jnp.concatenate(
-        [cand, jnp.broadcast_to(residual[None, :],
-                                (q_c.shape[0], residual.shape[0]))],
-        axis=1)                                            # [qc, P*M+R]
-    safe = jnp.maximum(cand, 0)
+    """Single-tenant view over :func:`gather_rows` (the exact and PQ member
+    scans): adds the [N] alive/tenant mask and returns
+    ``(cand, safe_rows, valid_mask)``."""
+    cand, safe = gather_rows(centroids, members, residual, q_c, nprobe)
     valid = (cand >= 0) & mask[safe]
     return cand, safe, valid
+
+
+def pack_extras(residual: np.ndarray, fresh_rows, super_rows) -> np.ndarray:
+    """Host-side export of the exact-scan row set for the fused serving
+    kernel: sealed-build residual ++ fresh rows (added post-build) ++ the
+    tenant-agnostic super-node rows, -1-padded to a pow2 bucket so jit
+    specializations stay bounded. Super rows ride here so the in-kernel
+    super-gate top-1 sees EVERY super node exactly — the gate threshold
+    (0.4) must never depend on whether a centroid routed near a super
+    node. A super row can then appear twice (its cluster slot + here);
+    duplicates only matter for the ANN tier, where the kernel's top-k
+    dedup drops them (top-1 gates are duplicate-immune anyway)."""
+    base = np.asarray(residual)
+    comb = np.concatenate([base[base >= 0],
+                           np.asarray(list(fresh_rows), np.int32),
+                           np.asarray(list(super_rows), np.int32)])
+    padded = np.full((_pow2(len(comb)),), -1, np.int32)
+    padded[:len(comb)] = comb
+    return padded
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "q_chunk"))

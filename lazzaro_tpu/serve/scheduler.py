@@ -25,12 +25,16 @@ the way Ragged Paged Attention coalesces ragged decode work on TPU:
 
 The scheduler is deliberately generic over its ``executor`` callable:
 ``MemoryIndex`` plugs in the fused single-chip kernel
-(``search_fused_requests`` — which itself routes to the exact or the
-quantized two-stage program depending on ``int8_serving``, so int8 mode
-keeps the cross-request mega-batching and the one-dispatch turn), while
-``parallel.index.ShardedMemoryIndex`` plugs in its shard_map distributed
-top-k (per-query tenant column: one pod dispatch per mixed-tenant batch)
-— same coalescing, same policy, different device program.
+(``search_fused_requests`` — which itself routes to the exact dense, the
+quantized two-stage, or the IVF coarse-prefilter program depending on
+``int8_serving`` / a published IVF build, so int8 AND IVF modes keep the
+cross-request mega-batching, the one-dispatch turn, and zero-RTT
+query-cache hits), while ``parallel.index.ShardedMemoryIndex`` plugs in
+its shard_map distributed top-k (per-query tenant column: one pod
+dispatch per mixed-tenant batch) — same coalescing, same policy,
+different device program. Mega-batched IVF turns change NOTHING here:
+the futures API, flush policy, and per-request demux are identical
+because the coarse-stage choice lives entirely behind the executor.
 """
 
 from __future__ import annotations

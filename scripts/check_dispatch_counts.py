@@ -1,15 +1,22 @@
 #!/usr/bin/env python3
-"""Guard: fail when a bench artifact records a fused-serving dispatch
-regression.
+"""Guard: fail when a bench artifact records a fused-serving regression.
 
-The fused serving acceptance bar (ISSUE 2/3) is ONE device dispatch per
-coalesced retrieval batch. Bench stages that measure a fused path record a
-MEASURED ``dispatches_per_turn`` in their JSON artifacts (bench.py
-``bench_fused_quant`` wraps the jit entry points and counts); this script
-walks every ``bench_artifacts/*.json`` (or the paths passed as arguments)
-for ``dispatches_per_turn`` keys and exits nonzero if any value != 1 — so
-a refactor that quietly splits the fused program back into multiple
-dispatches turns red in CI instead of shipping.
+The fused serving acceptance bar (ISSUE 2/3/4) is ONE device dispatch per
+coalesced retrieval batch, and for the approximate coarse stages (int8,
+IVF) a recall floor the artifact itself records. Bench stages that measure
+a fused path record a MEASURED ``dispatches_per_turn`` in their JSON
+artifacts (bench.py ``bench_fused_quant`` / ``bench_fused_ivf`` wrap the
+jit entry points and count), and recall-bearing stages record
+``recall_at_10`` next to their ``recall_floor``. This script walks every
+``bench_artifacts/*.json`` (or the paths passed as arguments) and exits
+nonzero if:
+
+  - any ``dispatches_per_turn`` != 1 (a refactor quietly split the fused
+    program back into multiple dispatches), or
+  - any dict carrying both keys has ``recall_at_10`` < ``recall_floor``
+    (a coarse-stage change quietly traded recall for throughput),
+
+so either regression turns red in CI instead of shipping.
 
 Usage:
     python scripts/check_dispatch_counts.py [artifact.json ...]
@@ -23,17 +30,19 @@ import os
 import sys
 
 
-def _walk(obj, path, hits):
+def _walk(obj, path, hits, recalls):
     if isinstance(obj, dict):
+        if "recall_at_10" in obj and "recall_floor" in obj:
+            recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k == "dispatches_per_turn":
                 hits.append((here, v))
             else:
-                _walk(v, here, hits)
+                _walk(v, here, hits, recalls)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
-            _walk(v, f"{path}[{i}]", hits)
+            _walk(v, f"{path}[{i}]", hits, recalls)
 
 
 def main(argv):
@@ -44,6 +53,7 @@ def main(argv):
                             os.pardir, "bench_artifacts")
         paths = sorted(glob.glob(os.path.join(root, "*.json")))
     checked = 0
+    checked_recall = 0
     bad = []
     for p in paths:
         try:
@@ -53,15 +63,27 @@ def main(argv):
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
         hits = []
-        _walk(data, os.path.basename(p), hits)
+        recalls = []
+        _walk(data, os.path.basename(p), hits, recalls)
         for loc, v in hits:
             checked += 1
             if v != 1:
-                bad.append((loc, v))
-    for loc, v in bad:
-        print(f"REGRESSION: {loc} == {v!r} (expected 1)")
-    print(f"[check] {checked} dispatches_per_turn value(s) across "
-          f"{len(paths)} artifact(s); {len(bad)} regression(s)")
+                bad.append((loc, f"dispatches_per_turn == {v!r} "
+                                 f"(expected 1)"))
+        for loc, got, floor in recalls:
+            checked_recall += 1
+            try:
+                ok = float(got) >= float(floor)
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                bad.append((loc, f"recall_at_10 == {got!r} "
+                                 f"< recall_floor {floor!r}"))
+    for loc, msg in bad:
+        print(f"REGRESSION: {loc}: {msg}")
+    print(f"[check] {checked} dispatches_per_turn value(s) and "
+          f"{checked_recall} recall pair(s) across {len(paths)} "
+          f"artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 
 

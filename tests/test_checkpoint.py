@@ -212,3 +212,21 @@ def test_payload_fsynced_before_pointer_flip(tmp_path, monkeypatch):
     C.save_index(idx, str(tmp_path / "ck"))
     # meta.json + arrays.npz + staged dir + ckpt dir (x2) + CURRENT >= 5
     assert len(synced) >= 5
+
+
+def test_round_trip_restores_super_row_tracking(tmp_path):
+    """ISSUE 4: ``load_index`` bypasses ``add``, so the super-row set the
+    fused IVF serving kernel's extras rely on must be rebuilt from the
+    restored ``is_super`` column."""
+    idx = MemoryIndex(dim=16, capacity=64, edge_capacity=32)
+    rng = np.random.RandomState(1)
+    emb = rng.randn(6, 16).astype(np.float32)
+    idx.add([f"n{i}" for i in range(6)], emb, [0.5] * 6, [0.0] * 6,
+            ["semantic"] * 6, ["work"] * 6, "default",
+            is_super=[False, True, False, True, False, False])
+    ck = str(tmp_path / "ckpt")
+    save_index(idx, ck)
+    idx2 = load_index(ck)
+    assert idx2._super_rows == idx._super_rows
+    assert idx2._super_rows_frozen == idx._super_rows_frozen
+    assert idx2._super_rows == {idx.id_to_row["n1"], idx.id_to_row["n3"]}

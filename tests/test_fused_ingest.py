@@ -271,3 +271,101 @@ def test_coalescer_merges_and_splits():
     batches = c.drain()
     assert [len(f) for f, _ in batches] == [10, 10, 3]
     assert sum(n for _, n in batches) >= 1
+
+
+def test_link_pool_hint_overflow_retry_exact_parity():
+    """ISSUE 4 satellite (ROADMAP ceiling #2): with a tiny
+    ``link_accept_hint`` the edge-slot pool under-provisions on purpose; a
+    batch whose accepted links overflow it must (a) raise the in-kernel
+    overflow flag / bump ``link_pool_overflows``, (b) re-insert exactly
+    the overflowed edges host-side, ending bit-identical (keys, weights,
+    created lists) to a worst-case-pool twin, and (c) never leak slots."""
+    rng = np.random.default_rng(3)
+    base = rng.standard_normal((1, 16)).astype(np.float32)
+
+    def build():
+        idx = MemoryIndex(dim=16, capacity=255, edge_capacity=512)
+        seed_emb = (np.tile(base, (8, 1))
+                    + 0.05 * rng.standard_normal((8, 16)).astype(np.float32))
+        idx.add([f"s{i}" for i in range(8)], seed_emb, [0.5] * 8, [0.0] * 8,
+                ["semantic"] * 8, ["default"] * 8, "u0")
+        return idx
+
+    rng = np.random.default_rng(3)          # same stream for both twins
+    a = build()
+    rng = np.random.default_rng(3)
+    b = build()
+    rng = np.random.default_rng(4)
+    new_emb = (np.tile(base, (4, 1))
+               + 0.05 * rng.standard_normal((4, 16)).astype(np.float32))
+    args = ([f"n{i}" for i in range(4)], new_emb, [0.5] * 4, [0.0] * 4,
+            ["semantic"] * 4, ["default"] * 4, "u0")
+    kw = dict(link_k=3, link_gate=0.5, now=123.0)
+
+    free_a = len(a._free_edge_slots)
+    _, _, created_a = a.ingest_batch(*args, link_accept_hint=0.05, **kw)
+    _, _, created_b = b.ingest_batch(*args, **kw)   # worst-case pool
+    assert a.link_pool_overflows == 1
+    assert b.link_pool_overflows == 0
+    for sm in (1, 0):
+        assert sorted(created_a[sm]) == sorted(created_b[sm])
+    assert set(a.edge_slots) == set(b.edge_slots)
+    wa, wb = a.edge_weights(), b.edge_weights()
+    for key in wa:
+        assert abs(wa[key][0] - wb[key][0]) < 1e-5, (key, wa[key], wb[key])
+    # no slot leaked: free + registered == free_before (every edge holds 1)
+    assert len(a._free_edge_slots) + len(a.edge_slots) == free_a
+
+
+def test_link_pool_hint_no_overflow_shrinks_allocation():
+    """A hint that still covers the acceptance rate must shrink the
+    transient pool draw (the free list never dips to the worst case) and
+    skip the retry entirely."""
+    idx = MemoryIndex(dim=D, capacity=255, edge_capacity=1023)
+    emb = np.eye(D, dtype=np.float32)[:8]     # orthogonal: nothing links
+    _, _, created = idx.ingest_batch(
+        [f"o{i}" for i in range(8)], emb, [0.5] * 8, [0.0] * 8,
+        ["semantic"] * 8, ["default"] * 8, "u", link_k=3,
+        link_accept_hint=0.25)
+    assert created == {1: [], 0: []}
+    assert idx.link_pool_overflows == 0
+    # worst case would draw 2*8*3 = 48 pool slots; hint 0.25 draws 12
+    assert idx._link_pool_size(48, 0.25) == 12
+    assert idx._link_pool_size(48, 1.0) == 48
+    assert idx._link_pool_size(48, 0.0) == 1   # floor: overflow path owns it
+
+
+def test_dedup_fused_pool_hint_overflow_retry():
+    """The dedup-fused mega-batch path honors the hint too: overflowed
+    accepted links come back through ``commit_ingest_dedup``'s host retry
+    with identical weights."""
+    rng = np.random.default_rng(11)
+    base = rng.standard_normal((1, 16)).astype(np.float32)
+
+    def run(hint):
+        idx = MemoryIndex(dim=16, capacity=255, edge_capacity=512)
+        seed_emb = (np.tile(base, (6, 1))
+                    + 0.05 * rng.standard_normal((6, 16)).astype(np.float32))
+        idx.add([f"s{i}" for i in range(6)], seed_emb, [0.5] * 6, [0.0] * 6,
+                ["semantic"] * 6, ["default"] * 6, "u0")
+        new_emb = (np.tile(base, (3, 1))
+                   + 0.05 * rng.standard_normal((3, 16)).astype(np.float32))
+        pending = idx.ingest_batch_dedup(
+            new_emb, [0.5] * 3, [0.0] * 3, ["semantic"] * 3,
+            ["default"] * 3, "u0", dedup_gate=2.0, link_k=3,
+            link_gate=0.5, now=99.0, link_accept_hint=hint)
+        ids = [f"q{i}" for i in range(3)]
+        _, created, _, _ = idx.commit_ingest_dedup(pending, ids)
+        return idx, created
+
+    rng = np.random.default_rng(11)
+    a, created_a = run(0.05)
+    rng = np.random.default_rng(11)
+    b, created_b = run(1.0)
+    assert a.link_pool_overflows == 1 and b.link_pool_overflows == 0
+    for sm in (1, 0):
+        assert sorted(created_a[sm]) == sorted(created_b[sm])
+    assert set(a.edge_slots) == set(b.edge_slots)
+    wa, wb = a.edge_weights(), b.edge_weights()
+    for key in wa:
+        assert abs(wa[key][0] - wb[key][0]) < 1e-5, (key, wa[key], wb[key])

@@ -262,10 +262,12 @@ def test_multi_tenant_batch_isolation():
         ms.close()
 
 
-def test_fused_serving_covers_int8_but_not_ivf():
+def test_fused_serving_covers_int8_and_ivf_but_not_pq():
     """Since ISSUE 3 the fused path serves int8 mode itself (the quantized
-    coarse-scan + exact-rescore kernel) — only the IVF coarse stage still
-    owns its own prefilter scan and bypasses the fused program."""
+    coarse-scan + exact-rescore kernel), and since ISSUE 4 the IVF coarse
+    stage rides INSIDE the fused program too (centroid prefilter + member
+    gather, ``search_fused_ivf``) — only IVF-PQ member storage keeps its
+    own classic prefilter scan and bypasses fusion."""
     with tempfile.TemporaryDirectory() as tmp:
         ms = _ingest(_system(tmp))
         assert ms._use_fused_serving()
@@ -273,5 +275,7 @@ def test_fused_serving_covers_int8_but_not_ivf():
         assert ms._use_fused_serving()     # quant kernel serves this mode
         ms.index.int8_serving = False
         ms.index.ivf_nprobe = 4
-        assert not ms._use_fused_serving()
+        assert ms._use_fused_serving()     # IVF rides the fused kernel now
+        ms.index.pq_serving = True
+        assert not ms._use_fused_serving()  # PQ keeps the classic scan
         ms.close()

@@ -341,3 +341,34 @@ def test_ivf_duplicate_rows_do_not_shorten_results():
     assert got[0] == "m0"
     assert len(got) == k, f"duplicate consumed a top-k slot: {got}"
     assert len(set(got)) == k
+
+
+def test_residual_cache_keyed_on_residual_buffer_identity():
+    """ISSUE 4 satellite: an ``IvfIndex`` is a mutable dataclass, so a
+    same-length rebuild can swap ``ivf.residual`` in place on the SAME
+    build object without passing through the ``_ivf`` setter. The device-
+    residual cache is keyed on the residual buffer's identity (besides the
+    build and fresh-tuple identities), so the swap must force a re-upload
+    — a (build, fresh) key would keep serving the stale residual rows."""
+    import jax.numpy as jnp
+
+    idx, emb = _built_index(seed=23)
+    ivf, fresh = idx._ivf_pack
+    dev0 = idx._ivf_residual_dev(ivf, fresh)
+    assert idx._ivf_residual_dev(ivf, fresh) is dev0   # cache hit
+
+    new_res = np.full(np.asarray(ivf.residual).shape, -1, np.int32)
+    new_res[0] = idx.id_to_row["m3"]          # same length, new content
+    ivf.residual = jnp.asarray(new_res)       # in-place, setter bypassed
+    dev1 = idx._ivf_residual_dev(ivf, fresh)
+    assert dev1 is not dev0, "stale residual served after in-place swap"
+    assert idx.id_to_row["m3"] in np.asarray(dev1).tolist()
+
+    # the fused-serving extras cache applies the same keying
+    dev2 = idx._ivf_extras_dev(ivf, fresh)
+    assert idx._ivf_extras_dev(ivf, fresh) is dev2
+    new_res[1] = idx.id_to_row["m4"]
+    ivf.residual = jnp.asarray(new_res)
+    dev3 = idx._ivf_extras_dev(ivf, fresh)
+    assert dev3 is not dev2
+    assert idx.id_to_row["m4"] in np.asarray(dev3).tolist()
