@@ -846,6 +846,224 @@ def bench_fused_ivf(on_tpu: bool, rows: int, reps: int = 3,
     return out
 
 
+def bench_fused_sharded(on_tpu: bool, rows: int, reps: int = 3,
+                        n_parts: int = 4, edge_rows: int = 100_000,
+                        recall_floor: float = 0.99,
+                        speedup_floor: float = 1.5):
+    """Pod-scale fused serving A/B (ISSUE 5 acceptance): batch-64 chat-turn
+    retrieval over a ``n_parts``-way host-device mesh through three paths —
+
+      fused_sharded  : ONE distributed shard_map dispatch running the FULL
+                       chat-turn program (gate + ANN + CSR gather +
+                       shard-local boost scatters;
+                       ``ShardedMemoryIndex.serve_requests``)
+      classic_sharded: the semantics-EQUIVALENT multi-dispatch pod
+                       sequence the old path needed for a chat turn — a
+                       ``make_sharded_multitenant_topk`` dispatch per
+                       retrieval tier (super gate + main ANN: the arena
+                       streams from HBM twice) + access-boost and
+                       neighbor-boost scatter dispatches with the host
+                       neighbor walk between them
+      plain_topk     : the OLD pod ``serve_requests`` body — one
+                       multitenant top-k dispatch that silently DROPPED
+                       the gate/neighbor/boost semantics (recorded for
+                       honesty: it does strictly less work)
+
+    plus the single-chip fused path over the same data on one device
+    (the pod-vs-chip scaling datapoint). ``dispatches_per_turn`` is
+    MEASURED by counting the index's ``_dispatch`` entries per serve, and
+    recall@10 of the fused-sharded results is scored against the classic
+    multitenant top-k oracle (both exact → floor 0.99 guards the merge)."""
+    import jax as _jax
+    from lazzaro_tpu.core import state as S_mod
+    from lazzaro_tpu.core.index import MemoryIndex
+    from lazzaro_tpu.parallel.index import ShardedMemoryIndex
+    from lazzaro_tpu.parallel.mesh import make_mesh
+    from lazzaro_tpu.serve import RetrievalRequest
+
+    n_dev = len(_jax.devices())
+    if n_dev < n_parts:
+        print(f"[bench] fused-sharded: only {n_dev} devices (wanted "
+              f"{n_parts}); set XLA_FLAGS="
+              f"--xla_force_host_platform_device_count={n_parts} for the "
+              f"CPU mesh", file=sys.stderr, flush=True)
+        n_parts = max(1, n_dev)
+    mesh = make_mesh(("data",), (n_parts,),
+                     devices=_jax.devices()[:n_parts])
+    B = 64
+    rng = np.random.default_rng(41)
+    idx = ShardedMemoryIndex(mesh, dim=DIM, capacity=rows + 64,
+                             dtype=jnp.bfloat16, k=10, cap_take=5,
+                             max_nbr=16)
+    t0 = time.perf_counter()
+    for c in range(0, rows, 65_536):
+        m = min(65_536, rows - c)
+        emb = rng.standard_normal((m, DIM)).astype(np.float32)
+        idx.add([f"f{c + i}" for i in range(m)], emb, "u0")
+    fill_s = time.perf_counter() - t0
+    ne = min(edge_rows, rows - 1)
+    idx.add_edges([(f"f{i}", f"f{i + 1}", 0.7) for i in range(ne)])
+    nbr_map = {}
+    for (s, t) in idx.edges:
+        nbr_map.setdefault(s, []).append(t)
+        nbr_map.setdefault(t, []).append(s)
+    queries = rng.standard_normal((B, DIM)).astype(np.float32)
+    reqs = [RetrievalRequest(query=queries[i], tenant="u0", k=10,
+                             gate_enabled=True, boost=True)
+            for i in range(B)]
+    read_reqs = [RetrievalRequest(query=queries[i], tenant="u0", k=10)
+                 for i in range(B)]
+
+    # classic pod kernels: one multitenant top-k dispatch per retrieval
+    # tier (the old path had no super column in the kernel, so the gate
+    # tier re-streams the arena with a super-masked alive column)
+    from lazzaro_tpu.ops.topk import make_sharded_multitenant_topk
+    classic_kern = make_sharded_multitenant_topk(mesh, "data", k=16)
+    st0 = idx.state
+    tid = np.full((B,), idx._tenants["u0"], np.int32)
+    qn = queries / np.maximum(
+        np.linalg.norm(queries, axis=1, keepdims=True), 1e-9)
+    qn_dev = jnp.asarray(qn)
+    tid_dev = jnp.asarray(tid)
+    sup_alive = st0.alive & st0.is_super      # gate-tier mask column
+    main_alive = st0.alive & ~st0.is_super
+    # a live snapshot would trip the donation gate and force the copying
+    # kernels on BOTH sides of the A/B (boost scatters don't touch these
+    # mask sources, so the derived columns stay valid)
+    del st0
+
+    def run_fused():
+        return idx.serve_requests(reqs)
+
+    def run_plain():
+        idx.serve_fused = False
+        try:
+            return idx.serve_requests(read_reqs)
+        finally:
+            idx.serve_fused = True
+
+    def run_classic():
+        st = idx.state
+        idx._dispatch(classic_kern, st.emb, sup_alive, st.tenant_id,
+                      qn_dev, tid_dev)                       # gate tier
+        scores, rows_d = idx._dispatch(classic_kern, st.emb, main_alive,
+                                       st.tenant_id, qn_dev, tid_dev)
+        del st          # let the boost scatters take the donated twins
+        from lazzaro_tpu.utils.batching import decode_topk
+        per = decode_topk(np.asarray(scores), np.asarray(rows_d),
+                          idx.row_to_id, -1e30, limit=10)
+        hit_ids = [i for ids_, _sc in per for i in ids_[:5]]
+        hit_rows = np.asarray([idx.id_to_row[i] for i in hit_ids], np.int32)
+        now_rel = time.time() - idx.epoch
+        idx._apply_arena(S_mod.arena_update_access,
+                         S_mod.arena_update_access_copy,
+                         jnp.asarray(S_mod.pad_rows(hit_rows, idx.capacity)),
+                         jnp.float32(now_rel), jnp.float32(0.05))
+        retrieved = set(hit_ids)
+        nbrs = sorted({x for i in hit_ids for x in nbr_map.get(i, ())}
+                      - retrieved)
+        if nbrs:
+            nrows = np.asarray([idx.id_to_row[i] for i in nbrs], np.int32)
+            idx._apply_arena(S_mod.arena_boost, S_mod.arena_boost_copy,
+                             jnp.asarray(S_mod.pad_rows(nrows, idx.capacity)),
+                             jnp.float32(now_rel), jnp.float32(0.02))
+        return per
+
+    t0 = time.perf_counter()
+    fused_res = run_fused()                   # warm/compile
+    warm_s = time.perf_counter() - t0
+    oracle = run_plain()
+    run_classic()
+    # recall@10 of the fused pod results vs the classic multitenant top-k
+    hits = total = 0
+    for r_f, r_o in zip(fused_res, oracle):
+        want = set(r_o.ids[:10])
+        total += len(want)
+        hits += len(want & set(r_f.ids[:10]))
+    recall = hits / max(total, 1)
+
+    calls = {"n": 0}
+    orig_dispatch = idx._dispatch
+
+    def counting(fn, *a, **kw):
+        calls["n"] += 1
+        return orig_dispatch(fn, *a, **kw)
+
+    idx._dispatch = counting
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_fused()
+    fused_ms = (time.perf_counter() - t0) * 1e3 / reps
+    dispatches_per_turn = calls["n"] / reps
+    idx._dispatch = orig_dispatch
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_classic()
+    classic_ms = (time.perf_counter() - t0) * 1e3 / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_plain()
+    plain_ms = (time.perf_counter() - t0) * 1e3 / reps
+
+    # single-chip fused over the same corpus on ONE device (the
+    # pod-vs-chip scaling datapoint; same kernel family, no mesh)
+    rng2 = np.random.default_rng(41)
+    chip = MemoryIndex(dim=DIM, capacity=rows + 64,
+                       edge_capacity=2 * ne + 64, dtype=jnp.bfloat16)
+    for c in range(0, rows, 65_536):
+        m = min(65_536, rows - c)
+        emb = rng2.standard_normal((m, DIM)).astype(np.float32)
+        chip.add([f"f{c + i}" for i in range(m)], emb, [0.5] * m, [0.0] * m,
+                 ["semantic"] * m, ["default"] * m, "u0")
+    chip.add_edges([(f"f{i}", f"f{i + 1}", 0.7) for i in range(ne)], "u0")
+    kw = dict(cap_take=5, max_nbr=16, super_gate=0.4,
+              acc_boost=0.05, nbr_boost=0.02)
+    chip.search_fused_requests(reqs, **kw)    # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        chip.search_fused_requests(reqs, **kw)
+    chip_ms = (time.perf_counter() - t0) * 1e3 / reps
+    del chip
+
+    n_rows = rows
+    out = {
+        "mesh": {"n_parts": n_parts, "axis": "data",
+                 "rows_per_chip": (idx.capacity + 1) // n_parts},
+        "arena_rows": n_rows,
+        "dim": DIM,
+        "batch": B,
+        "reps": reps,
+        "edge_band": ne,
+        "fill_s": round(fill_s, 1),
+        "warm_s": round(warm_s, 1),
+        "dispatches_per_turn": dispatches_per_turn,
+        "recall_at_10": round(recall, 4),
+        "recall_floor": recall_floor,
+        "speedup_floor": speedup_floor,
+        "fused_sharded_retrieval_qps": round(B / (fused_ms / 1e3), 1),
+        "classic_sharded_retrieval_qps": round(B / (classic_ms / 1e3), 1),
+        "plain_topk_retrieval_qps": round(B / (plain_ms / 1e3), 1),
+        "single_chip_fused_qps": round(B / (chip_ms / 1e3), 1),
+        "fused_sharded_batch64_ms": round(fused_ms, 3),
+        "classic_sharded_batch64_ms": round(classic_ms, 3),
+        "plain_topk_batch64_ms": round(plain_ms, 3),
+        "single_chip_fused_batch64_ms": round(chip_ms, 3),
+        "fused_vs_classic_speedup": round(classic_ms / fused_ms, 2),
+        "fused_vs_plain_ratio": round(plain_ms / fused_ms, 2),
+        "sharded_vs_single_chip_speedup": round(chip_ms / fused_ms, 2),
+        "roofline": {
+            # aggregate HBM across the pod: one batch streams the whole
+            # arena once (fused) vs twice (classic's two tiers)
+            "fused_sharded_batch64": _roofline(n_rows, DIM, 2, fused_ms,
+                                               B, on_tpu),
+            "classic_sharded_batch64": _roofline(2 * n_rows, DIM, 2,
+                                                 classic_ms, B, on_tpu),
+        },
+    }
+    del idx
+    return out
+
+
 def bench_reference_default(on_tpu: bool):
     """Reference-DEFAULT configuration, measured (r4 review #4): hierarchy
     ON (super-node creation + the 0.4-gated fast path, ref
@@ -1644,6 +1862,42 @@ def fused_ivf_stage_main():
                           "sizes": {size_tag: out}}))
 
 
+def fused_sharded_stage_main():
+    """Standalone pod-serving A/B (BENCH_FUSED_SHARDED=<rows[,rows...]> or
+    =1 for the ISSUE 5 size 262144): runs ONLY the fused-sharded stage on
+    an n-way host-device mesh and writes
+    bench_artifacts/pr5_fused_sharded_<size>_<dev>.json. On CPU run with
+    XLA_FLAGS=--xla_force_host_platform_device_count=<n> (the stage warns
+    and shrinks the mesh otherwise). BENCH_SHARDED_PARTS picks the mesh
+    width (default 4)."""
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    spec = os.environ.get("BENCH_FUSED_SHARDED", "1")
+    sizes = ([262_144] if spec.strip() in ("", "1")
+             else [int(s) for s in spec.split(",") if s.strip()])
+    n_parts = int(os.environ.get("BENCH_SHARDED_PARTS", "4"))
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    dev_tag = "tpu" if on_tpu else "cpu"
+    for rows in sizes:
+        print(f"[bench] fused-sharded stage at {rows} rows, {n_parts}-way",
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        out = bench_fused_sharded(on_tpu, rows, n_parts=n_parts)
+        out["stage_total_s"] = round(time.perf_counter() - t0, 1)
+        size_tag = "1m" if rows >= 1_000_000 else f"{rows // 1024}k"
+        path = os.path.join(art_dir,
+                            f"pr5_fused_sharded_{size_tag}_{dev_tag}.json")
+        with open(path, "w") as f:
+            json.dump({"metric": "fused_sharded_retrieval_qps",
+                       "value": out["fused_sharded_retrieval_qps"],
+                       "unit": "qps", "device": dev_tag,
+                       "sizes": {size_tag: out}}, f, indent=1)
+        print(f"[bench] wrote {path}", file=sys.stderr, flush=True)
+        print(json.dumps({"metric": "fused_sharded_retrieval_qps",
+                          "sizes": {size_tag: out}}))
+
+
 if __name__ == "__main__":
     try:
         if os.environ.get("BENCH_FUSED_QUANT"):
@@ -1651,6 +1905,9 @@ if __name__ == "__main__":
             sys.exit(0)
         if os.environ.get("BENCH_FUSED_IVF"):
             fused_ivf_stage_main()
+            sys.exit(0)
+        if os.environ.get("BENCH_FUSED_SHARDED"):
+            fused_sharded_stage_main()
             sys.exit(0)
         main()
     except Exception as e:  # always emit ONE parseable JSON line (weak #6)

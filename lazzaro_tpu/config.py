@@ -95,9 +95,13 @@ class MemoryConfig:
     # dispatch. With ivf_serving > 0 and a published build, the coarse
     # stage becomes the IVF centroid prefilter + member gather INSIDE the
     # same dispatch (state.search_fused_ivf; composes with int8 as
-    # gathered-int8 coarse + exact rescore). Automatically bypassed under
-    # a mesh or with pq_serving (the PQ member scan keeps its classic
-    # multi-dispatch path).
+    # gathered-int8 coarse + exact rescore). Under a MESH the same
+    # chat-turn program runs as ONE distributed shard_map dispatch
+    # (state.make_fused_sharded): shard-local scan (exact or int8
+    # coarse+rescore), one all_gather + global top-k merge, then the
+    # gate/CSR/boost tail with shard-local scatters — the pod path keeps
+    # the full serving semantics. Only pq_serving bypasses fusion (the PQ
+    # member scan keeps its classic multi-dispatch path).
     serve_fused: bool = True
     # QueryScheduler flush policy: a pending batch ships when it reaches
     # serve_batch_max requests OR when its oldest request has waited

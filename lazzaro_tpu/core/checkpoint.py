@@ -325,17 +325,19 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
 # Pod-sharded index (parallel.index.ShardedMemoryIndex)
 # ---------------------------------------------------------------------------
 
-_SHARDED_COLS = ("emb", "alive", "tenant", "salience")
-
-
 def save_sharded_index(index, ckpt_dir: str) -> None:
-    """Checkpoint a ``ShardedMemoryIndex``: columns are gathered to host
-    (cross-process allgather when the mesh spans hosts) and written under
-    the same versioned-CURRENT layout as ``save_index``."""
+    """Checkpoint a ``ShardedMemoryIndex``: the full arena column set
+    (ISSUE 5 — the pod index now carries every serving column: access
+    counters, super flags, timestamps) is gathered to host (cross-process
+    allgather when the mesh spans hosts) and written under the same
+    versioned-CURRENT layout as ``save_index``; the host edge map rides
+    the JSON sidecar so the CSR shadow rebuilds on load."""
+    st = index.state
     arrays: Dict[str, np.ndarray] = {}
     dtypes: Dict[str, str] = {}
-    for col in _SHARDED_COLS:
-        arrays[col], dtypes[col] = _host(getattr(index, col))
+    for col in _ARENA_COLS:
+        arrays[f"arena_{col}"], dtypes[f"arena_{col}"] = _host(
+            getattr(st, col))
     ids = list(index.id_to_row.keys())
     arrays["node_rows"] = np.asarray([index.id_to_row[i] for i in ids],
                                      np.int64)
@@ -345,18 +347,21 @@ def save_sharded_index(index, ckpt_dir: str) -> None:
         "dim": index.dim,
         "capacity": index.capacity,
         "axis": index.axis,
+        "epoch": index.epoch,
         "tenant_affinity": index.tenant_affinity,
         "column_dtypes": dtypes,
         "node_ids": ids,
         "tenants": index._tenants,
+        "edges": [[s, t, w] for (s, t), w in index.edges.items()],
     }
     _write_versioned(ckpt_dir, arrays, meta)
 
 
 def load_sharded_index(ckpt_dir: str, mesh, k: int = 10):
     """Rebuild a ``ShardedMemoryIndex`` on ``mesh`` from ``save_sharded_index``
-    output. The mesh axis size must divide the saved capacity (any mesh whose
-    axis size divides it works — checkpoints are portable across pod shapes)."""
+    output. The mesh axis size must divide the saved row count (any mesh
+    whose axis size divides it works — checkpoints are portable across pod
+    shapes)."""
     from lazzaro_tpu.parallel.index import ShardedMemoryIndex
 
     data, meta = _read_versioned(ckpt_dir)
@@ -366,27 +371,38 @@ def load_sharded_index(ckpt_dir: str, mesh, k: int = 10):
         raise ValueError(f"unsupported checkpoint format {meta['format_version']}")
     dtypes = meta["column_dtypes"]
 
-    dt = (jnp.bfloat16 if dtypes["emb"] == "bfloat16"
-          else jnp.dtype(dtypes["emb"]))
+    dt = (jnp.bfloat16 if dtypes["arena_emb"] == "bfloat16"
+          else jnp.dtype(dtypes["arena_emb"]))
+    n_parts = mesh.shape[meta["axis"]]
+    total = int(meta["capacity"]) + 1
+    if total % n_parts != 0:
+        raise ValueError(
+            f"saved row count {total} does not divide the mesh axis "
+            f"({n_parts}) — pick a pod shape whose axis divides it")
     index = ShardedMemoryIndex(
         mesh, dim=meta["dim"], capacity=meta["capacity"],
-        axis=meta["axis"], dtype=dt,
+        axis=meta["axis"], dtype=dt, epoch=meta.get("epoch"),
         tenant_affinity=meta["tenant_affinity"], k=k)
-    import jax
-    for col in _SHARDED_COLS:
-        sharding = index._mat_sh if col == "emb" else index._row_sh
-        setattr(index, col,
-                jax.device_put(_device(data[col], dtypes[col]), sharding))
+    arena = S.ArenaState(**{
+        col: _device(data[f"arena_{col}"], dtypes[f"arena_{col}"])
+        for col in _ARENA_COLS})
+    index.state = arena                     # setter re-shards over the mesh
 
     node_rows = data["node_rows"].astype(np.int64)
     node_ids = np.asarray(meta["node_ids"], object)
     index.id_to_row = dict(zip(node_ids.tolist(), node_rows.tolist()))
     index.row_to_id = dict(zip(node_rows.tolist(), node_ids.tolist()))
     index._tenants = {t: int(v) for t, v in meta["tenants"].items()}
+    index.edges = {(s, t): float(w) for s, t, w in meta.get("edges", [])}
+    index._csr_dirty = True
+    sup = np.asarray(data["arena_is_super"]).astype(bool)
+    index._super_rows = set(np.flatnonzero(sup[:index.capacity]).tolist())
     # Per-partition free lists via vectorized set-difference (descending
-    # within each — no per-row Python at 1M-capacity scale).
+    # within each — no per-row Python at 1M-capacity scale); the global
+    # sentinel row is never allocatable.
+    taken = np.concatenate([node_rows, [index.capacity]])
     index._free = [
         np.setdiff1d(np.arange(p * index.part_rows, (p + 1) * index.part_rows,
-                               dtype=np.int64), node_rows)[::-1].tolist()
+                               dtype=np.int64), taken)[::-1].tolist()
         for p in range(index.n_parts)]
     return index

@@ -26,7 +26,64 @@ import numpy as np
 from lazzaro_tpu.core import state as S
 from lazzaro_tpu.ops import graphops
 from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
-                                        fetch_packed, next_pow2, pad_to_pow2)
+                                        fetch_packed, next_pow2, pad_to_pow2,
+                                        unpack_retrieval)
+
+
+def build_host_csr(edge_keys, id_to_row: Dict[str, int], n: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side CSR build shared by the single-chip and pod serving paths:
+    ``(indptr [n+1] i32, nbr [E_pad] i32)`` over ``n`` arena rows from an
+    iterable of ``(src_id, tgt_id)`` edge keys (bidirectional, -1 padded to
+    a pow2 bucket). Built entirely from host bookkeeping — no device
+    readback."""
+    src_l, dst_l = [], []
+    for qsrc, qtgt in edge_keys:
+        s = id_to_row.get(qsrc)
+        t = id_to_row.get(qtgt)
+        if s is None or t is None:
+            continue
+        src_l.append(s)
+        dst_l.append(t)
+    if src_l:
+        a = np.asarray(src_l, np.int64)
+        b = np.asarray(dst_l, np.int64)
+        src = np.concatenate([a, b])
+        dst = np.concatenate([b, a])
+        order = np.argsort(src, kind="stable")
+        src, dst = src[order], dst[order]
+    else:
+        src = dst = np.zeros((0,), np.int64)
+    indptr = np.zeros((n + 1,), np.int32)
+    indptr[1:] = np.cumsum(np.bincount(src, minlength=n))
+    nbr = np.full((max(8, next_pow2(len(dst))),), -1, np.int32)
+    nbr[:len(dst)] = dst
+    return indptr, nbr
+
+
+def split_csr(indptr: np.ndarray, nbr: np.ndarray, n_shards: int
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Row-shard a global CSR for the distributed fused serving kernel
+    (``state.make_fused_sharded``): shard ``p`` gets the neighbor lists of
+    its OWN rows (``[p·L, (p+1)·L)``) with offsets rebased to its slice —
+    neighbor ids stay GLOBAL (a neighbor may live on any chip; the kernel
+    merges the gathered windows and each owner scatters its own rows).
+    Returns ``(indptr_sh [n, L+1] i32, nbr_sh [n, E_max] i32)`` with every
+    shard's neighbor array padded to one common pow2 bucket."""
+    n_rows = indptr.shape[0] - 1
+    assert n_rows % n_shards == 0
+    L = n_rows // n_shards
+    indptr_sh = np.zeros((n_shards, L + 1), np.int32)
+    parts = []
+    for p in range(n_shards):
+        lo, hi = indptr[p * L], indptr[(p + 1) * L]
+        indptr_sh[p] = indptr[p * L:(p + 1) * L + 1] - lo
+        parts.append(np.asarray(nbr[lo:hi], np.int32))
+    e_max = max(8, next_pow2(max(len(x) for x in parts)))
+    nbr_sh = np.full((n_shards, e_max), -1, np.int32)
+    for p, x in enumerate(parts):
+        nbr_sh[p, :len(x)] = x
+    return indptr_sh, nbr_sh
 
 
 class MemoryIndex:
@@ -144,6 +201,10 @@ class MemoryIndex:
         self._shards: Dict[str, int] = {}
         self.tenant_nodes: Dict[str, set] = {}
         self._mesh_topk_cache: Dict[int, object] = {}
+        # Distributed fused serving programs (ISSUE 5): under a mesh the
+        # whole chat-turn program runs as ONE shard_map dispatch
+        # (state.make_fused_sharded), cached per (mode, k, take, nbr).
+        self._fused_sharded_cache: Dict[tuple, object] = {}
         # CSR adjacency shadow for the fused retrieval kernel: a device
         # (indptr, neighbors) pair built from the HOST edge map (edge_slots
         # + id_to_row — no device readback needed), invalidated by edge
@@ -1318,29 +1379,17 @@ class MemoryIndex:
         if cache is not None and not self._csr_dirty and cache[0] == n:
             return cache[1], cache[2]
         self._csr_dirty = False
-        keys = list(self.edge_slots.keys())
-        src_l, dst_l = [], []
-        for qsrc, qtgt in keys:
-            s = self.id_to_row.get(qsrc)
-            t = self.id_to_row.get(qtgt)
-            if s is None or t is None:
-                continue
-            src_l.append(s)
-            dst_l.append(t)
-        if src_l:
-            a = np.asarray(src_l, np.int64)
-            b = np.asarray(dst_l, np.int64)
-            src = np.concatenate([a, b])
-            dst = np.concatenate([b, a])
-            order = np.argsort(src, kind="stable")
-            src, dst = src[order], dst[order]
+        indptr, nbr = build_host_csr(list(self.edge_slots.keys()),
+                                     self.id_to_row, n)
+        if self.mesh is not None:
+            # pod path: per-shard CSR slices for the distributed fused
+            # kernel, placed so each chip holds its own rows' lists
+            from lazzaro_tpu.parallel.mesh import shard_stacked
+            sh = shard_stacked(self.mesh, self.shard_axis)
+            dev = tuple(jax.device_put(a, sh)
+                        for a in split_csr(indptr, nbr, self._n_parts))
         else:
-            src = dst = np.zeros((0,), np.int64)
-        indptr = np.zeros((n + 1,), np.int32)
-        indptr[1:] = np.cumsum(np.bincount(src, minlength=n))
-        nbr = np.full((max(8, next_pow2(len(dst))),), -1, np.int32)
-        nbr[:len(dst)] = dst
-        dev = (jnp.asarray(indptr), jnp.asarray(nbr))
+            dev = (jnp.asarray(indptr), jnp.asarray(nbr))
         self._csr_cache = (n, dev[0], dev[1])
         return dev
 
@@ -1363,7 +1412,13 @@ class MemoryIndex:
         prefilter + member gather, int8-gathered coarse + exact rescore
         when the shadow is on too); otherwise int8 mode takes
         ``search_fused_quant`` (dense int8 coarse + exact rescore); else
-        the exact dense ``search_fused``."""
+        the exact dense ``search_fused``. Under a MESH the same program
+        runs as ONE distributed shard_map dispatch
+        (``state.make_fused_sharded``): shard-local scan (exact, or int8
+        coarse + exact rescore over the row-sharded shadow), one
+        all_gather + global top-k merge, then the gate/CSR/boost tail
+        with shard-local scatters — the pod path keeps the full chat-turn
+        semantics (ISSUE 5)."""
         from lazzaro_tpu.serve.scheduler import RetrievalResult
 
         nq = len(reqs)
@@ -1404,6 +1459,16 @@ class MemoryIndex:
             return out
 
         indptr, nbr = self._csr_for(st)
+        if self.mesh is not None:
+            packed = self._dispatch_fused_sharded(
+                st, indptr, nbr, qp, padb, valid, tenants, gate_on,
+                boost_on, k_bucket, cap_take, max_nbr, super_gate,
+                acc_boost, nbr_boost, now)
+            host = np.asarray(packed)          # the ONE readback
+            gate_s, gate_r, ann_s, ann_r, fast = unpack_retrieval(
+                host[:nq], k_bucket)
+            return self._demux_fused(reqs, results, valid, boost_on, gate_s,
+                                     gate_r, ann_s, ann_r, fast, cap)
         args = (indptr, nbr, jnp.asarray(qp),
                 jnp.asarray(padb(valid)),
                 jnp.asarray(padb(tenants, -1, np.int32)),
@@ -1482,12 +1547,15 @@ class MemoryIndex:
             packed = S.search_fused_read(st, *args,
                                          jnp.float32(super_gate), **statics)
         host = np.asarray(packed)              # the ONE readback
-        k = k_bucket
-        ann_s = host[:nq, 2:2 + k]
-        ann_r = np.ascontiguousarray(host[:nq, 2 + k:2 + 2 * k]).view(np.int32)
-        gate_s = host[:nq, 0]
-        gate_r = np.ascontiguousarray(host[:nq, 1:2]).view(np.int32)[:, 0]
-        fast = host[:nq, 2 + 2 * k] > 0.5
+        gate_s, gate_r, ann_s, ann_r, fast = unpack_retrieval(host[:nq],
+                                                              k_bucket)
+        return self._demux_fused(reqs, results, valid, boost_on, gate_s,
+                                 gate_r, ann_s, ann_r, fast, cap)
+
+    def _demux_fused(self, reqs, results, valid, boost_on, gate_s, gate_r,
+                     ann_s, ann_r, fast, cap):
+        """Per-request demux of the unpacked fused readback — shared by the
+        single-chip and the pod-sharded dispatch."""
         for i, r in enumerate(reqs):
             if not valid[i]:
                 continue
@@ -1502,6 +1570,59 @@ class MemoryIndex:
             res.fast = bool(fast[i])
             res.boosted = bool(boost_on[i] and not fast[i])
         return results
+
+    def _fused_sharded_kernels(self, mode: str, k_bucket: int,
+                               cap_take: int, max_nbr: int):
+        key = (mode, k_bucket, cap_take, max_nbr)
+        kern = self._fused_sharded_cache.get(key)
+        if kern is None:
+            kern = S.make_fused_sharded(
+                self.mesh, self.shard_axis, k=k_bucket,
+                cap_take=min(cap_take, k_bucket), max_nbr=max_nbr,
+                mode=mode, slack=self.coarse_slack)
+            self._fused_sharded_cache[key] = kern
+        return kern
+
+    def _dispatch_fused_sharded(self, st, indptr, nbr, qp, padb, valid,
+                                tenants, gate_on, boost_on, k_bucket,
+                                cap_take, max_nbr, super_gate, acc_boost,
+                                nbr_boost, now):
+        """The pod serving dispatch (ISSUE 5): the full chat-turn program
+        as ONE distributed shard_map dispatch against the row-sharded
+        arena. Exact by default; with ``int8_serving`` the shard-local
+        scan streams the row-sharded int8 shadow (coarse + exact rescore —
+        the same two-stage semantics as single-chip quant mode, so the
+        gate verdict never sees quantization error). ``indptr``/``nbr``
+        are the PER-SHARD CSR slices ``_csr_for`` builds under a mesh.
+        The donation gate is the same refcount contract as every other
+        mutation: donate only when this index provably holds the sole
+        arena reference."""
+        use_quant = bool(self.int8_serving)
+        mode = "quant" if use_quant else "exact"
+        kern = self._fused_sharded_kernels(mode, k_bucket, cap_take, max_nbr)
+        sargs = (indptr, nbr, jnp.asarray(qp), jnp.asarray(padb(valid)),
+                 jnp.asarray(padb(tenants, -1, np.int32)),
+                 jnp.asarray(padb(gate_on)))
+        if boost_on.any():
+            del st      # a live snapshot would trip the sole-owner gate
+            now_rel = (now if now is not None else time.time()) - self.epoch
+            with self._state_lock:
+                cur = self._state
+                tables = self._int8_shadow_for(cur) if use_quant else ()
+                fn = (kern.serve
+                      if sys.getrefcount(cur) <= self._SOLE_REFS
+                      else kern.serve_copy)
+                new_state, packed = fn(cur, tables, *sargs,
+                                       jnp.asarray(padb(boost_on)),
+                                       jnp.float32(now_rel),
+                                       jnp.float32(super_gate),
+                                       jnp.float32(acc_boost),
+                                       jnp.float32(nbr_boost))
+                del cur
+                self.state = new_state
+            return packed
+        tables = self._int8_shadow_for(st) if use_quant else ()
+        return kern.read(st, tables, *sargs, jnp.float32(super_gate))
 
     def apply_boosts(self, entries: Dict[str, Tuple[int, int, float]],
                      acc_boost: float, nbr_boost: float) -> None:

@@ -765,7 +765,7 @@ class MemorySystem:
 
     # ----------------------------------------------------------- fused serving
     def _use_fused_serving(self) -> bool:
-        """Fused retrieval serves the single-chip arena — exact by default,
+        """Fused retrieval serves every arena mode — exact by default,
         through the quantized two-stage kernel (int8 coarse scan + exact
         rescore, ``state.search_fused_quant``) when the int8 serving shadow
         is on, and through the IVF coarse stage (centroid prefilter +
@@ -774,10 +774,14 @@ class MemorySystem:
         one-dispatch turn, cross-request mega-batching, and zero-RTT cache
         hits (``MemoryIndex.search_fused_requests`` owns the routing; an
         IVF config with no build yet serves the dense fused path). Under a
-        mesh the shard_map searcher owns the path, and IVF-PQ member
-        storage keeps its own classic prefilter scan the fused kernel
-        does not reproduce."""
-        return (self.config.serve_fused and self.mesh is None
+        MESH the same request flow routes to the distributed shard_map
+        program (``state.make_fused_sharded`` via the index's pod
+        dispatch, ISSUE 5) — shard-local scan, one all_gather merge,
+        shard-local boost scatters — so the pod path keeps the gate /
+        neighbor / boost semantics and the one-distributed-dispatch turn
+        too. Only IVF-PQ member storage keeps its classic prefilter scan
+        the fused kernel does not reproduce."""
+        return (self.config.serve_fused
                 and not (self.index.ivf_nprobe and self.index.pq_serving))
 
     def _ensure_scheduler(self) -> QueryScheduler:

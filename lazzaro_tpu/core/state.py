@@ -49,7 +49,7 @@ Who may hold a reference to an ``ArenaState``/``EdgeState``:
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -938,34 +938,43 @@ def _gate_and_boost_rows(state: ArenaState, csr_indptr, csr_nbr, gate_s,
     return fast, acc_rows, nbr_rows
 
 
+def _exact_two_tier(state: ArenaState, q_c: jax.Array, tenant_c: jax.Array,
+                    k_gate: int, k_ann: int):
+    """Masked super top-``k_gate`` + masked main top-``k_ann`` over ONE
+    score matrix (the arena streams from HBM once; the two retrieval tiers
+    are just different masks, same trick as the multi-mode link scan).
+    The shard-local core of the exact fused scan: single-chip callers pass
+    the whole arena, the sharded program passes each chip's local slice.
+
+    The trailing barrier is the PR 2 consumer-split fix: the top-k results
+    feed BOTH the packed readback and the boost gather chain; without it
+    XLA (CPU at least) splits the consumers into two full [C, cap] sorts —
+    measured 2.4× on the whole fused program at 65k rows."""
+    qn = normalize(q_c).astype(state.emb.dtype)
+    scores = nt_dot(qn, state.emb)                        # [C, rows] f32
+    alive_t = state.alive[None, :] & (
+        state.tenant_id[None, :] == tenant_c[:, None])
+    sup = state.is_super[None, :]
+    gate_s, gate_r = jax.lax.top_k(
+        jnp.where(alive_t & sup, scores, NEG_INF), k_gate)
+    ann_s, ann_r = jax.lax.top_k(
+        jnp.where(alive_t & ~sup, scores, NEG_INF), k_ann)
+    return jax.lax.optimization_barrier((gate_s, gate_r, ann_s, ann_r))
+
+
 def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
                        csr_nbr: jax.Array, q: jax.Array, q_valid: jax.Array,
                        tenant: jax.Array, gate_on: jax.Array,
                        boost_on: jax.Array, super_gate: jax.Array,
                        k: int, cap_take: int, max_nbr: int):
-    """Per-chunk compute phase: masked super top-1 + masked main top-k over
-    ONE score matrix (the arena streams from HBM once; the two retrieval
-    tiers are just different masks, same trick as the multi-mode link
-    scan), the device-side gate verdict, and the CSR neighbor gather with
-    per-query dedup. Returns sentinel-padded row lists for the scatter
-    phase (``capacity`` is the sentinel row index)."""
+    """Per-chunk compute phase: the exact two-tier top-k core, the
+    device-side gate verdict, and the CSR neighbor gather with per-query
+    dedup. Returns sentinel-padded row lists for the scatter phase
+    (``capacity`` is the sentinel row index)."""
 
     def chunk(q_c, valid_c, tenant_c, gate_c, boost_c):
-        qn = normalize(q_c).astype(state.emb.dtype)
-        scores = nt_dot(qn, state.emb)                        # [C, cap+1] f32
-        alive_t = state.alive[None, :] & (
-            state.tenant_id[None, :] == tenant_c[:, None])
-        sup = state.is_super[None, :]
-        gate_s, gate_r = jax.lax.top_k(
-            jnp.where(alive_t & sup, scores, NEG_INF), 1)
-        ann_s, ann_r = jax.lax.top_k(
-            jnp.where(alive_t & ~sup, scores, NEG_INF), k)
-        # Barrier: the top-k results feed BOTH the packed readback and the
-        # boost gather chain below; without it XLA (CPU at least) splits
-        # the consumers into two full [C, cap] sorts — measured 2.4× on
-        # the whole fused program at 65k rows.
-        gate_s, gate_r, ann_s, ann_r = jax.lax.optimization_barrier(
-            (gate_s, gate_r, ann_s, ann_r))
+        gate_s, gate_r, ann_s, ann_r = _exact_two_tier(state, q_c, tenant_c,
+                                                       1, k)
         gate_s, gate_r = gate_s[:, 0], gate_r[:, 0]
         fast, acc_rows, nbr_rows = _gate_and_boost_rows(
             state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
@@ -1010,15 +1019,21 @@ def _search_fused(
 
 def _boost_scatter(state: ArenaState, acc_rows: jax.Array,
                    nbr_rows: jax.Array, now: jax.Array, acc_boost: jax.Array,
-                   nbr_boost: jax.Array) -> ArenaState:
-    """Scatter phase shared by the exact and quantized fused serving
-    kernels: count-weighted access/neighbor salience boosts, capped at 1.0,
-    with freshness inheritance for every touched row."""
+                   nbr_boost: jax.Array, zero_last: bool = True
+                   ) -> ArenaState:
+    """Scatter phase shared by every fused serving kernel: count-weighted
+    access/neighbor salience boosts, capped at 1.0, with freshness
+    inheritance for every touched row. Single-chip callers route masked
+    rows to the in-range sentinel row (``zero_last=True`` zeroes its
+    count); the shard-local scatters route non-owned rows OUT of range
+    instead — XLA drops out-of-bounds scatter updates — so they pass
+    ``zero_last=False``."""
     n = state.emb.shape[0]
-    acc_cnt = (jnp.zeros((n,), jnp.int32).at[acc_rows.reshape(-1)].add(1)
-               .at[n - 1].set(0))
-    nbr_cnt = (jnp.zeros((n,), jnp.int32).at[nbr_rows.reshape(-1)].add(1)
-               .at[n - 1].set(0))
+    acc_cnt = jnp.zeros((n,), jnp.int32).at[acc_rows.reshape(-1)].add(1)
+    nbr_cnt = jnp.zeros((n,), jnp.int32).at[nbr_rows.reshape(-1)].add(1)
+    if zero_last:
+        acc_cnt = acc_cnt.at[n - 1].set(0)
+        nbr_cnt = nbr_cnt.at[n - 1].set(0)
     sal = (state.salience + acc_cnt.astype(jnp.float32) * acc_boost
            + nbr_cnt.astype(jnp.float32) * nbr_boost)
     touched = (acc_cnt > 0) | (nbr_cnt > 0)
@@ -1072,6 +1087,66 @@ def search_fused_read(state: ArenaState, csr_indptr: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+def _quant_two_tier(state: ArenaState, q8a: jax.Array, scale_a: jax.Array,
+                    q_c: jax.Array, tenant_c: jax.Array, k: int, slack: int):
+    """Two-stage quantized two-tier core: int8 coarse scan over the shadow
+    (``q8a`` codes + ``scale_a`` per-row scales, ops/quant.py layout) for
+    BOTH retrieval tiers — super gate candidates and main ANN candidates
+    are different masks over the ONE int8 score matrix — then an exact
+    bf16/f32 rescore of the k+slack survivors via a gathered-row dot. The
+    slack absorbs the ~1e-2 int8 ranking error at the k boundary (ISSUE 3
+    satellite: config-driven, shared with the IVF over-fetch) so the exact
+    top-k can't lose a true member the coarse scan ranked at k+3.
+
+    Shard-local by construction (the shadow row-shards like the master, and
+    the rescore gather only touches local rows): single-chip callers pass
+    the whole arena + shadow, the sharded program each chip's slices.
+    Returns exact-scored ``(gate_s [C,1], gate_r [C,1], ann_s [C,k],
+    ann_r [C,k])``; the super gate is threshold-sensitive (0.4), so its
+    VERDICT uses the exact rescored score — quantization error can only
+    cost a gate candidate ranked below coarse position 1+slack, never flip
+    the threshold comparison itself."""
+    from lazzaro_tpu.ops.quant import quantize_rows
+
+    n = state.emb.shape[0]
+    k_fetch = min(k + slack, n)
+    g_fetch = min(1 + slack, n)
+    qn = normalize(q_c)                                   # [C, d] f32
+    qq, qs = quantize_rows(qn)
+    dots = jax.lax.dot_general(
+        qq, q8a, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                 # [C, rows] i32
+    coarse = (dots.astype(jnp.float32)
+              * qs[:, None] * scale_a[None, :])
+    alive_t = state.alive[None, :] & (
+        state.tenant_id[None, :] == tenant_c[:, None])
+    sup = state.is_super[None, :]
+    cg_s, cg_r = jax.lax.top_k(
+        jnp.where(alive_t & sup, coarse, NEG_INF), g_fetch)
+    ca_s, ca_r = jax.lax.top_k(
+        jnp.where(alive_t & ~sup, coarse, NEG_INF), k_fetch)
+    # Same consumer-split hazard as _exact_two_tier: the coarse top-k
+    # feeds both the rescore gather and (via it) the readback — without
+    # the barrier XLA can duplicate the full-arena sorts.
+    cg_s, cg_r, ca_s, ca_r = jax.lax.optimization_barrier(
+        (cg_s, cg_r, ca_s, ca_r))
+    qd = qn.astype(state.emb.dtype)
+
+    def rescore(rows_c, coarse_s):
+        g = state.emb[rows_c]                             # [C, kf, d]
+        ex = jnp.einsum("cd,ckd->ck", qd, g,
+                        preferred_element_type=jnp.float32)
+        return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
+
+    ann_ex = rescore(ca_r, ca_s)
+    ann_s, sel = jax.lax.top_k(ann_ex, k)
+    ann_r = jnp.take_along_axis(ca_r, sel, axis=1)
+    gate_ex = rescore(cg_r, cg_s)
+    g_s, g_sel = jax.lax.top_k(gate_ex, 1)
+    g_r = jnp.take_along_axis(cg_r, g_sel, axis=1)
+    return g_s, g_r, ann_s, ann_r
+
+
 def _search_fused_quant_scan(state: ArenaState, q8a: jax.Array,
                              scale_a: jax.Array, csr_indptr: jax.Array,
                              csr_nbr: jax.Array, q: jax.Array,
@@ -1079,59 +1154,13 @@ def _search_fused_quant_scan(state: ArenaState, q8a: jax.Array,
                              gate_on: jax.Array, boost_on: jax.Array,
                              super_gate: jax.Array, k: int, slack: int,
                              cap_take: int, max_nbr: int):
-    """Quantized per-chunk compute phase: int8 coarse scan over the shadow
-    (``q8a`` codes + ``scale_a`` per-row scales, ops/quant.py layout) for
-    BOTH retrieval tiers — super gate candidates and main ANN candidates
-    are different masks over the ONE int8 score matrix — then an exact
-    bf16/f32 rescore of the k+slack survivors via a gathered-row dot. The
-    slack absorbs the ~1e-2 int8 ranking error at the k boundary (ISSUE 3
-    satellite: config-driven, shared with the IVF over-fetch) so the exact
-    top-k can't lose a true member the coarse scan ranked at k+3."""
-    from lazzaro_tpu.ops.quant import quantize_rows
-
-    n = state.emb.shape[0]
-    k_fetch = min(k + slack, n)
-    g_fetch = min(1 + slack, n)
+    """Quantized per-chunk compute phase: the int8 coarse-scan + exact
+    rescore core, then the shared gate/CSR/boost tail."""
 
     def chunk(q_c, valid_c, tenant_c, gate_c, boost_c):
-        qn = normalize(q_c)                                   # [C, d] f32
-        qq, qs = quantize_rows(qn)
-        dots = jax.lax.dot_general(
-            qq, q8a, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32)                 # [C, cap+1] i32
-        coarse = (dots.astype(jnp.float32)
-                  * qs[:, None] * scale_a[None, :])
-        alive_t = state.alive[None, :] & (
-            state.tenant_id[None, :] == tenant_c[:, None])
-        sup = state.is_super[None, :]
-        cg_s, cg_r = jax.lax.top_k(
-            jnp.where(alive_t & sup, coarse, NEG_INF), g_fetch)
-        ca_s, ca_r = jax.lax.top_k(
-            jnp.where(alive_t & ~sup, coarse, NEG_INF), k_fetch)
-        # Same consumer-split hazard as _search_fused_scan: the coarse
-        # top-k feeds both the rescore gather and (via it) the readback —
-        # without the barrier XLA can duplicate the full-arena sorts.
-        cg_s, cg_r, ca_s, ca_r = jax.lax.optimization_barrier(
-            (cg_s, cg_r, ca_s, ca_r))
-        qd = qn.astype(state.emb.dtype)
-
-        def rescore(rows_c, coarse_s):
-            g = state.emb[rows_c]                             # [C, kf, d]
-            ex = jnp.einsum("cd,ckd->ck", qd, g,
-                            preferred_element_type=jnp.float32)
-            return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
-
-        ann_ex = rescore(ca_r, ca_s)
-        ann_s, sel = jax.lax.top_k(ann_ex, k)
-        ann_r = jnp.take_along_axis(ca_r, sel, axis=1)
-        # The super gate is threshold-sensitive (0.4): its VERDICT uses the
-        # exact rescored score, so quantization error can only cost a gate
-        # candidate ranked below coarse position 1+slack, never flip the
-        # threshold comparison itself.
-        gate_ex = rescore(cg_r, cg_s)
-        g_s, g_sel = jax.lax.top_k(gate_ex, 1)
-        gate_s = g_s[:, 0]
-        gate_r = jnp.take_along_axis(cg_r, g_sel, axis=1)[:, 0]
+        g_s, g_r, ann_s, ann_r = _quant_two_tier(state, q8a, scale_a, q_c,
+                                                 tenant_c, k, slack)
+        gate_s, gate_r = g_s[:, 0], g_r[:, 0]
         fast, acc_rows, nbr_rows = _gate_and_boost_rows(
             state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
             valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
@@ -1240,6 +1269,90 @@ def _dedup_topk(scores: jax.Array, rows: jax.Array, sentinel: int, k: int
     return top_s, jnp.where(top_s > NEG_INF / 2, top_r, sentinel)
 
 
+def _ivf_two_tier(state: ArenaState, shadow, centroids: jax.Array,
+                  members: jax.Array, extras: jax.Array, q_c: jax.Array,
+                  tenant_c: jax.Array, k: int, nprobe: int, slack: int):
+    """IVF two-tier core: coarse centroid prefilter + member gather
+    (``ops.ivf.gather_rows`` — the same candidate assembly as the classic
+    IVF scan, barrier included), per-query tenant masking over the
+    candidates, candidate scoring (exact bf16/f32, or int8-gathered coarse
+    + exact rescore when ``shadow`` is present), and duplicate-row dedup
+    at the top-k boundary. Both retrieval tiers are masks over the ONE
+    candidate score matrix, same trick as the dense scans.
+
+    Shard-local by construction when given per-shard tables whose member/
+    extras entries are LOCAL row indices (``ops.ivf.shard_serve_tables``):
+    the gathers then only touch the chip's own arena slice. Returns
+    ``(gate_s [C], gate_r [C], ann_s [C,k], ann_r [C,k])`` with rows
+    routed to the sentinel (``state.capacity``) where invalid."""
+    from lazzaro_tpu.ops.ivf import gather_rows
+
+    cap = state.capacity
+    L = nprobe * members.shape[1] + extras.shape[0]
+    k_fetch = min(k + slack, L)
+    g_fetch = min(1 + slack, L)
+    qn = normalize(q_c)                               # [C, d] f32
+    cand, safe = gather_rows(centroids, members, extras, qn, nprobe)
+    valid = ((cand >= 0) & state.alive[safe]
+             & (state.tenant_id[safe] == tenant_c[:, None]))
+    sup = state.is_super[safe]
+    qd = qn.astype(state.emb.dtype)
+
+    def rescore(rows_c, coarse_s):
+        g = state.emb[rows_c]                         # [C, kf, d]
+        ex = jnp.einsum("cd,ckd->ck", qd, g,
+                        preferred_element_type=jnp.float32)
+        return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
+
+    if shadow is None:
+        vecs = state.emb[safe]                        # [C, L, d]
+        sc = jnp.einsum("cd,cld->cl", qd, vecs,
+                        preferred_element_type=jnp.float32)
+        a_s0, a_pos = jax.lax.top_k(
+            jnp.where(valid & ~sup, sc, NEG_INF), k_fetch)
+        g_s0, g_pos = jax.lax.top_k(
+            jnp.where(valid & sup, sc, NEG_INF), 1)
+        # Consumer-split hazard (see _exact_two_tier): the top-k feeds
+        # both the packed readback and the boost gather chain.
+        a_s0, a_pos, g_s0, g_pos = jax.lax.optimization_barrier(
+            (a_s0, a_pos, g_s0, g_pos))
+        ann_ex = a_s0
+        a_rows = jnp.take_along_axis(cand, a_pos, axis=1)
+        gate_s = g_s0[:, 0]
+        gate_r0 = jnp.take_along_axis(cand, g_pos, axis=1)[:, 0]
+    else:
+        from lazzaro_tpu.ops.quant import quantize_rows
+
+        q8a, scale_a = shadow
+        qq, qs = quantize_rows(qn)
+        d8 = jnp.einsum("cd,cld->cl", qq, q8a[safe],
+                        preferred_element_type=jnp.int32)
+        coarse = (d8.astype(jnp.float32)
+                  * qs[:, None] * scale_a[safe])      # [C, L]
+        a_s0, a_pos = jax.lax.top_k(
+            jnp.where(valid & ~sup, coarse, NEG_INF), k_fetch)
+        g_s0, g_pos = jax.lax.top_k(
+            jnp.where(valid & sup, coarse, NEG_INF), g_fetch)
+        a_s0, a_pos, g_s0, g_pos = jax.lax.optimization_barrier(
+            (a_s0, a_pos, g_s0, g_pos))
+        # exact rescore of the few survivors from the master — scores
+        # and the 0.4 gate verdict never see quantization error
+        a_rows0 = jnp.take_along_axis(cand, a_pos, axis=1)
+        a_rows_safe = jnp.where(a_s0 > NEG_INF / 2, a_rows0, cap)
+        ann_ex = rescore(a_rows_safe, a_s0)
+        g_rows0 = jnp.take_along_axis(cand, g_pos, axis=1)
+        g_rows_safe = jnp.where(g_s0 > NEG_INF / 2, g_rows0, cap)
+        gate_ex = rescore(g_rows_safe, g_s0)
+        g_s, g_sel = jax.lax.top_k(gate_ex, 1)
+        gate_s = g_s[:, 0]
+        gate_r0 = jnp.take_along_axis(g_rows_safe, g_sel, axis=1)[:, 0]
+        a_rows = a_rows_safe
+
+    ann_s, ann_r = _dedup_topk(ann_ex, a_rows, cap, k)
+    gate_r = jnp.where(gate_s > NEG_INF / 2, gate_r0, cap)
+    return gate_s, gate_r, ann_s, ann_r
+
+
 def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
                            members: jax.Array, extras: jax.Array,
                            csr_indptr: jax.Array, csr_nbr: jax.Array,
@@ -1248,81 +1361,13 @@ def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
                            boost_on: jax.Array, super_gate: jax.Array,
                            k: int, nprobe: int, slack: int, cap_take: int,
                            max_nbr: int):
-    """IVF per-chunk compute phase: coarse centroid prefilter + member
-    gather (``ops.ivf.gather_rows`` — the same candidate assembly as the
-    classic IVF scan, barrier included), per-query tenant masking over the
-    candidates, candidate scoring (exact bf16/f32, or int8-gathered coarse
-    + exact rescore when ``shadow`` is present), duplicate-row dedup at
-    the top-k boundary, and the shared gate/CSR/boost tail. Both
-    retrieval tiers are masks over the ONE candidate score matrix, same
-    trick as the dense scans."""
-    from lazzaro_tpu.ops.ivf import gather_rows
-
-    cap = state.capacity
-    L = nprobe * members.shape[1] + extras.shape[0]
-    k_fetch = min(k + slack, L)
-    g_fetch = min(1 + slack, L)
+    """IVF per-chunk compute phase: the coarse-prefilter two-tier core,
+    then the shared gate/CSR/boost tail."""
 
     def body(q_c, valid_c, tenant_c, gate_c, boost_c):
-        qn = normalize(q_c)                               # [C, d] f32
-        cand, safe = gather_rows(centroids, members, extras, qn, nprobe)
-        valid = ((cand >= 0) & state.alive[safe]
-                 & (state.tenant_id[safe] == tenant_c[:, None]))
-        sup = state.is_super[safe]
-        qd = qn.astype(state.emb.dtype)
-
-        def rescore(rows_c, coarse_s):
-            g = state.emb[rows_c]                         # [C, kf, d]
-            ex = jnp.einsum("cd,ckd->ck", qd, g,
-                            preferred_element_type=jnp.float32)
-            return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
-
-        if shadow is None:
-            vecs = state.emb[safe]                        # [C, L, d]
-            sc = jnp.einsum("cd,cld->cl", qd, vecs,
-                            preferred_element_type=jnp.float32)
-            a_s0, a_pos = jax.lax.top_k(
-                jnp.where(valid & ~sup, sc, NEG_INF), k_fetch)
-            g_s0, g_pos = jax.lax.top_k(
-                jnp.where(valid & sup, sc, NEG_INF), 1)
-            # Consumer-split hazard (see _search_fused_scan): the top-k
-            # feeds both the packed readback and the boost gather chain.
-            a_s0, a_pos, g_s0, g_pos = jax.lax.optimization_barrier(
-                (a_s0, a_pos, g_s0, g_pos))
-            ann_ex = a_s0
-            a_rows = jnp.take_along_axis(cand, a_pos, axis=1)
-            gate_s = g_s0[:, 0]
-            gate_r0 = jnp.take_along_axis(cand, g_pos, axis=1)[:, 0]
-        else:
-            from lazzaro_tpu.ops.quant import quantize_rows
-
-            q8a, scale_a = shadow
-            qq, qs = quantize_rows(qn)
-            d8 = jnp.einsum("cd,cld->cl", qq, q8a[safe],
-                            preferred_element_type=jnp.int32)
-            coarse = (d8.astype(jnp.float32)
-                      * qs[:, None] * scale_a[safe])      # [C, L]
-            a_s0, a_pos = jax.lax.top_k(
-                jnp.where(valid & ~sup, coarse, NEG_INF), k_fetch)
-            g_s0, g_pos = jax.lax.top_k(
-                jnp.where(valid & sup, coarse, NEG_INF), g_fetch)
-            a_s0, a_pos, g_s0, g_pos = jax.lax.optimization_barrier(
-                (a_s0, a_pos, g_s0, g_pos))
-            # exact rescore of the few survivors from the master — scores
-            # and the 0.4 gate verdict never see quantization error
-            a_rows0 = jnp.take_along_axis(cand, a_pos, axis=1)
-            a_rows_safe = jnp.where(a_s0 > NEG_INF / 2, a_rows0, cap)
-            ann_ex = rescore(a_rows_safe, a_s0)
-            g_rows0 = jnp.take_along_axis(cand, g_pos, axis=1)
-            g_rows_safe = jnp.where(g_s0 > NEG_INF / 2, g_rows0, cap)
-            gate_ex = rescore(g_rows_safe, g_s0)
-            g_s, g_sel = jax.lax.top_k(gate_ex, 1)
-            gate_s = g_s[:, 0]
-            gate_r0 = jnp.take_along_axis(g_rows_safe, g_sel, axis=1)[:, 0]
-            a_rows = a_rows_safe
-
-        ann_s, ann_r = _dedup_topk(ann_ex, a_rows, cap, k)
-        gate_r = jnp.where(gate_s > NEG_INF / 2, gate_r0, cap)
+        gate_s, gate_r, ann_s, ann_r = _ivf_two_tier(
+            state, shadow, centroids, members, extras, q_c, tenant_c, k,
+            nprobe, slack)
         fast, acc_rows, nbr_rows = _gate_and_boost_rows(
             state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
             valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
@@ -1396,6 +1441,228 @@ def search_fused_ivf_read(state: ArenaState, shadow, centroids: jax.Array,
         q_valid, tenant, gate_on, boost_off, super_gate, k, nprobe, slack,
         cap_take, max_nbr)
     return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+# ---------------------------------------------------------------------------
+# Pod-scale fused serving (ISSUE 5): the SAME chat-turn program — two-tier
+# scan, super gate, CSR neighbor gather, boost scatters — composed with the
+# device mesh as ONE distributed shard_map dispatch + ONE packed readback.
+#
+# Geometry: every arena column (and the int8 shadow / per-shard IVF tables /
+# per-shard CSR) is row-sharded over the mesh axis; queries and per-query
+# metadata are replicated. Each chip runs the shard-local two-tier core
+# over its own rows (exact, int8-coarse + exact rescore, or IVF centroid
+# prefilter over LOCAL member tables), produces local top-(k[+slack])
+# candidates, and the ONLY cross-chip traffic is (a) the k-candidate
+# all_gather + global top-k merge (ops.topk.sharded_topk_merge — the
+# make_sharded_topk combine) and (b) a small pmax that replicates the
+# owner-gathered CSR neighbor windows. The gate verdict and the boost ROW
+# LISTS are then replicated computation, and each chip scatters boosts for
+# exactly the rows it owns (non-owned rows route out of range — XLA drops
+# OOB scatter updates), so the whole tail is shard-local writes.
+#
+# Parity with the single-chip kernels is structural: the per-row score
+# computation, mask arithmetic, neighbor dedup, and capped boost adds are
+# the same code (_exact_two_tier / _quant_two_tier / _ivf_two_tier /
+# _boost_scatter); only the partitioning differs.
+# ---------------------------------------------------------------------------
+
+
+class FusedShardedKernels(NamedTuple):
+    """The jit entry points one ``make_fused_sharded`` call builds: the
+    donated serving program, its copy-on-write twin (for callers that
+    cannot prove sole ownership of the state), and the read-only twin for
+    batches with no boosts requested. Tests and bench wrap the factory to
+    count calls — each call is exactly ONE distributed dispatch."""
+
+    serve: Callable
+    serve_copy: Callable
+    read: Callable
+
+
+def _globalize_rows(rows: jax.Array, scores: jax.Array, shard: jax.Array,
+                    local_n: int, n_shards: int) -> jax.Array:
+    """Local candidate rows → global row ids; NEG_INF (masked/garbage)
+    entries route to the GLOBAL sentinel row so they can never collide
+    with a real row after the cross-chip merge."""
+    sent = n_shards * local_n - 1
+    return jnp.where(scores > NEG_INF / 2, rows + shard * local_n, sent)
+
+
+def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
+                       max_nbr: int, mode: str = "exact", slack: int = 0,
+                       nprobe: int = 0) -> FusedShardedKernels:
+    """Build the distributed fused chat-turn serving program for ``mesh``.
+
+    ``mode`` picks the shard-local coarse stage:
+
+    - ``"exact"``     — bf16/f32 whole-shard scan (``_exact_two_tier``)
+    - ``"quant"``     — int8 shadow coarse top-(k+slack) + exact rescore
+                        (``_quant_two_tier``); extra tables ``(q8, scale)``
+                        row-sharded like the master
+    - ``"ivf"``       — centroid prefilter + LOCAL member gather
+                        (``_ivf_two_tier``); tables ``(centroids [C,d]
+                        replicated, members [n,C,M], extras [n,E])`` with
+                        member/extras entries as LOCAL row indices per
+                        shard (``ops.ivf.shard_serve_tables``)
+    - ``"ivf_quant"`` — IVF prefilter + int8-gathered coarse + exact
+                        rescore; tables ``(q8, scale, centroids, members,
+                        extras)``
+
+    Call signatures (tables is the mode's tuple above, ``()`` for exact):
+
+    ``serve(state, tables, csr_indptr [n,L+1], csr_nbr [n,E], q [Q,d],
+    q_valid [Q], tenant [Q], gate_on [Q], boost_on [Q], now, super_gate,
+    acc_boost, nbr_boost) -> (state, packed [Q, 3+2k])`` — donates the
+    state (ONE distributed dispatch, shard-local boost scatters in place);
+    ``serve_copy`` is the non-donating twin; ``read(state, tables,
+    csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, super_gate) ->
+    packed`` skips the mutation entirely.
+
+    The per-shard CSR carries each chip's OWN rows' neighbor lists with
+    GLOBAL neighbor ids; Q is bounded by the scheduler's padded batch
+    (≤ ``QUERY_CHUNK`` — the local cores stream bigger fleets through the
+    usual chunked tiles, IVF at ``IVF_SERVE_CHUNK`` to bound the gather
+    footprint)."""
+    from jax.sharding import PartitionSpec as P
+
+    from lazzaro_tpu.ops.topk import sharded_topk_merge
+    from lazzaro_tpu.utils.compat import shard_map
+
+    if mode not in ("exact", "quant", "ivf", "ivf_quant"):
+        raise ValueError(f"unknown fused-sharded mode {mode!r}")
+    if cap_take > k:
+        raise ValueError("cap_take must not exceed k")
+    n_shards = mesh.shape[axis]
+    chunk = IVF_SERVE_CHUNK if mode.startswith("ivf") else QUERY_CHUNK
+
+    def _scan_merge(arena, tables, q, tenant):
+        """Shard-local two-tier candidates → globalize → ONE all_gather +
+        global top-k per tier. Returns replicated (gate_s [Q], gate_r [Q],
+        ann_s [Q,k], ann_r [Q,k]) with GLOBAL row ids."""
+        shard = jax.lax.axis_index(axis)
+        local_n = arena.emb.shape[0]
+        k_l = max(1, min(k, local_n))
+        if mode == "quant":
+            q8_l, scale_l = tables
+        elif mode == "ivf":
+            cent, mem2, ext2 = tables
+            mem_l, ext_l, shadow_l = mem2[0], ext2[0], None
+        elif mode == "ivf_quant":
+            q8_l, scale_l, cent, mem2, ext2 = tables
+            mem_l, ext_l, shadow_l = mem2[0], ext2[0], (q8_l, scale_l)
+
+        def core(q_c, tenant_c):
+            if mode == "exact":
+                return _exact_two_tier(arena, q_c, tenant_c, 1, k_l)
+            if mode == "quant":
+                return _quant_two_tier(arena, q8_l, scale_l, q_c, tenant_c,
+                                       k_l, slack)
+            g_s, g_r, a_s, a_r = _ivf_two_tier(arena, shadow_l, cent, mem_l,
+                                               ext_l, q_c, tenant_c, k_l,
+                                               nprobe, slack)
+            return g_s[:, None], g_r[:, None], a_s, a_r
+
+        g_s, g_r, a_s, a_r = chunked_map_multi(core, (q, tenant),
+                                               chunk=chunk)
+        ann_s, ann_r = sharded_topk_merge(
+            axis, a_s, _globalize_rows(a_r, a_s, shard, local_n, n_shards),
+            k)
+        g_ms, g_mr = sharded_topk_merge(
+            axis, g_s, _globalize_rows(g_r, g_s, shard, local_n, n_shards),
+            1)
+        # The PR 2 consumer-split fix applies at the merge boundary too:
+        # the merged top-k feeds both the packed readback and (in the
+        # serve twins) the boost gather tail.
+        return jax.lax.optimization_barrier(
+            (g_ms[:, 0], g_mr[:, 0], ann_s, ann_r))
+
+    def _boost_tail(arena, indptr_l, nbr_l, ann_s, ann_r, fast, q_valid,
+                    tenant, boost_on, now, acc_boost, nbr_boost):
+        """The gate/CSR/boost tail against the row-sharded edge arena:
+        owner chips gather their rows' CSR neighbor windows (merged to all
+        chips with one small pmax), the per-query dedup / in-result masks
+        are replicated arithmetic on the merged id lists (exactly
+        ``_csr_neighbor_rows``'s), and each chip scatters boosts ONLY for
+        rows it owns — non-owned rows route out of range and XLA drops
+        the updates, so no boost ever crosses a chip boundary."""
+        shard = jax.lax.axis_index(axis)
+        local_n = arena.emb.shape[0]
+        sent = n_shards * local_n - 1          # == the global sentinel row
+        do_boost = boost_on & q_valid & ~fast
+        hit = ann_s[:, :cap_take] > NEG_INF / 2
+        acc_rows = jnp.where(hit & do_boost[:, None],
+                             ann_r[:, :cap_take], sent)     # global rows
+        base = shard * local_n
+        loc = acc_rows - base
+        mine = (loc >= 0) & (loc < local_n) & (acc_rows != sent)
+        safe_loc = jnp.clip(loc, 0, local_n - 1)
+        start = jnp.where(mine, indptr_l[safe_loc], 0)
+        end = jnp.where(mine, indptr_l[safe_loc + 1], 0)
+        idx = start[:, :, None] + jnp.arange(max_nbr)[None, None, :]
+        ok = idx < end[:, :, None]
+        nbrw = jnp.where(ok, nbr_l[jnp.minimum(idx, nbr_l.shape[0] - 1)],
+                         -1)
+        # exactly one chip owns each accessed row; everyone else holds -1,
+        # so a pmax replicates the true windows — the only tail collective
+        nbrw = jax.lax.pmax(nbrw, axis)
+        flat = nbrw.reshape(nbrw.shape[0], -1)              # [Q, M]
+        m = flat.shape[1]
+        dup = ((flat[:, :, None] == flat[:, None, :])
+               & jnp.tri(m, k=-1, dtype=bool)[None, :, :]).any(-1)
+        in_res = (flat[:, :, None] == acc_rows[:, None, :]).any(-1)
+        nloc = flat - base
+        nmine = (nloc >= 0) & (nloc < local_n) & (flat >= 0)
+        nsafe = jnp.clip(nloc, 0, local_n - 1)
+        nvalid = (nmine & arena.alive[nsafe]
+                  & (arena.tenant_id[nsafe] == tenant[:, None]))
+        nbr_idx = jnp.where(nvalid & ~dup & ~in_res, nloc, local_n)
+        acc_idx = jnp.where(mine, loc, local_n)
+        return _boost_scatter(arena, acc_idx, nbr_idx, now, acc_boost,
+                              nbr_boost, zero_last=False)
+
+    def _serve_local(arena, tables, indptr2, nbr2, q, q_valid, tenant,
+                     gate_on, boost_on, now, super_gate, acc_boost,
+                     nbr_boost):
+        gate_s, gate_r, ann_s, ann_r = _scan_merge(arena, tables, q, tenant)
+        fast = gate_on & (gate_s > super_gate)
+        packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+        arena = _boost_tail(arena, indptr2[0], nbr2[0], ann_s, ann_r, fast,
+                            q_valid, tenant, boost_on, now, acc_boost,
+                            nbr_boost)
+        return arena, packed
+
+    def _read_local(arena, tables, indptr2, nbr2, q, q_valid, tenant,
+                    gate_on, super_gate):
+        gate_s, gate_r, ann_s, ann_r = _scan_merge(arena, tables, q, tenant)
+        fast = gate_on & (gate_s > super_gate)
+        return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+    state_specs = ArenaState(
+        emb=P(axis, None), salience=P(axis), timestamp=P(axis),
+        last_accessed=P(axis), access_count=P(axis), type_id=P(axis),
+        shard_id=P(axis), tenant_id=P(axis), alive=P(axis),
+        is_super=P(axis))
+    tables_specs = {
+        "exact": (),
+        "quant": (P(axis, None), P(axis)),
+        "ivf": (P(None, None), P(axis, None, None), P(axis, None)),
+        "ivf_quant": (P(axis, None), P(axis), P(None, None),
+                      P(axis, None, None), P(axis, None)),
+    }[mode]
+    common = (state_specs, tables_specs, P(axis, None), P(axis, None),
+              P(None, None), P(None), P(None), P(None))
+    mapped_serve = shard_map(
+        _serve_local, mesh=mesh,
+        in_specs=common + (P(None), P(), P(), P(), P()),
+        out_specs=(state_specs, P(None, None)), check_vma=False)
+    mapped_read = shard_map(
+        _read_local, mesh=mesh, in_specs=common + (P(),),
+        out_specs=P(None, None), check_vma=False)
+    return FusedShardedKernels(
+        serve=jax.jit(mapped_serve, donate_argnums=(0,)),
+        serve_copy=jax.jit(mapped_serve),
+        read=jax.jit(mapped_read))
 
 
 def _arena_apply_boosts(state: ArenaState, rows: jax.Array,

@@ -217,6 +217,36 @@ def pack_extras(residual: np.ndarray, fresh_rows, super_rows) -> np.ndarray:
     return padded
 
 
+def shard_serve_tables(members: np.ndarray, extras: np.ndarray,
+                       n_shards: int, part_rows: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Split the GLOBAL member/extras tables into per-shard LOCAL-row
+    tables for the distributed fused IVF kernel
+    (``core.state.make_fused_sharded`` mode="ivf"): shard ``p`` keeps only
+    the rows it owns (global rows ``[p·part_rows, (p+1)·part_rows)``),
+    re-indexed to local offsets and left-packed per cluster, -1 padded.
+    The union over shards is exactly the global candidate set, so the
+    distributed scan visits the same rows as the single-chip kernel —
+    each from the chip whose HBM holds it. Every per-(shard, cluster)
+    member list fits the global member cap, so the stacked table keeps
+    the global [C, M] geometry and the local gather never widens."""
+    members = np.asarray(members, np.int64)
+    extras = np.asarray(extras, np.int64)
+    C, M = members.shape
+    out_m = np.full((n_shards, C, M), -1, np.int32)
+    out_e = np.full((n_shards, max(8, extras.shape[0])), -1, np.int32)
+    for p in range(n_shards):
+        lo, hi = p * part_rows, (p + 1) * part_rows
+        msk = (members >= lo) & (members < hi)
+        # left-pack per cluster: stable-sort selected-first
+        order = np.argsort(~msk, axis=1, kind="stable")
+        out_m[p] = np.take_along_axis(
+            np.where(msk, members - lo, -1), order, axis=1).astype(np.int32)
+        sel = extras[(extras >= lo) & (extras < hi)] - lo
+        out_e[p, :len(sel)] = sel.astype(np.int32)
+    return out_m, out_e
+
+
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "q_chunk"))
 def ivf_search(centroids: jax.Array, members: jax.Array, residual: jax.Array,
                emb: jax.Array, mask: jax.Array, queries: jax.Array,

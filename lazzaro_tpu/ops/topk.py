@@ -36,6 +36,31 @@ def masked_topk(emb: jax.Array, mask: jax.Array, query: jax.Array, k: int
     return top_s, top_i
 
 
+def sharded_topk_merge(axis: str, top_s: jax.Array, top_i: jax.Array,
+                       k: int) -> Tuple[jax.Array, jax.Array]:
+    """The ONE cross-chip combine every sharded retrieval kernel shares:
+    all_gather the per-chip candidate lists ``(top_s, top_i) [Q, k_local]``
+    over the mesh ``axis`` and take a global top-``k`` of the
+    ``n_shards · k_local`` candidates. Must be called INSIDE shard_map
+    (or pmap) with ``axis`` bound. Candidate ids must already be
+    globalized by the caller (local row + shard offset).
+
+    Tie order matches the single-chip ``lax.top_k``: candidates concatenate
+    shard-major and score-descending within a shard, so equal scores
+    resolve in global-row order as long as each survived its local top-k.
+    Used by ``make_sharded_topk`` / ``make_sharded_int8_topk`` /
+    ``make_sharded_multitenant_topk`` below and by the fused sharded
+    serving programs (``core.state.make_fused_sharded``)."""
+    all_s = jax.lax.all_gather(top_s, axis)                 # [n, Q, k_l]
+    all_i = jax.lax.all_gather(top_i, axis)
+    q = top_s.shape[0]
+    all_s = jnp.moveaxis(all_s, 0, 1).reshape(q, -1)        # [Q, n*k_l]
+    all_i = jnp.moveaxis(all_i, 0, 1).reshape(q, -1)
+    fin_s, fin_pos = jax.lax.top_k(all_s, k)
+    fin_i = jnp.take_along_axis(all_i, fin_pos, axis=1)
+    return fin_s, fin_i
+
+
 def make_sharded_topk(mesh: Mesh, axis: str = "data", k: int = 10,
                       impl: str = "auto"):
     """Build a pjit-compiled distributed top-k over ``mesh``.
@@ -87,14 +112,7 @@ def make_sharded_topk(mesh: Mesh, axis: str = "data", k: int = 10,
         local_n = emb_l.shape[0]
         top_s, top_i = local_candidates(emb_l, mask_l, query)   # [Q, k]
         top_i = top_i + shard_idx * local_n                     # globalize rows
-        # Gather candidates from every chip: [n_shards, Q, k]
-        all_s = jax.lax.all_gather(top_s, axis)
-        all_i = jax.lax.all_gather(top_i, axis)
-        all_s = jnp.moveaxis(all_s, 0, 1).reshape(top_s.shape[0], -1)  # [Q, n*k]
-        all_i = jnp.moveaxis(all_i, 0, 1).reshape(top_s.shape[0], -1)
-        fin_s, fin_pos = jax.lax.top_k(all_s, k)
-        fin_i = jnp.take_along_axis(all_i, fin_pos, axis=1)
-        return fin_s, fin_i
+        return sharded_topk_merge(axis, top_s, top_i, k)
 
     mapped = shard_map(
         local_search,
@@ -137,13 +155,7 @@ def make_sharded_int8_topk(mesh: Mesh, axis: str = "data", k: int = 10):
         scores = jnp.where(mask_l[None, :], scores, NEG_INF)
         top_s, top_i = jax.lax.top_k(scores, k_eff)
         top_i = top_i + shard_idx * local_n                 # globalize rows
-        all_s = jax.lax.all_gather(top_s, axis)
-        all_i = jax.lax.all_gather(top_i, axis)
-        all_s = jnp.moveaxis(all_s, 0, 1).reshape(top_s.shape[0], -1)
-        all_i = jnp.moveaxis(all_i, 0, 1).reshape(top_s.shape[0], -1)
-        fin_s, fin_pos = jax.lax.top_k(all_s, k)
-        fin_i = jnp.take_along_axis(all_i, fin_pos, axis=1)
-        return fin_s, fin_i
+        return sharded_topk_merge(axis, top_s, top_i, k)
 
     mapped = shard_map(
         local_search,
@@ -186,13 +198,7 @@ def make_sharded_multitenant_topk(mesh: Mesh, axis: str = "data",
         scores = jnp.where(mask, scores, NEG_INF)
         top_s, top_i = jax.lax.top_k(scores, k_eff)
         top_i = top_i + shard_idx * local_n                 # globalize rows
-        all_s = jax.lax.all_gather(top_s, axis)
-        all_i = jax.lax.all_gather(top_i, axis)
-        all_s = jnp.moveaxis(all_s, 0, 1).reshape(top_s.shape[0], -1)
-        all_i = jnp.moveaxis(all_i, 0, 1).reshape(top_s.shape[0], -1)
-        fin_s, fin_pos = jax.lax.top_k(all_s, k)
-        fin_i = jnp.take_along_axis(all_i, fin_pos, axis=1)
-        return fin_s, fin_i
+        return sharded_topk_merge(axis, top_s, top_i, k)
 
     mapped = shard_map(
         local_search,

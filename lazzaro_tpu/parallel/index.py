@@ -1,10 +1,30 @@
 """ShardedMemoryIndex: the memory index spread across a device mesh.
 
 This is the pod-scale variant of ``core.index.MemoryIndex`` (SURVEY §2.3's
-"index model-parallelism" + "tenant partitioning = mesh sharding"): the
-embedding matrix, masks, and numeric columns are row-sharded over the mesh
-'data' axis (HBM-resident on every chip), queries are replicated, and search
-is local-top-k → all_gather → global-top-k over ICI.
+"index model-parallelism" + "tenant partitioning = mesh sharding"): every
+arena column — embeddings, salience, access counters, tenant and super-node
+flags — is row-sharded over the mesh 'data' axis (HBM-resident on every
+chip), queries are replicated, and serving is shard-local scan →
+``all_gather`` merge → shard-local boost scatters.
+
+Serving (ISSUE 5): ``serve_requests`` runs the FULL chat-turn retrieval
+program — masked super-node top-1 gate, main ANN top-k, CSR neighbor
+gather over a row-sharded edge arena, and the neighbor- + access-salience
+boost scatters — as ONE distributed ``shard_map`` dispatch + ONE packed
+readback per coalesced mega-batch (``core.state.make_fused_sharded``; the
+pre-ISSUE-5 pod path served a plain multitenant top-k that silently
+DROPPED the gate, the neighbor gather, and every boost). Per-request
+tenants ride into the kernel as a replicated column, so one mixed-tenant
+batch dispatches once with mask-enforced isolation; boosts land as
+shard-local scatters (each chip writes only the rows it owns — no boost
+ever crosses a chip boundary), and the kernel batch is keyed on the batch
+max-k (pow2-bucketed), so a request's ``k`` is never silently truncated
+to a construction-time constant. With ``int8_serving`` the shard-local
+scan streams the per-chip int8 shadow (coarse top-(k+slack) + exact
+rescore — on real TPU that also rides the MXU int8 path), and with a
+build published by ``ivf_build`` it becomes the centroid prefilter over
+per-shard LOCAL member tables. ``serve_fused=False`` keeps the classic
+single-purpose multitenant top-k for A/B and fallback.
 
 Tenant partitioning (the EP analog): with ``tenant_affinity`` on, every
 tenant's rows are allocated inside one mesh partition (hash(tenant) % n),
@@ -16,6 +36,8 @@ Multi-host works unchanged: build the mesh after ``jax.distributed.initialize``.
 
 from __future__ import annotations
 
+import sys
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -24,51 +46,143 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from lazzaro_tpu.core import state as S
+from lazzaro_tpu.core.index import build_host_csr, split_csr
 from lazzaro_tpu.ops.topk import make_sharded_topk
+from lazzaro_tpu.parallel.mesh import shard_stacked
+from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
+                                        next_pow2, pad_to_pow2,
+                                        unpack_retrieval)
 
 NEG_INF = -1e30
 
 
+@jax.jit
+def _shadow_update(q8, scale, rows, emb_stored):
+    """Incremental int8-shadow maintenance for freshly written rows —
+    O(batch), mirroring the fused-ingest ``_shadow_scatter``."""
+    from lazzaro_tpu.ops.quant import quantize_rows
+
+    q_new, s_new = quantize_rows(emb_stored)
+    return q8.at[rows].set(q_new), scale.at[rows].set(s_new)
+
+
 class ShardedMemoryIndex:
+    # References to the arena pytree at the donation gate when this index
+    # is the sole owner: the ``_arena`` attribute, the ``cur`` local, and
+    # ``sys.getrefcount``'s own argument (same contract as MemoryIndex).
+    _SOLE_REFS = 3
+
     def __init__(self, mesh: Mesh, dim: int, capacity: int = 1 << 20,
                  axis: str = "data", dtype=jnp.bfloat16,
-                 tenant_affinity: bool = True, k: int = 10):
+                 tenant_affinity: bool = True, k: int = 10,
+                 serve_fused: bool = True, int8_serving: bool = False,
+                 coarse_slack: int = 8, cap_take: int = 5,
+                 max_nbr: int = 32, super_gate: float = 0.4,
+                 acc_boost: float = 0.05, nbr_boost: float = 0.02,
+                 epoch: Optional[float] = None):
         self.mesh = mesh
         self.axis = axis
         self.dim = dim
         self.n_parts = mesh.shape[axis]
-        assert capacity % self.n_parts == 0, "capacity must divide the mesh axis"
-        self.capacity = capacity
-        self.part_rows = capacity // self.n_parts
+        # Row geometry: the arena carries capacity+1 rows (last = the
+        # sentinel scratch row, core.state contract) and the TOTAL must
+        # divide the mesh axis — capacity is rounded UP, never rejected.
+        total = capacity + 1
+        total = -(-total // self.n_parts) * self.n_parts
+        self.capacity = total - 1
+        self.part_rows = total // self.n_parts
         self.tenant_affinity = tenant_affinity
+        self.dtype = dtype
+        self.epoch = float(epoch if epoch is not None else time.time())
+
+        self.serve_fused = bool(serve_fused)
+        self.int8_serving = bool(int8_serving)
+        self.coarse_slack = max(0, int(coarse_slack))
+        self.cap_take = int(cap_take)
+        self.max_nbr = int(max_nbr)
+        self.super_gate = float(super_gate)
+        self.acc_boost = float(acc_boost)
+        self.nbr_boost = float(nbr_boost)
 
         self._row_sh = NamedSharding(mesh, P(axis))
         self._mat_sh = NamedSharding(mesh, P(axis, None))
         self._rep = NamedSharding(mesh, P())
+        self._stacked = shard_stacked(mesh, axis)
 
-        self.emb = jax.device_put(jnp.zeros((capacity, dim), dtype), self._mat_sh)
-        self.alive = jax.device_put(jnp.zeros((capacity,), bool), self._row_sh)
-        self.tenant = jax.device_put(jnp.full((capacity,), -1, jnp.int32), self._row_sh)
-        self.salience = jax.device_put(jnp.zeros((capacity,), jnp.float32), self._row_sh)
+        self._state_lock = threading.RLock()
+        self._arena = self._reshard(S.init_arena(self.capacity, dim, dtype))
 
-        # host bookkeeping: per-partition free lists, global id maps
+        # host bookkeeping: per-partition free lists (the global sentinel
+        # row — the last row of the last partition — is never allocated),
+        # global id maps, host edge map for the CSR shadow, super rows.
         self._free: List[List[int]] = [
-            list(range((p + 1) * self.part_rows - 1, p * self.part_rows - 1, -1))
+            [r for r in range((p + 1) * self.part_rows - 1,
+                              p * self.part_rows - 1, -1)
+             if r != self.capacity]
             for p in range(self.n_parts)]
         self.id_to_row: Dict[str, int] = {}
         self.row_to_id: Dict[int, str] = {}
         self._tenants: Dict[str, int] = {}
+        self.edges: Dict[Tuple[str, str], float] = {}
+        self._csr_cache = None             # (indptr_dev, nbr_dev)
+        self._csr_dirty = True
+        self._super_rows: set = set()
+
+        # int8 serving shadow (row-sharded like the master; rebuilt lazily,
+        # maintained incrementally by add()'s scatter once built)
+        self._int8_shadow = None
+        self._int8_dirty = True
+
+        # IVF serve tables (publish via ivf_build): centroids replicated,
+        # member/extras tables split per shard with LOCAL row indices
+        self._ivf = None          # (centroids_dev, members_np, residual_np,
+        #                            nprobe)
+        self._ivf_routed = None   # np bool [rows]
+        self._ivf_fresh: List[int] = []
+        self._ivf_tabs_cache = None
 
         self._k = k
         self._search = make_sharded_topk(mesh, axis, k=k)
-        # Per-row tenant serving kernel (ROADMAP ceiling #4), built lazily
-        # on the first coalesced serve: pod-scale mixed-tenant batches
-        # dispatch ONCE total instead of once per tenant group.
-        self._serve_search = None
-        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1, 2, 3))
-        self._decay = jax.jit(self._decay_impl, donate_argnums=(0,))
+        # Classic pod serving kernels (serve_fused=False A/B + fallback),
+        # keyed by the batch max-k pow2 bucket so a request's k above the
+        # construction-time default retraces instead of truncating.
+        self._serve_search_cache: Dict[int, object] = {}
+        # Fused distributed serving programs, keyed (mode, k_bucket).
+        self._fused_cache: Dict[Tuple[str, int], S.FusedShardedKernels] = {}
 
     # ------------------------------------------------------------------ util
+    def _reshard(self, pytree):
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                a, self._mat_sh if a.ndim == 2 else self._row_sh), pytree)
+
+    @property
+    def state(self) -> S.ArenaState:
+        with self._state_lock:
+            return self._arena
+
+    @state.setter
+    def state(self, s: S.ArenaState) -> None:
+        self._arena = self._reshard(s)
+
+    # Legacy column views (checkpointing, tests, bench poke these).
+    @property
+    def emb(self):
+        return self.state.emb
+
+    @property
+    def alive(self):
+        return self.state.alive
+
+    @property
+    def tenant(self):
+        return self.state.tenant_id
+
+    @property
+    def salience(self):
+        return self.state.salience
+
     def tenant_id(self, name: str) -> int:
         if name not in self._tenants:
             self._tenants[name] = len(self._tenants)
@@ -76,7 +190,8 @@ class ShardedMemoryIndex:
 
     def _partition_for(self, tenant: str) -> int:
         if not self.tenant_affinity:
-            return int(np.random.default_rng(abs(hash(tenant)) % 2**32).integers(self.n_parts))
+            return int(np.random.default_rng(
+                abs(hash(tenant)) % 2**32).integers(self.n_parts))
         return abs(hash(tenant)) % self.n_parts
 
     def _alloc(self, tenant: str, n: int) -> List[int]:
@@ -94,30 +209,37 @@ class ShardedMemoryIndex:
             raise RuntimeError("ShardedMemoryIndex capacity exhausted")
         return rows
 
-    @staticmethod
-    def _update_impl(emb, alive, tenant, salience, rows, new_emb, new_tenant,
-                     new_salience, live):
-        emb = emb.at[rows].set(new_emb)
-        alive = alive.at[rows].set(live)
-        tenant = tenant.at[rows].set(new_tenant)
-        salience = salience.at[rows].set(new_salience)
-        return emb, alive, tenant, salience
+    def _apply_arena(self, donated, copying, *args, **kwargs) -> None:
+        """The zero-copy mutation gate (PR 1 contract): donate when this
+        index provably holds the sole reference to the arena pytree,
+        otherwise run the copying twin so a concurrent reader's snapshot
+        is never invalidated."""
+        with self._state_lock:
+            cur = self._arena
+            fn = donated if sys.getrefcount(cur) <= self._SOLE_REFS else copying
+            out = fn(cur, *args, **kwargs)
+            del cur
+            self.state = out
 
-    @staticmethod
-    def _decay_impl(salience, alive, tenant, tid, rate, floor):
-        mask = alive & (tenant == tid)
-        return jnp.where(mask, floor + (salience - floor) * (1.0 - rate), salience)
+    # The device-program entry point every serve goes through — tests and
+    # bench wrap it to count dispatches (one call == one dispatch).
+    def _dispatch(self, fn, *args, **kwargs):
+        return fn(*args, **kwargs)
 
     # ------------------------------------------------------------------- api
     def add(self, ids: Sequence[str], embeddings: np.ndarray, tenant: str,
-            saliences: Optional[Sequence[float]] = None) -> List[int]:
+            saliences: Optional[Sequence[float]] = None,
+            supers: Optional[Sequence[bool]] = None) -> List[int]:
         n = len(ids)
         if n == 0:
             return []
         if saliences is None:
             saliences = [0.5] * n
+        if supers is None:
+            supers = [False] * n
         rows = []
-        fresh = self._alloc(tenant, sum(1 for i in ids if i not in self.id_to_row))
+        fresh = self._alloc(tenant,
+                            sum(1 for i in ids if i not in self.id_to_row))
         fi = 0
         for node_id in ids:
             if node_id in self.id_to_row:
@@ -129,32 +251,109 @@ class ShardedMemoryIndex:
                 rows.append(r)
 
         emb = np.asarray(embeddings, np.float32).reshape(n, self.dim)
-        emb = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-9)
         tid = self.tenant_id(tenant)
-        self.emb, self.alive, self.tenant, self.salience = self._update(
-            self.emb, self.alive, self.tenant, self.salience,
-            jnp.asarray(np.asarray(rows, np.int32)),
-            jnp.asarray(emb.astype(np.float32)).astype(self.emb.dtype),
-            jnp.full((n,), tid, jnp.int32),
-            jnp.asarray(np.asarray(saliences, np.float32)),
-            jnp.ones((n,), bool))
+        rows_np = np.asarray(rows, np.int32)
+        padded = S.pad_rows(rows_np, self.capacity)
+        b = len(padded)
+
+        def pad(vals, fill=0.0, dt=np.float32):
+            out = np.full((b,), fill, dt)
+            out[:n] = vals
+            return out
+
+        emb_p = np.zeros((b, self.dim), np.float32)
+        emb_p[:n] = emb
+        emb_dev = jnp.asarray(emb_p)
+        self._apply_arena(
+            S.arena_add, S.arena_add_copy,
+            jnp.asarray(padded), emb_dev,
+            jnp.asarray(pad(np.asarray(saliences, np.float32))),
+            jnp.full((b,), time.time() - self.epoch, jnp.float32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.asarray(pad(tid, -1, np.int32)),
+            jnp.asarray(pad(np.asarray(supers, bool), False, bool)))
+        for r, is_sup in zip(rows, supers):
+            (self._super_rows.add if is_sup
+             else self._super_rows.discard)(r)
+        # int8 shadow: incremental scatter when a maintained shadow exists
+        # (O(batch)); otherwise it rebuilds lazily at the next serve.
+        shadow = self._int8_shadow
+        if (self.int8_serving and shadow is not None and not self._int8_dirty
+                and shadow[0].shape[0] == self.capacity + 1):
+            stored = S.normalize(emb_dev).astype(self.dtype)
+            q8, scale = _shadow_update(shadow[0], shadow[1],
+                                       jnp.asarray(padded), stored)
+            self._int8_shadow = (jax.device_put(q8, self._mat_sh),
+                                 jax.device_put(scale, self._row_sh))
+        else:
+            self._int8_dirty = True
+        # IVF freshness: unrouted rows serve exactly from the extras until
+        # the next ivf_build folds them into clusters.
+        if self._ivf is not None:
+            routed = self._ivf_routed
+            for r in rows:
+                if not routed[r] and r not in self._ivf_fresh:
+                    self._ivf_fresh.append(r)
+            self._ivf_tabs_cache = None
         return rows
 
     def delete(self, ids: Sequence[str]) -> None:
         rows = [self.id_to_row.pop(i) for i in ids if i in self.id_to_row]
         if not rows:
             return
-        n = len(rows)
+        gone = set(ids)
+        dead_edges = [key for key in self.edges
+                      if key[0] in gone or key[1] in gone]
+        for key in dead_edges:
+            del self.edges[key]
+        if dead_edges:
+            self._csr_dirty = True
         for r in rows:
             self.row_to_id.pop(r, None)
+            self._super_rows.discard(r)
             self._free[r // self.part_rows].append(r)
-        self.emb, self.alive, self.tenant, self.salience = self._update(
-            self.emb, self.alive, self.tenant, self.salience,
-            jnp.asarray(np.asarray(rows, np.int32)),
-            jnp.zeros((n, self.dim), self.emb.dtype),
-            jnp.full((n,), -1, jnp.int32),
-            jnp.zeros((n,), jnp.float32),
-            jnp.zeros((n,), bool))
+            if self._ivf is not None:
+                # un-route freed slots so a re-used row joins the fresh
+                # extras (exact) instead of inheriting a stale cluster
+                self._ivf_routed[r] = False
+                if r in self._ivf_fresh:
+                    self._ivf_fresh.remove(r)
+        if self._ivf is not None:
+            self._ivf_tabs_cache = None
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.capacity)
+        self._apply_arena(S.arena_delete, S.arena_delete_copy,
+                          jnp.asarray(padded))
+
+    def add_edges(self, triples: Sequence[Tuple[str, str, float]],
+                  tenant: Optional[str] = None) -> None:
+        """Register association edges (host bookkeeping + CSR shadow; the
+        device side is the per-shard CSR the fused serving program
+        gathers). ``tenant`` is accepted for MemoryIndex API parity —
+        edge visibility is governed by the endpoint rows' tenant column."""
+        changed = False
+        for src, tgt, w in triples:
+            if src in self.id_to_row and tgt in self.id_to_row:
+                self.edges[(src, tgt)] = float(w)
+                changed = True
+        if changed:
+            self._csr_dirty = True
+
+    def set_super(self, ids: Sequence[str], flag: bool = True) -> None:
+        """Mark rows as super nodes (the gate tier of the fused program)."""
+        rows = [self.id_to_row[i] for i in ids if i in self.id_to_row]
+        if not rows:
+            return
+        for r in rows:
+            (self._super_rows.add if flag else self._super_rows.discard)(r)
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.capacity)
+        b = len(padded)
+        flags = np.zeros((b,), bool)
+        flags[:len(rows)] = flag
+        self._apply_arena(S.arena_set_parentage, S.arena_set_parentage_copy,
+                          jnp.asarray(padded), jnp.asarray(flags))
+        if self._ivf is not None:
+            self._ivf_tabs_cache = None       # extras carry every super row
 
     def search(self, query: np.ndarray, tenant: str
                ) -> Tuple[List[str], List[float]]:
@@ -170,9 +369,6 @@ class ShardedMemoryIndex:
         Q is bucketed to a power of two: each distinct query-batch shape
         would otherwise retrace the pod-wide shard_map kernel (multi-second
         compiles are most expensive exactly here)."""
-        from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
-                                                pad_to_pow2)
-
         queries = np.asarray(queries, np.float32)
         if queries.ndim == 1:
             queries = queries[None, :]
@@ -182,52 +378,239 @@ class ShardedMemoryIndex:
             return empty_results(nq)
         norms = np.maximum(np.linalg.norm(queries, axis=1, keepdims=True), 1e-9)
         q = pad_to_pow2(queries / norms)
-        mask = self.alive & (self.tenant == tid)
-        scores, rows = self._search(self.emb, mask, jnp.asarray(q))
+        st = self.state
+        mask = st.alive & (st.tenant_id == tid)
+        scores, rows = self._dispatch(self._search, st.emb, mask,
+                                      jnp.asarray(q))
         return decode_topk(np.asarray(scores)[:nq], np.asarray(rows)[:nq],
                            self.row_to_id, NEG_INF)
+
+    # --------------------------------------------------- fused pod serving
+    def _csr_sharded(self):
+        """Per-shard CSR slices of the host edge map (each chip's own
+        rows' neighbor lists, global neighbor ids), re-uploaded only after
+        an edge-topology change."""
+        if self._csr_cache is not None and not self._csr_dirty:
+            return self._csr_cache
+        self._csr_dirty = False
+        indptr, nbr = build_host_csr(list(self.edges.keys()),
+                                     self.id_to_row, self.capacity + 1)
+        ish, nsh = split_csr(indptr, nbr, self.n_parts)
+        self._csr_cache = (jax.device_put(ish, self._stacked),
+                           jax.device_put(nsh, self._stacked))
+        return self._csr_cache
+
+    def _int8_shadow_for(self):
+        """(Re)build the row-sharded int8 shadow from the current master;
+        after the first build, ``add()`` maintains it incrementally."""
+        with self._state_lock:
+            shadow = self._int8_shadow
+            if (not self._int8_dirty and shadow is not None
+                    and shadow[0].shape[0] == self.capacity + 1):
+                return shadow
+            from lazzaro_tpu.ops.quant import quantize_rows
+
+            q8, scale = quantize_rows(self._arena.emb)
+            shadow = (jax.device_put(q8, self._mat_sh),
+                      jax.device_put(scale, self._row_sh))
+            self._int8_shadow = shadow
+            self._int8_dirty = False
+            return shadow
+
+    def ivf_build(self, n_clusters: Optional[int] = None, nprobe: int = 8,
+                  iters: int = 8) -> bool:
+        """Offline coarse build for the pod path: k-means over the (host-
+        gathered) master, then the member/extras tables are split into
+        per-shard LOCAL-row tables (``ops.ivf.shard_serve_tables``) so the
+        distributed fused kernel's gathers never leave a chip. Returns
+        False when the arena is too small to benefit."""
+        from lazzaro_tpu.ops.ivf import build_ivf
+
+        st = self.state
+        mask = np.asarray(st.alive)
+        if int(mask.sum()) < 2 * max(4, nprobe):
+            return False
+        ivf = build_ivf(st.emb, mask, n_clusters=n_clusters, iters=iters)
+        members = np.asarray(ivf.members)
+        residual = np.asarray(ivf.residual)
+        routed = np.zeros((self.capacity + 1,), bool)
+        m = members.ravel()
+        routed[m[(m >= 0) & (m <= self.capacity)]] = True
+        r = residual[(residual >= 0) & (residual <= self.capacity)]
+        routed[r] = True
+        with self._state_lock:
+            self._ivf = (jax.device_put(ivf.centroids, self._rep), members,
+                         residual, min(int(nprobe), ivf.n_clusters))
+            self._ivf_routed = routed
+            self._ivf_fresh = []
+            self._ivf_tabs_cache = None
+        return True
+
+    def _ivf_tables(self, k_bucket: int):
+        """(centroids, members_sh, extras_sh, nprobe) device tables for the
+        fused IVF program, or None to serve dense (no build, or too few
+        candidates per shard to fill k)."""
+        if self._ivf is None:
+            return None
+        cache = self._ivf_tabs_cache
+        if cache is not None and cache[0] >= k_bucket:
+            return cache[1]
+        from lazzaro_tpu.ops.ivf import pack_extras, shard_serve_tables
+
+        cent, members, residual, nprobe = self._ivf
+        extras = pack_extras(residual, self._ivf_fresh,
+                             sorted(self._super_rows))
+        n_cand = nprobe * members.shape[1] + extras.shape[0]
+        if n_cand < k_bucket + self.coarse_slack:
+            return None
+        mem_sh, ext_sh = shard_serve_tables(members, extras, self.n_parts,
+                                            self.part_rows)
+        tabs = (cent, jax.device_put(mem_sh, self._stacked),
+                jax.device_put(ext_sh, self._stacked), nprobe)
+        self._ivf_tabs_cache = (k_bucket, tabs)
+        return tabs
+
+    def _fused_kernels(self, mode: str, k_bucket: int,
+                       nprobe: int) -> S.FusedShardedKernels:
+        key = (mode, k_bucket, nprobe)
+        kern = self._fused_cache.get(key)
+        if kern is None:
+            kern = S.make_fused_sharded(
+                self.mesh, self.axis, k=k_bucket,
+                cap_take=min(self.cap_take, k_bucket), max_nbr=self.max_nbr,
+                mode=mode, slack=self.coarse_slack, nprobe=nprobe)
+            self._fused_cache[key] = kern
+        return kern
 
     def serve_requests(self, reqs) -> List:
         """``serve.QueryScheduler`` executor for the pod-sharded path: one
         coalesced batch of :class:`serve.RetrievalRequest`s becomes ONE
-        distributed top-k for the whole mixed-tenant batch — each query
-        carries its tenant id into the kernel as a replicated column and
-        isolation is the per-row ``tenant_col == query_tenant`` mask
-        (ROADMAP ceiling #4; previously the batch dispatched once per
-        tenant group). No edge arena lives here, so boost/gate requests
-        serve as plain reads: ``fast`` and ``boosted`` stay False and the
-        orchestrator's classic host path pays any boosts."""
-        from lazzaro_tpu.ops.topk import make_sharded_multitenant_topk
+        distributed dispatch + ONE packed readback running the FULL
+        chat-turn program — super gate, ANN top-k, CSR neighbor gather,
+        shard-local boost scatters — for the whole mixed-tenant batch
+        (per-query tenant column; queries with an unknown tenant match
+        nothing). The kernel is keyed on the batch max-k (pow2-bucketed),
+        so ``k`` above the construction-time default retraces once per
+        bucket instead of silently truncating. ``serve_fused=False`` keeps
+        the classic gate-less multitenant top-k (A/B + fallback)."""
         from lazzaro_tpu.serve.scheduler import RetrievalResult
-        from lazzaro_tpu.utils.batching import decode_topk, pad_to_pow2
 
         results = [RetrievalResult() for _ in reqs]
         nq = len(reqs)
-        if nq == 0:
+        if nq == 0 or not self.id_to_row:
             return results
-        q = np.zeros((nq, self.dim), np.float32)
+        dim = self.dim
+        q = np.zeros((nq, dim), np.float32)
+        valid = np.zeros((nq,), bool)
         tids = np.full((nq,), -1, np.int32)
+        gate_on = np.zeros((nq,), bool)
+        boost_on = np.zeros((nq,), bool)
         for i, r in enumerate(reqs):
             v = np.asarray(r.query, np.float32).reshape(-1)
             tid = self._tenants.get(r.tenant)
-            if v.size != self.dim or tid is None:
+            if v.size != dim or tid is None:
                 continue                    # tenant -1 matches no rows
-            q[i] = v / max(float(np.linalg.norm(v)), 1e-9)
+            q[i] = v
+            valid[i] = True
             tids[i] = tid
-        if (tids < 0).all():
+            gate_on[i] = bool(getattr(r, "gate_enabled", False))
+            boost_on[i] = bool(getattr(r, "boost", False))
+        if not valid.any():
             return results
-        if self._serve_search is None:
-            self._serve_search = make_sharded_multitenant_topk(
-                self.mesh, self.axis, k=self._k)
+        k_req = max((min(int(r.k), self.capacity)
+                     for i, r in enumerate(reqs) if valid[i]), default=1)
+        k_eff = max(self.cap_take, k_req, 1)
+        k_bucket = min(max(next_pow2(k_eff), 1), self.capacity)
         qp = pad_to_pow2(q)
+        pad_n = qp.shape[0]
+
+        def padb(arr, fill=False, dt=bool):
+            out = np.full((pad_n,), fill, dt)
+            out[:nq] = arr
+            return out
+
+        if not self.serve_fused:
+            return self._serve_classic(reqs, results, valid, qp, tids,
+                                       k_bucket)
+
+        ivf_tabs = self._ivf_tables(k_bucket)
+        use_quant = self.int8_serving
+        if ivf_tabs is not None:
+            cent, mem_sh, ext_sh, nprobe = ivf_tabs
+            mode = "ivf_quant" if use_quant else "ivf"
+            tables = ((*self._int8_shadow_for(), cent, mem_sh, ext_sh)
+                      if use_quant else (cent, mem_sh, ext_sh))
+        else:
+            nprobe = 0
+            mode = "quant" if use_quant else "exact"
+            tables = self._int8_shadow_for() if use_quant else ()
+        kern = self._fused_kernels(mode, k_bucket, nprobe)
+        csr_i, csr_n = self._csr_sharded()
+        args = (tables, csr_i, csr_n, jnp.asarray(qp),
+                jnp.asarray(padb(valid)),
+                jnp.asarray(padb(tids, -1, np.int32)),
+                jnp.asarray(padb(gate_on)))
+        if boost_on.any():
+            now_rel = time.time() - self.epoch
+            with self._state_lock:
+                cur = self._arena
+                fn = (kern.serve
+                      if sys.getrefcount(cur) <= self._SOLE_REFS
+                      else kern.serve_copy)
+                new_state, packed = self._dispatch(
+                    fn, cur, *args, jnp.asarray(padb(boost_on)),
+                    jnp.float32(now_rel), jnp.float32(self.super_gate),
+                    jnp.float32(self.acc_boost), jnp.float32(self.nbr_boost))
+                del cur
+                self.state = new_state
+        else:
+            packed = self._dispatch(kern.read, self.state, *args,
+                                    jnp.float32(self.super_gate))
+        host = np.asarray(packed)              # the ONE readback
+        gate_s, gate_r, ann_s, ann_r, fast = unpack_retrieval(host[:nq],
+                                                              k_bucket)
+        for i, r in enumerate(reqs):
+            if not valid[i]:
+                continue
+            res = results[i]
+            ids, scores = decode_topk(ann_s[i:i + 1], ann_r[i:i + 1],
+                                      self.row_to_id, NEG_INF,
+                                      limit=min(int(r.k), self.capacity))[0]
+            res.ids, res.scores = ids, scores
+            if gate_s[i] > NEG_INF / 2:
+                res.gate_id = self.row_to_id.get(int(gate_r[i]))
+                res.gate_score = float(gate_s[i])
+            res.fast = bool(fast[i])
+            res.boosted = bool(boost_on[i] and not fast[i])
+        return results
+
+    def _serve_classic(self, reqs, results, valid, qp, tids, k_bucket):
+        """The pre-ISSUE-5 pod path, kept for A/B and fallback: ONE
+        distributed multitenant top-k per batch — correct ids and scores,
+        but no gate verdict, no neighbor gather, no boosts (``fast`` and
+        ``boosted`` stay False; the orchestrator's classic host path pays
+        any boosts)."""
+        from lazzaro_tpu.ops.topk import make_sharded_multitenant_topk
+
+        kern = self._serve_search_cache.get(k_bucket)
+        if kern is None:
+            kern = make_sharded_multitenant_topk(self.mesh, self.axis,
+                                                 k=k_bucket)
+            self._serve_search_cache[k_bucket] = kern
+        norms = np.maximum(np.linalg.norm(qp, axis=1, keepdims=True), 1e-9)
         tp = np.full((qp.shape[0],), -1, np.int32)
-        tp[:nq] = tids
-        scores, rows = self._serve_search(self.emb, self.alive, self.tenant,
-                                          jnp.asarray(qp), jnp.asarray(tp))
+        tp[:len(tids)] = tids
+        st = self.state
+        scores, rows = self._dispatch(kern, st.emb, st.alive, st.tenant_id,
+                                      jnp.asarray(qp / norms),
+                                      jnp.asarray(tp))
+        nq = len(reqs)
         decoded = decode_topk(np.asarray(scores)[:nq], np.asarray(rows)[:nq],
                               self.row_to_id, NEG_INF)
         for i, (ids, sc) in enumerate(decoded):
-            k = int(reqs[i].k)
+            if not valid[i]:
+                continue
+            k = min(int(reqs[i].k), self.capacity)
             results[i].ids = ids[:k]
             results[i].scores = sc[:k]
         return results
@@ -236,9 +619,9 @@ class ShardedMemoryIndex:
         tid = self._tenants.get(tenant)
         if tid is None:
             return
-        self.salience = self._decay(self.salience, self.alive, self.tenant,
-                                    jnp.int32(tid), jnp.float32(rate),
-                                    jnp.float32(floor))
+        self._apply_arena(S.arena_decay, S.arena_decay_copy,
+                          jnp.int32(tid), jnp.float32(rate),
+                          jnp.float32(floor))
 
     def partition_of(self, node_id: str) -> Optional[int]:
         row = self.id_to_row.get(node_id)

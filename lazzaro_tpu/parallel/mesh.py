@@ -86,3 +86,13 @@ def make_hybrid_mesh(ici_axes: Sequence[str], ici_sizes: Sequence[int],
 
 def spec(mesh: Mesh, *axes: Optional[str]) -> NamedSharding:
     return NamedSharding(mesh, P(*axes))
+
+
+def shard_stacked(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Sharding for host-STACKED per-shard tables ``[n_shards, ...]`` (the
+    per-shard CSR slices and IVF member/extras tables the fused pod
+    serving program consumes): the leading dim is the shard axis, so chip
+    ``p`` holds exactly its own ``[1, ...]`` slice and the shard_map body
+    squeezes it off. Trailing dims (left unspecified in the PartitionSpec)
+    replicate within the slice."""
+    return NamedSharding(mesh, P(axis))

@@ -24,17 +24,22 @@ the way Ragged Paged Attention coalesces ragged decode work on TPU:
   splitting batches.
 
 The scheduler is deliberately generic over its ``executor`` callable:
-``MemoryIndex`` plugs in the fused single-chip kernel
-(``search_fused_requests`` — which itself routes to the exact dense, the
-quantized two-stage, or the IVF coarse-prefilter program depending on
-``int8_serving`` / a published IVF build, so int8 AND IVF modes keep the
+``MemoryIndex`` plugs in the fused kernel (``search_fused_requests`` —
+which routes to the exact dense, the quantized two-stage, or the IVF
+coarse-prefilter program depending on ``int8_serving`` / a published IVF
+build, and under a mesh to the DISTRIBUTED fused program,
+``state.make_fused_sharded``, so int8, IVF, and pod modes all keep the
 cross-request mega-batching, the one-dispatch turn, and zero-RTT
 query-cache hits), while ``parallel.index.ShardedMemoryIndex`` plugs in
-its shard_map distributed top-k (per-query tenant column: one pod
-dispatch per mixed-tenant batch) — same coalescing, same policy,
-different device program. Mega-batched IVF turns change NOTHING here:
-the futures API, flush policy, and per-request demux are identical
-because the coarse-stage choice lives entirely behind the executor.
+its own pod executor (``serve_requests``) — since ISSUE 5 the SAME full
+chat-turn program as one distributed shard_map dispatch per mixed-tenant
+mega-batch: per-query tenant column, device gate verdict, CSR neighbor
+gather, and shard-local boost scatters (the old pod executor was a plain
+multitenant top-k that dropped the gate/neighbor/boost semantics). Same
+coalescing, same policy, different device program. Mega-batched IVF or
+pod turns change NOTHING here: the futures API, flush policy, and
+per-request demux are identical because the coarse-stage and partitioning
+choices live entirely behind the executor.
 """
 
 from __future__ import annotations

@@ -103,6 +103,22 @@ def empty_results(n: int) -> List[Tuple[List[str], List[float]]]:
     return [([], []) for _ in range(n)]
 
 
+def unpack_retrieval(host: np.ndarray, k: int
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                np.ndarray, np.ndarray]:
+    """Host half of ``core.state._pack_retrieval``: split the ONE
+    [Q, 3 + 2k] packed readback into (gate_scores, gate_rows, ann_scores,
+    ann_rows, fast). Row columns were bitcast (not cast) on device, so the
+    int view reverses them losslessly. Shared by the single-chip and the
+    pod-sharded fused serving decoders."""
+    ann_s = host[:, 2:2 + k]
+    ann_r = np.ascontiguousarray(host[:, 2 + k:2 + 2 * k]).view(np.int32)
+    gate_s = host[:, 0]
+    gate_r = np.ascontiguousarray(host[:, 1:2]).view(np.int32)[:, 0]
+    fast = host[:, 2 + 2 * k] > 0.5
+    return gate_s, gate_r, ann_s, ann_r, fast
+
+
 class FlushPolicy:
     """Time/size flush decision shared by ``IngestCoalescer`` (ingest side)
     and ``serve.QueryScheduler`` (query side).

@@ -1,22 +1,30 @@
 #!/usr/bin/env python3
 """Guard: fail when a bench artifact records a fused-serving regression.
 
-The fused serving acceptance bar (ISSUE 2/3/4) is ONE device dispatch per
-coalesced retrieval batch, and for the approximate coarse stages (int8,
-IVF) a recall floor the artifact itself records. Bench stages that measure
-a fused path record a MEASURED ``dispatches_per_turn`` in their JSON
-artifacts (bench.py ``bench_fused_quant`` / ``bench_fused_ivf`` wrap the
-jit entry points and count), and recall-bearing stages record
-``recall_at_10`` next to their ``recall_floor``. This script walks every
-``bench_artifacts/*.json`` (or the paths passed as arguments) and exits
-nonzero if:
+The fused serving acceptance bar (ISSUE 2/3/4/5) is ONE device dispatch per
+coalesced retrieval batch — on the mesh path ONE *distributed* dispatch —
+and for the approximate coarse stages (int8, IVF) a recall floor the
+artifact itself records. Bench stages that measure a fused path record a
+MEASURED ``dispatches_per_turn`` in their JSON artifacts (bench.py
+``bench_fused_quant`` / ``bench_fused_ivf`` wrap the jit entry points,
+``bench_fused_sharded`` wraps the pod index's ``_dispatch`` hook), and
+recall-bearing stages record ``recall_at_10`` next to their
+``recall_floor``. This script walks every ``bench_artifacts/*.json`` (or
+the paths passed as arguments) and exits nonzero if:
 
-  - any ``dispatches_per_turn`` != 1 (a refactor quietly split the fused
-    program back into multiple dispatches), or
+  - any ``dispatches_per_turn`` != 1 (a refactor quietly split a fused
+    program back into multiple dispatches — single-chip or distributed),
   - any dict carrying both keys has ``recall_at_10`` < ``recall_floor``
     (a coarse-stage change quietly traded recall for throughput),
+  - any dict carrying both keys has ``fused_vs_classic_speedup`` <
+    ``speedup_floor`` (the fused path quietly lost its throughput edge
+    over the semantics-equivalent classic sequence), or
+  - a SHARDED artifact (any dict carrying a ``mesh`` sub-dict) does NOT
+    record a measured ``dispatches_per_turn`` at all — a pod-path stage
+    that stops measuring its dispatch count must fail loudly, not pass
+    vacuously,
 
-so either regression turns red in CI instead of shipping.
+so any of these regressions turns red in CI instead of shipping.
 
 Usage:
     python scripts/check_dispatch_counts.py [artifact.json ...]
@@ -30,19 +38,24 @@ import os
 import sys
 
 
-def _walk(obj, path, hits, recalls):
+def _walk(obj, path, hits, recalls, speedups, meshes):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
+        if "fused_vs_classic_speedup" in obj and "speedup_floor" in obj:
+            speedups.append((path, obj["fused_vs_classic_speedup"],
+                             obj["speedup_floor"]))
+        if isinstance(obj.get("mesh"), dict):
+            meshes.append((path, "dispatches_per_turn" in obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k == "dispatches_per_turn":
                 hits.append((here, v))
             else:
-                _walk(v, here, hits, recalls)
+                _walk(v, here, hits, recalls, speedups, meshes)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
-            _walk(v, f"{path}[{i}]", hits, recalls)
+            _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes)
 
 
 def main(argv):
@@ -54,6 +67,8 @@ def main(argv):
         paths = sorted(glob.glob(os.path.join(root, "*.json")))
     checked = 0
     checked_recall = 0
+    checked_speedup = 0
+    checked_mesh = 0
     bad = []
     for p in paths:
         try:
@@ -62,9 +77,8 @@ def main(argv):
         except (OSError, ValueError) as e:
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
-        hits = []
-        recalls = []
-        _walk(data, os.path.basename(p), hits, recalls)
+        hits, recalls, speedups, meshes = [], [], [], []
+        _walk(data, os.path.basename(p), hits, recalls, speedups, meshes)
         for loc, v in hits:
             checked += 1
             if v != 1:
@@ -79,11 +93,26 @@ def main(argv):
             if not ok:
                 bad.append((loc, f"recall_at_10 == {got!r} "
                                  f"< recall_floor {floor!r}"))
+        for loc, got, floor in speedups:
+            checked_speedup += 1
+            try:
+                ok = float(got) >= float(floor)
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                bad.append((loc, f"fused_vs_classic_speedup == {got!r} "
+                                 f"< speedup_floor {floor!r}"))
+        for loc, has_count in meshes:
+            checked_mesh += 1
+            if not has_count:
+                bad.append((loc, "sharded artifact (has a 'mesh' dict) "
+                                 "records no measured dispatches_per_turn"))
     for loc, msg in bad:
         print(f"REGRESSION: {loc}: {msg}")
-    print(f"[check] {checked} dispatches_per_turn value(s) and "
-          f"{checked_recall} recall pair(s) across {len(paths)} "
-          f"artifact(s); {len(bad)} regression(s)")
+    print(f"[check] {checked} dispatches_per_turn value(s), "
+          f"{checked_recall} recall pair(s), {checked_speedup} speedup "
+          f"pair(s), and {checked_mesh} sharded artifact(s) across "
+          f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 
 

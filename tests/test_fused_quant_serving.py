@@ -347,14 +347,14 @@ def test_sharded_serve_requests_single_dispatch_multi_tenant():
             RetrievalRequest(query=basis(2), tenant="alice", k=2),
             RetrievalRequest(query=basis(0), tenant="nobody", k=2)]
     calls = {"n": 0}
-    res0 = idx.serve_requests(reqs)            # builds the lazy searcher
-    orig = idx._serve_search
+    res0 = idx.serve_requests(reqs)            # builds + warms the kernels
+    orig = idx._dispatch
 
-    def counting(*a, **kw):
+    def counting(fn, *a, **kw):
         calls["n"] += 1
-        return orig(*a, **kw)
+        return orig(fn, *a, **kw)
 
-    idx._serve_search = counting
+    idx._dispatch = counting
     res = idx.serve_requests(reqs)
     assert calls["n"] == 1                     # ONE dispatch, 3 tenants
     for r0, r in zip(res0, res):

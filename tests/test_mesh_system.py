@@ -98,6 +98,54 @@ def test_full_system_parity_on_mesh(mesh, tmp_path):
     assert plain == meshed
 
 
+def test_mesh_chat_turn_is_one_distributed_dispatch(mesh, tmp_path,
+                                                    monkeypatch):
+    """ISSUE 5: under a mesh the chat-turn retrieval (gate + ANN +
+    neighbor/access boosts) routes through the fused sharded program —
+    ONE distributed shard_map dispatch per coalesced batch, zero classic
+    search/boost dispatches. Counted by wrapping the factory's jit entry
+    points AND the classic kernels."""
+    from lazzaro_tpu.core import state as S
+
+    calls = {"serve": 0, "read": 0, "classic": 0}
+    orig_factory = S.make_fused_sharded
+
+    def counting_factory(*a, **kw):
+        kern = orig_factory(*a, **kw)
+
+        def wrap(fn, key):
+            def g(*aa, **kk):
+                calls[key] += 1
+                return fn(*aa, **kk)
+            return g
+
+        return S.FusedShardedKernels(wrap(kern.serve, "serve"),
+                                     wrap(kern.serve_copy, "serve"),
+                                     wrap(kern.read, "read"))
+
+    monkeypatch.setattr(S, "make_fused_sharded", counting_factory)
+    for name in ("arena_search", "arena_update_access", "arena_boost"):
+        orig = getattr(S, name)
+
+        def wrapped(*a, __orig=orig, **kw):
+            calls["classic"] += 1
+            return __orig(*a, **kw)
+
+        monkeypatch.setattr(S, name, wrapped)
+
+    ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
+                      verbose=False, load_from_disk=False, mesh=mesh)
+    ms.start_conversation()
+    ms.chat("I work as a data engineer on a big ETL project.")
+    ms.end_conversation()
+    calls.update(serve=0, read=0, classic=0)
+    ms.start_conversation()
+    ms.chat("What do I do for work, the ETL project?")
+    assert calls["serve"] == 1             # ONE distributed dispatch
+    assert calls["classic"] == 0           # no classic search/boost path
+    ms.close()
+
+
 def test_snapshot_round_trip_on_mesh(mesh, tmp_path):
     ms = MemorySystem(enable_async=False, db_dir=str(tmp_path / "db"),
                       verbose=False, load_from_disk=False, mesh=mesh)
