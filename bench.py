@@ -117,6 +117,32 @@ def _roofline(n_rows: int, dim: int, dtype_bytes: int, ms: float,
     return out
 
 
+def _telemetry_block(tel) -> dict:
+    """ISSUE 6: the observability block every fused bench artifact embeds —
+    the full ``Telemetry.snapshot()`` plus the derived headline numbers
+    (pad-waste fraction, batch occupancy, queue-wait percentiles, peak-HBM
+    gauges) that ``scripts/check_dispatch_counts.py`` requires. Batch
+    occupancy / pad-waste are the measured baseline the ragged-serving
+    direction (ROADMAP item 4) will be judged against."""
+    snap = tel.snapshot()
+    live = tel.counter_total("serve.live_requests")
+    padded = tel.counter_total("serve.padded_slots")
+    qw = tel.timer_values("serve.queue_wait_ms")
+    peak = {k: v for k, v in snap["gauges"].items()
+            if k.startswith("kernel.peak_hbm_bytes")}
+    return {
+        "pad_waste_fraction": (round(1.0 - live / padded, 4)
+                               if padded else 0.0),
+        "batch_occupancy": round(live / padded, 4) if padded else 1.0,
+        "queue_wait_ms_p50": (round(float(np.percentile(qw, 50)), 3)
+                              if qw else None),
+        "queue_wait_ms_p95": (round(float(np.percentile(qw, 95)), 3)
+                              if qw else None),
+        "peak_hbm_bytes": peak or None,
+        "snapshot": snap,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Synthetic corpus with REAL graph structure (r4 review: near-orthogonal
 # vectors produced a degenerate bench graph — links decayed+pruned to an
@@ -438,14 +464,17 @@ def bench_fused_retrieval(on_tpu: bool):
     result decode, honest by construction."""
     from lazzaro_tpu.core.index import MemoryIndex
     from lazzaro_tpu.serve import RetrievalRequest
+    from lazzaro_tpu.utils.telemetry import Telemetry
 
     n_rows = min(N, 65_536)
     B = 64
     reps = 5
     rng = np.random.default_rng(23)
+    tel = Telemetry()
     idx = MemoryIndex(dim=DIM, capacity=n_rows + 64,
                       edge_capacity=max(65_535, 2 * n_rows - 1),
-                      dtype=jnp.bfloat16)
+                      dtype=jnp.bfloat16, telemetry=tel,
+                      telemetry_hbm=True)
     for c in range(0, n_rows, 8192):
         m = min(8192, n_rows - c)
         emb = rng.standard_normal((m, DIM)).astype(np.float32)
@@ -501,6 +530,7 @@ def bench_fused_retrieval(on_tpu: bool):
         "fused_vs_classic_speedup": round(classic_ms / fused_ms, 2),
         "batch": B,
         "arena_rows": n_rows,
+        "telemetry": _telemetry_block(tel),
         "roofline": {
             "fused_retrieval_batch64": _roofline(n_rows, DIM, 2, fused_ms,
                                                  B, on_tpu),
@@ -532,12 +562,14 @@ def bench_fused_quant(on_tpu: bool, rows: int, reps: int = 3,
     from lazzaro_tpu.core import state as S_mod
     from lazzaro_tpu.core.index import MemoryIndex
     from lazzaro_tpu.serve import RetrievalRequest
+    from lazzaro_tpu.utils.telemetry import Telemetry
 
     B = 64
     rng = np.random.default_rng(31)
+    tel = Telemetry()
     idx = MemoryIndex(dim=DIM, capacity=rows + 64,
                       edge_capacity=2 * edge_rows + 64, dtype=jnp.bfloat16,
-                      int8_serving=True)
+                      int8_serving=True, telemetry=tel, telemetry_hbm=True)
     t0 = time.perf_counter()
     for c in range(0, rows, 65_536):
         m = min(65_536, rows - c)
@@ -636,6 +668,7 @@ def bench_fused_quant(on_tpu: bool, rows: int, reps: int = 3,
         "classic_int8_batch64_ms": round(classic_ms, 3),
         "quant_vs_classic_speedup": round(classic_ms / quant_ms, 2),
         "quant_vs_bf16_speedup": round(exact_ms / quant_ms, 2),
+        "telemetry": _telemetry_block(tel),
         "roofline": {
             # int8 coarse scan streams 1 byte/row-dim, bf16 streams 2
             "fused_quant_batch64": _roofline(n_rows, DIM, 1, quant_ms, B,
@@ -674,6 +707,7 @@ def bench_fused_ivf(on_tpu: bool, rows: int, reps: int = 3,
     from lazzaro_tpu.core import state as S_mod
     from lazzaro_tpu.core.index import MemoryIndex
     from lazzaro_tpu.serve import RetrievalRequest
+    from lazzaro_tpu.utils.telemetry import Telemetry
 
     B = 64
     k = 10
@@ -682,9 +716,11 @@ def bench_fused_ivf(on_tpu: bool, rows: int, reps: int = 3,
     centers = rng.standard_normal((n_centers, DIM)).astype(np.float32)
     centers /= np.linalg.norm(centers, axis=1, keepdims=True)
     spread = 0.5 / np.sqrt(DIM)
+    tel = Telemetry()
     idx = MemoryIndex(dim=DIM, capacity=rows + 64,
                       edge_capacity=2 * edge_rows + 64, dtype=jnp.bfloat16,
-                      ivf_nprobe=nprobe_ladder[0])
+                      ivf_nprobe=nprobe_ladder[0], telemetry=tel,
+                      telemetry_hbm=True)
     q_rows = rng.integers(0, rows, size=B)
     q_base = np.zeros((B, DIM), np.float32)
     t0 = time.perf_counter()
@@ -833,6 +869,7 @@ def bench_fused_ivf(on_tpu: bool, rows: int, reps: int = 3,
         "fused_quant_batch64_ms": round(quant_ms, 3),
         "ivf_vs_classic_speedup": round(classic_ms / fused_ms, 2),
         "ivf_vs_fused_quant_speedup": round(quant_ms / fused_ms, 2),
+        "telemetry": _telemetry_block(tel),
         "roofline": {
             # the IVF win is structural: candidate bytes per query vs the
             # dense scans' whole-arena stream
@@ -892,9 +929,12 @@ def bench_fused_sharded(on_tpu: bool, rows: int, reps: int = 3,
                      devices=_jax.devices()[:n_parts])
     B = 64
     rng = np.random.default_rng(41)
+    from lazzaro_tpu.utils.telemetry import Telemetry
+    tel = Telemetry()
     idx = ShardedMemoryIndex(mesh, dim=DIM, capacity=rows + 64,
                              dtype=jnp.bfloat16, k=10, cap_take=5,
-                             max_nbr=16)
+                             max_nbr=16, telemetry=tel,
+                             telemetry_hbm=True)
     t0 = time.perf_counter()
     for c in range(0, rows, 65_536):
         m = min(65_536, rows - c)
@@ -1009,7 +1049,8 @@ def bench_fused_sharded(on_tpu: bool, rows: int, reps: int = 3,
     # pod-vs-chip scaling datapoint; same kernel family, no mesh)
     rng2 = np.random.default_rng(41)
     chip = MemoryIndex(dim=DIM, capacity=rows + 64,
-                       edge_capacity=2 * ne + 64, dtype=jnp.bfloat16)
+                       edge_capacity=2 * ne + 64, dtype=jnp.bfloat16,
+                       telemetry=Telemetry())   # keep the pod block clean
     for c in range(0, rows, 65_536):
         m = min(65_536, rows - c)
         emb = rng2.standard_normal((m, DIM)).astype(np.float32)
@@ -1051,6 +1092,7 @@ def bench_fused_sharded(on_tpu: bool, rows: int, reps: int = 3,
         "fused_vs_classic_speedup": round(classic_ms / fused_ms, 2),
         "fused_vs_plain_ratio": round(plain_ms / fused_ms, 2),
         "sharded_vs_single_chip_speedup": round(chip_ms / fused_ms, 2),
+        "telemetry": _telemetry_block(tel),
         "roofline": {
             # aggregate HBM across the pod: one batch streams the whole
             # arena once (fused) vs twice (classic's two tiers)
@@ -1553,6 +1595,10 @@ def main():
     # traffic, and the roofline denominator must reflect that or the
     # suspect flag understates implied bandwidth (r4 review finding).
     arena_rows = ms.index.state.emb.shape[0]
+    # ISSUE 6: the system registry's view of the whole measured run —
+    # pad-waste / batch-occupancy (the ragged-serving before-number),
+    # queue-wait percentiles, device counters — captured before close()
+    sys_telemetry = _telemetry_block(ms.telemetry)
     ms.close()
 
     # Snapshot the measurements gathered so far to stderr + a sidecar file:
@@ -1690,6 +1736,7 @@ def main():
         "unit": "ms",
         "vs_baseline": round(100.0 / p50, 2),   # reference bar: <100ms ⚡ tier
         "roofline_suspect": suspect,
+        "telemetry": sys_telemetry,
         "extra": {
             "p95_ms": round(p95, 4),
             "p50_int8_serving_ms": (round(p50_int8, 4)

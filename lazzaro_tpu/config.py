@@ -121,6 +121,27 @@ class MemoryConfig:
     # many distinct nodes.
     serve_boost_flush_max: int = 4096
 
+    # --- serving telemetry (ISSUE 6) ---------------------------------------
+    # Host spans + device counters: every request records enqueue→flush
+    # queue wait (per-tenant label), every coalesced batch records pad
+    # inflation, device dispatch wall time and readback-decode time, and
+    # the fused kernels append an int32 counter tail (gate hit/miss, top-k
+    # shortfall, dedup hits, boost-scatter rows, link-pool occupancy/
+    # overflow) to the packed readback that already exists — bytes, not
+    # dispatches. Off = the registry stays empty but the readback layout
+    # is unchanged (the tail always rides; decoding it is nearly free).
+    serve_telemetry: bool = True
+    # Telemetry ring-buffer window per timer series (percentiles are
+    # computed over at most this many recent samples).
+    serve_telemetry_window: int = 10_000
+    # AOT-lower each fused serving geometry's read twin ONCE to record its
+    # compiled ``memory_analysis()`` peak-HBM gauge
+    # (kernel.peak_hbm_bytes{mode,k,rows,mesh}). Costs one extra compile
+    # per (mode × geometry × mesh) key — never an extra dispatch — so it
+    # defaults off; bench runs and the HBM-budget CI direction (ROADMAP
+    # item 8) turn it on.
+    serve_telemetry_hbm: bool = False
+
     # --- behavior flags (parity with memory_system.py:63-84) ---------------
     enable_sharding: bool = True
     enable_hierarchy: bool = True

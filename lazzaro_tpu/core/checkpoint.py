@@ -233,6 +233,10 @@ def save_index(index: MemoryIndex, ckpt_dir: str,
         "node_ids": ids,
         "tenants": index._tenants,
         "shards": index._shards,
+        # Fused-path observability counters survive restarts (ISSUE 6
+        # satellite: a checkpoint load used to silently zero them, so a
+        # dashboard's overflow rate reset on every restore).
+        "counters": {"link_pool_overflows": index.link_pool_overflows},
     }
     if extra_meta:
         meta.update(extra_meta)
@@ -279,6 +283,9 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
     index.row_to_id = dict(zip(node_rows.tolist(), node_ids.tolist()))
     index._tenants = {k: int(v) for k, v in meta["tenants"].items()}
     index._shards = {k: int(v) for k, v in meta["shards"].items()}
+    # restore fused-path counters (absent in pre-ISSUE-6 checkpoints)
+    index.link_pool_overflows = int(
+        meta.get("counters", {}).get("link_pool_overflows", 0))
 
     # Free lists via vectorized set-difference (descending, so allocation
     # pops low rows first — same shape as a fresh index).

@@ -28,6 +28,9 @@ from lazzaro_tpu.ops import graphops
 from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
                                         fetch_packed, next_pow2, pad_to_pow2,
                                         unpack_retrieval)
+from lazzaro_tpu.utils.compat import trace_annotation
+from lazzaro_tpu.utils.telemetry import (default_registry, peak_bytes,
+                                         record_device_counters)
 
 
 def build_host_csr(edge_keys, id_to_row: Dict[str, int], n: int
@@ -100,9 +103,21 @@ class MemoryIndex:
                  dtype=jnp.float32, epoch: Optional[float] = None,
                  mesh=None, shard_axis: str = "data",
                  int8_serving: bool = False, ivf_nprobe: int = 0,
-                 pq_serving: bool = False, coarse_slack: int = 8):
+                 pq_serving: bool = False, coarse_slack: int = 8,
+                 telemetry=None, telemetry_hbm: bool = False):
         self.dim = dim
         self.dtype = dtype
+        # Serving telemetry (ISSUE 6): spans + device counters land in this
+        # registry (the process-wide default unless the owner — typically
+        # MemorySystem — injects its own). ``telemetry_hbm=True``
+        # additionally AOT-lowers each fused serving geometry's read twin
+        # once to record its ``memory_analysis()`` peak-HBM gauge — one
+        # extra compile per (mode × k-bucket) key, zero extra dispatches,
+        # so it's opt-in (bench and the HBM-budget CI gate turn it on).
+        self.telemetry = telemetry if telemetry is not None \
+            else default_registry()
+        self.telemetry_hbm = bool(telemetry_hbm)
+        self._hbm_recorded: set = set()
         # Coarse-stage over-fetch slack, shared by every two-stage serving
         # path (ISSUE 3 satellite): the IVF member scan over-fetches
         # k + slack before the host dedup trims (a reused slot can sit in
@@ -446,6 +461,7 @@ class MemoryIndex:
             "dim": self.dim,
             "dtype": str(np.dtype(self.dtype)),
             "tenants": len(self._tenants),
+            "link_pool_overflows": self.link_pool_overflows,
             "int8_serving": self.int8_serving,
             "ivf": (f"nprobe={self.ivf_nprobe}, "
                     f"{'built' if self._ivf is not None else 'pending'}"
@@ -687,28 +703,43 @@ class MemoryIndex:
                                         ecap)
 
         now_rel = (now if now is not None else time.time()) - self.epoch
-        link_flat, shadow_fresh = self._apply_fused(
-            jnp.asarray(padded), jnp.asarray(emb),
-            jnp.asarray(pad([float(s) for s in saliences])),
-            jnp.asarray(pad([float(t) - self.epoch for t in timestamps])),
-            jnp.asarray(pad([S.TYPE_IDS.get(t, 0) for t in types], 0, np.int32)),
-            jnp.asarray(pad([self.shard_id(sk or "default")
-                             for sk in shard_keys], -1, np.int32)),
-            jnp.asarray(pad([tid] * n, -1, np.int32)),
-            jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
-            jnp.asarray(touch_padded), jnp.asarray(touch_sal),
-            jnp.asarray(c_padded), jnp.asarray(c_src), jnp.asarray(c_tgt),
-            jnp.asarray(c_w), link_pool, jnp.int32(len(link_pool_list)),
-            jnp.float32(now_rel), jnp.int32(tid),
-            jnp.float32(link_gate), jnp.float32(link_scale),
-            k=k_eff, shard_modes=shard_modes)
-        if not shadow_fresh:
-            self._int8_dirty = True
-        self._pq_dirty = True
-        self._note_super(rows, [bool(x) for x in is_super])
-        self._ivf_note_added(rows)
+        t0 = time.perf_counter()
+        with trace_annotation("lz.ingest.fused"):
+            link_flat, shadow_fresh = self._apply_fused(
+                jnp.asarray(padded), jnp.asarray(emb),
+                jnp.asarray(pad([float(s) for s in saliences])),
+                jnp.asarray(pad([float(t) - self.epoch
+                                 for t in timestamps])),
+                jnp.asarray(pad([S.TYPE_IDS.get(t, 0) for t in types], 0,
+                                np.int32)),
+                jnp.asarray(pad([self.shard_id(sk or "default")
+                                 for sk in shard_keys], -1, np.int32)),
+                jnp.asarray(pad([tid] * n, -1, np.int32)),
+                jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
+                jnp.asarray(touch_padded), jnp.asarray(touch_sal),
+                jnp.asarray(c_padded), jnp.asarray(c_src),
+                jnp.asarray(c_tgt),
+                jnp.asarray(c_w), link_pool, jnp.int32(len(link_pool_list)),
+                jnp.float32(now_rel), jnp.int32(tid),
+                jnp.float32(link_gate), jnp.float32(link_scale),
+                k=k_eff, shard_modes=shard_modes)
+            if not shadow_fresh:
+                self._int8_dirty = True
+            self._pq_dirty = True
+            self._note_super(rows, [bool(x) for x in is_super])
+            self._ivf_note_added(rows)
 
-        host = fetch_packed(*link_flat)        # the ONE readback
+            host = fetch_packed(*link_flat)    # the ONE readback
+        self.telemetry.record("ingest.dispatch_ms",
+                              (time.perf_counter() - t0) * 1e3,
+                              labels={"kind": "fused"})
+        # Device-side ingest counters riding the same readback (ISSUE 6):
+        # overflow flag + accepted-link count + pool-slot occupancy are the
+        # trailing broadcast leaves after the per-mode triples.
+        ctr = host[3 * n_modes:]
+        self.telemetry.bump("ingest.dispatches", labels={"kind": "fused"})
+        self.telemetry.bump("ingest.links_accepted", int(ctr[1][0, 0]))
+        self.telemetry.bump("ingest.pool_slots_used", int(ctr[2][0, 0]))
         pool_real = len(link_pool_list)
         candidates: Dict[int, Dict[str, List[Tuple[str, float]]]] = {}
         created: Dict[int, List[Tuple[str, str, float]]] = {}
@@ -767,6 +798,7 @@ class MemoryIndex:
             # the rare overfull batch pays one extra dispatch; the edges
             # land with the same weights/tenant/timestamp they would have
             self.link_pool_overflows += 1
+            self.telemetry.bump("ingest.link_pool_overflows")
             self.add_edges(overflowed, tenant, now=now)
         return rows, candidates, created
 
@@ -873,27 +905,42 @@ class MemoryIndex:
                                         ecap)
 
         now_abs = now if now is not None else time.time()
-        flat, shadow_fresh = self._apply_dedup_fused(
-            jnp.asarray(padded), jnp.asarray(emb),
-            jnp.asarray(pad([float(s) for s in saliences])),
-            jnp.asarray(pad([float(t) - self.epoch for t in timestamps])),
-            jnp.asarray(pad([S.TYPE_IDS.get(t, 0) for t in types], 0,
-                            np.int32)),
-            jnp.asarray(pad([self.shard_id(sk or "default")
-                             for sk in shard_keys], -1, np.int32)),
-            jnp.asarray(pad([tid] * n, -1, np.int32)),
-            jnp.asarray(pad([False] * n, False, bool)),
-            jnp.asarray(pad(gids, -1, np.int32)),
-            jnp.asarray(chain_slots), link_pool,
-            jnp.int32(len(link_pool_list)),
-            jnp.float32(now_abs - self.epoch), jnp.int32(tid),
-            jnp.float32(dedup_gate), jnp.float32(chain_weight),
-            jnp.float32(link_gate), jnp.float32(link_scale),
-            k=k_eff, shard_modes=shard_modes)
-        if not shadow_fresh:
-            self._int8_dirty = True
-        self._pq_dirty = True
-        host = fetch_packed(*flat)             # the ONE readback
+        t0 = time.perf_counter()
+        with trace_annotation("lz.ingest.dedup_fused"):
+            flat, shadow_fresh = self._apply_dedup_fused(
+                jnp.asarray(padded), jnp.asarray(emb),
+                jnp.asarray(pad([float(s) for s in saliences])),
+                jnp.asarray(pad([float(t) - self.epoch
+                                 for t in timestamps])),
+                jnp.asarray(pad([S.TYPE_IDS.get(t, 0) for t in types], 0,
+                                np.int32)),
+                jnp.asarray(pad([self.shard_id(sk or "default")
+                                 for sk in shard_keys], -1, np.int32)),
+                jnp.asarray(pad([tid] * n, -1, np.int32)),
+                jnp.asarray(pad([False] * n, False, bool)),
+                jnp.asarray(pad(gids, -1, np.int32)),
+                jnp.asarray(chain_slots), link_pool,
+                jnp.int32(len(link_pool_list)),
+                jnp.float32(now_abs - self.epoch), jnp.int32(tid),
+                jnp.float32(dedup_gate), jnp.float32(chain_weight),
+                jnp.float32(link_gate), jnp.float32(link_scale),
+                k=k_eff, shard_modes=shard_modes)
+            if not shadow_fresh:
+                self._int8_dirty = True
+            self._pq_dirty = True
+            host = fetch_packed(*flat)         # the ONE readback
+        self.telemetry.record("ingest.dispatch_ms",
+                              (time.perf_counter() - t0) * 1e3,
+                              labels={"kind": "dedup_fused"})
+        # Device counters riding the same readback: dedup verdicts are the
+        # first wide leaf; the link counters trail the per-mode triples.
+        ctr = host[3 + 3 * n_modes:]
+        self.telemetry.bump("ingest.dispatches",
+                            labels={"kind": "dedup_fused"})
+        self.telemetry.bump("ingest.dedup_hits",
+                            int((host[0][:n, 0] > 0).sum()))
+        self.telemetry.bump("ingest.links_accepted", int(ctr[1][0, 0]))
+        self.telemetry.bump("ingest.pool_slots_used", int(ctr[2][0, 0]))
         return {
             "rows": rows, "n": n, "k_eff": k_eff,
             "shard_modes": shard_modes, "link_scale": link_scale,
@@ -1002,6 +1049,7 @@ class MemoryIndex:
         self._ivf_note_added(live_rows)
         if overflowed:
             self.link_pool_overflows += 1
+            self.telemetry.bump("ingest.link_pool_overflows")
             self.add_edges(overflowed, pending["tenant"],
                            now=pending["now"])
         return candidates, created, merges, chains
@@ -1452,6 +1500,14 @@ class MemoryIndex:
             return results
         qp = pad_to_pow2(q)
         pad_n = qp.shape[0]
+        tel = self.telemetry
+        # Coalesce/pad inflation: padded kernel slots vs live requests and
+        # the per-batch max-k bucket — the pow2 padding tax ROADMAP item 4
+        # (ragged serving) needs a measured baseline for.
+        tel.bump("serve.live_requests", nq)
+        tel.bump("serve.padded_slots", pad_n)
+        tel.gauge("serve.batch_occupancy", nq / pad_n)
+        tel.record("serve.k_bucket", k_bucket)
 
         def padb(arr, fill=False, dt=bool):
             out = np.full((pad_n,), fill, dt)
@@ -1460,15 +1516,28 @@ class MemoryIndex:
 
         indptr, nbr = self._csr_for(st)
         if self.mesh is not None:
-            packed = self._dispatch_fused_sharded(
-                st, indptr, nbr, qp, padb, valid, tenants, gate_on,
-                boost_on, k_bucket, cap_take, max_nbr, super_gate,
-                acc_boost, nbr_boost, now)
-            host = np.asarray(packed)          # the ONE readback
-            gate_s, gate_r, ann_s, ann_r, fast = unpack_retrieval(
-                host[:nq], k_bucket)
-            return self._demux_fused(reqs, results, valid, boost_on, gate_s,
-                                     gate_r, ann_s, ann_r, fast, cap)
+            mode = "sharded_quant" if self.int8_serving else "sharded_exact"
+            t0 = time.perf_counter()
+            with trace_annotation(f"lz.serve.{mode}"):
+                packed = self._dispatch_fused_sharded(
+                    st, indptr, nbr, qp, padb, valid, tenants, gate_on,
+                    boost_on, k_bucket, cap_take, max_nbr, super_gate,
+                    acc_boost, nbr_boost, now)
+                host = np.asarray(packed)      # the ONE readback
+            tel.record("serve.dispatch_ms",
+                       (time.perf_counter() - t0) * 1e3,
+                       labels={"mode": mode})
+            tel.bump("serve.dispatches", labels={"mode": mode})
+            with tel.span("serve.decode_ms"):
+                gate_s, gate_r, ann_s, ann_r, fast, counters = \
+                    unpack_retrieval(host[:nq], k_bucket)
+                out = self._demux_fused(reqs, results, valid, boost_on,
+                                        gate_s, gate_r, ann_s, ann_r, fast,
+                                        cap)
+            record_device_counters(
+                tel, counters, fast, gate_on[:nq], valid[:nq],
+                np.asarray([min(int(r.k), cap) for r in reqs]))
+            return out
         args = (indptr, nbr, jnp.asarray(qp),
                 jnp.asarray(padb(valid)),
                 jnp.asarray(padb(tenants, -1, np.int32)),
@@ -1494,63 +1563,126 @@ class MemoryIndex:
             statics["slack"] = self.coarse_slack
         elif use_quant:
             statics["slack"] = self.coarse_slack
-        if boost_on.any():
-            del st      # a live snapshot would trip the sole-owner gate
-            now_rel = (now if now is not None else time.time()) - self.epoch
-            with self._state_lock:
-                cur = self._state
-                boost_args = (jnp.asarray(padb(boost_on)),
-                              jnp.float32(now_rel), jnp.float32(super_gate),
-                              jnp.float32(acc_boost), jnp.float32(nbr_boost))
-                if ivf_tabs is not None:
-                    cent, members, extras, _ = ivf_tabs
-                    # shadow (when int8 is on too) taken against ``cur``
-                    # under the lock — the (arena, codes) pair never tears
-                    shadow = (self._int8_shadow_for(cur) if use_quant
-                              else None)
-                    fn = (S.search_fused_ivf
-                          if sys.getrefcount(cur) <= self._SOLE_REFS
-                          else S.search_fused_ivf_copy)
-                    new_state, packed = fn(cur, shadow, cent, members,
-                                           extras, *args, *boost_args,
-                                           **statics)
-                elif use_quant:
-                    # shadow taken against ``cur`` under the lock, so the
-                    # (arena, codes) pair can never tear across a racing
-                    # writer (re-entrant RLock; rebuild is dispatch-only)
-                    q8, scale = self._int8_shadow_for(cur)
-                    fn = (S.search_fused_quant
-                          if sys.getrefcount(cur) <= self._SOLE_REFS
-                          else S.search_fused_quant_copy)
-                    new_state, packed = fn(cur, q8, scale, *args,
-                                           *boost_args, **statics)
-                else:
-                    fn = (S.search_fused
-                          if sys.getrefcount(cur) <= self._SOLE_REFS
-                          else S.search_fused_copy)
-                    new_state, packed = fn(cur, *args, *boost_args, **statics)
-                del cur
-                self.state = new_state
-        elif ivf_tabs is not None:
-            cent, members, extras, _ = ivf_tabs
-            shadow = self._int8_shadow_for(st) if use_quant else None
-            packed = S.search_fused_ivf_read(st, shadow, cent, members,
-                                             extras, *args,
+        mode = ("ivf" if ivf_tabs is not None
+                else "quant" if use_quant else "exact")
+        self._maybe_record_hbm(mode, st, args, statics, super_gate,
+                               ivf_tabs, use_quant)
+        t0 = time.perf_counter()
+        with trace_annotation(f"lz.serve.{mode}"):
+            if boost_on.any():
+                del st  # a live snapshot would trip the sole-owner gate
+                now_rel = ((now if now is not None else time.time())
+                           - self.epoch)
+                with self._state_lock:
+                    cur = self._state
+                    boost_args = (jnp.asarray(padb(boost_on)),
+                                  jnp.float32(now_rel),
+                                  jnp.float32(super_gate),
+                                  jnp.float32(acc_boost),
+                                  jnp.float32(nbr_boost))
+                    if ivf_tabs is not None:
+                        cent, members, extras, _ = ivf_tabs
+                        # shadow (when int8 is on too) taken against ``cur``
+                        # under the lock — the (arena, codes) pair never
+                        # tears
+                        shadow = (self._int8_shadow_for(cur) if use_quant
+                                  else None)
+                        fn = (S.search_fused_ivf
+                              if sys.getrefcount(cur) <= self._SOLE_REFS
+                              else S.search_fused_ivf_copy)
+                        new_state, packed = fn(cur, shadow, cent, members,
+                                               extras, *args, *boost_args,
+                                               **statics)
+                    elif use_quant:
+                        # shadow taken against ``cur`` under the lock, so
+                        # the (arena, codes) pair can never tear across a
+                        # racing writer (re-entrant RLock; rebuild is
+                        # dispatch-only)
+                        q8, scale = self._int8_shadow_for(cur)
+                        fn = (S.search_fused_quant
+                              if sys.getrefcount(cur) <= self._SOLE_REFS
+                              else S.search_fused_quant_copy)
+                        new_state, packed = fn(cur, q8, scale, *args,
+                                               *boost_args, **statics)
+                    else:
+                        fn = (S.search_fused
+                              if sys.getrefcount(cur) <= self._SOLE_REFS
+                              else S.search_fused_copy)
+                        new_state, packed = fn(cur, *args, *boost_args,
+                                               **statics)
+                    del cur
+                    self.state = new_state
+            elif ivf_tabs is not None:
+                cent, members, extras, _ = ivf_tabs
+                shadow = self._int8_shadow_for(st) if use_quant else None
+                packed = S.search_fused_ivf_read(st, shadow, cent, members,
+                                                 extras, *args,
+                                                 jnp.float32(super_gate),
+                                                 **statics)
+            elif use_quant:
+                q8, scale = self._int8_shadow_for(st)
+                packed = S.search_fused_quant_read(st, q8, scale, *args,
+                                                   jnp.float32(super_gate),
+                                                   **statics)
+            else:
+                packed = S.search_fused_read(st, *args,
                                              jnp.float32(super_gate),
                                              **statics)
-        elif use_quant:
-            q8, scale = self._int8_shadow_for(st)
-            packed = S.search_fused_quant_read(st, q8, scale, *args,
-                                               jnp.float32(super_gate),
-                                               **statics)
-        else:
-            packed = S.search_fused_read(st, *args,
-                                         jnp.float32(super_gate), **statics)
-        host = np.asarray(packed)              # the ONE readback
-        gate_s, gate_r, ann_s, ann_r, fast = unpack_retrieval(host[:nq],
-                                                              k_bucket)
-        return self._demux_fused(reqs, results, valid, boost_on, gate_s,
-                                 gate_r, ann_s, ann_r, fast, cap)
+            host = np.asarray(packed)          # the ONE readback
+        tel.record("serve.dispatch_ms", (time.perf_counter() - t0) * 1e3,
+                   labels={"mode": mode})
+        tel.bump("serve.dispatches", labels={"mode": mode})
+        with tel.span("serve.decode_ms"):
+            gate_s, gate_r, ann_s, ann_r, fast, counters = unpack_retrieval(
+                host[:nq], k_bucket)
+            out = self._demux_fused(reqs, results, valid, boost_on, gate_s,
+                                    gate_r, ann_s, ann_r, fast, cap)
+        record_device_counters(
+            tel, counters, fast, gate_on[:nq], valid[:nq],
+            np.asarray([min(int(r.k), cap) for r in reqs]))
+        return out
+
+    def _maybe_record_hbm(self, mode: str, st, args, statics, super_gate,
+                          ivf_tabs, use_quant) -> None:
+        """Record the ``memory_analysis()`` peak-HBM gauge for one fused
+        serving geometry, once per (mode × k-bucket × cap/nbr) key —
+        "Memory Safe Computations with XLA": compiled-program introspection
+        is cheap, so every kernel the serving path builds reports its peak
+        footprint before a new size/mode combination can OOM in production.
+        Opt-in (``telemetry_hbm``) because the AOT lower+compile of the
+        read twin is an extra compile (never an extra dispatch)."""
+        if not self.telemetry_hbm:
+            return
+        key = (mode,) + tuple(sorted(statics.items()))
+        if key in self._hbm_recorded:
+            return
+        self._hbm_recorded.add(key)
+        try:
+            if ivf_tabs is not None:
+                cent, members, extras, _ = ivf_tabs
+                shadow = self._int8_shadow_for(st) if use_quant else None
+                lowered = S.search_fused_ivf_read.lower(
+                    st, shadow, cent, members, extras, *args,
+                    jnp.float32(super_gate), **statics)
+            elif use_quant:
+                q8, scale = self._int8_shadow_for(st)
+                lowered = S.search_fused_quant_read.lower(
+                    st, q8, scale, *args, jnp.float32(super_gate),
+                    **statics)
+            else:
+                lowered = S.search_fused_read.lower(
+                    st, *args, jnp.float32(super_gate), **statics)
+            peak = peak_bytes(lowered.compile().memory_analysis())
+        except Exception:   # noqa: BLE001 — observability must never serve 500s
+            return
+        if peak is not None:
+            self.telemetry.gauge(
+                "kernel.peak_hbm_bytes", peak,
+                labels={"mode": mode,
+                        "k": str(statics.get("k")),
+                        "rows": str(st.emb.shape[0]),
+                        "mesh": (f"{self._n_parts}x{self.shard_axis}"
+                                 if self.mesh is not None else "1")})
 
     def _demux_fused(self, reqs, results, valid, boost_on, gate_s, gate_r,
                      ann_s, ann_r, fast, cap):
@@ -1581,6 +1713,9 @@ class MemoryIndex:
                 cap_take=min(cap_take, k_bucket), max_nbr=max_nbr,
                 mode=mode, slack=self.coarse_slack)
             self._fused_sharded_cache[key] = kern
+            self.telemetry.gauge("kernel.cache_entries",
+                                 len(self._fused_sharded_cache),
+                                 labels={"surface": "fused_sharded"})
         return kern
 
     def _dispatch_fused_sharded(self, st, indptr, nbr, qp, padb, valid,
@@ -1603,6 +1738,24 @@ class MemoryIndex:
         sargs = (indptr, nbr, jnp.asarray(qp), jnp.asarray(padb(valid)),
                  jnp.asarray(padb(tenants, -1, np.int32)),
                  jnp.asarray(padb(gate_on)))
+        if self.telemetry_hbm:
+            hkey = ("sharded", mode, k_bucket, cap_take, max_nbr)
+            if hkey not in self._hbm_recorded:
+                self._hbm_recorded.add(hkey)
+                try:
+                    tables = self._int8_shadow_for(st) if use_quant else ()
+                    peak = peak_bytes(kern.read.lower(
+                        st, tables, *sargs, jnp.float32(super_gate)
+                    ).compile().memory_analysis())
+                except Exception:   # noqa: BLE001 — never fail the serve
+                    peak = None
+                if peak is not None:
+                    self.telemetry.gauge(
+                        "kernel.peak_hbm_bytes", peak,
+                        labels={"mode": f"sharded_{mode}",
+                                "k": str(k_bucket),
+                                "rows": str(st.emb.shape[0]),
+                                "mesh": f"{self._n_parts}x{self.shard_axis}"})
         if boost_on.any():
             del st      # a live snapshot would trip the sole-owner gate
             now_rel = (now if now is not None else time.time()) - self.epoch

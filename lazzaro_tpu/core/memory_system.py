@@ -20,6 +20,7 @@ CLI /save path — SURVEY §2.2 quirks).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -42,6 +43,24 @@ from lazzaro_tpu.core.store import ArrowStore
 from lazzaro_tpu.models.graph import Edge, Node
 from lazzaro_tpu.serve import QueryScheduler, RetrievalRequest
 from lazzaro_tpu.utils.batching import IngestCoalescer
+from lazzaro_tpu.utils.telemetry import Telemetry
+
+_logger = logging.getLogger("lazzaro_tpu.memory_system")
+
+
+def _ensure_log_handler() -> None:
+    """Attach one bare-message stderr handler to the ``lazzaro_tpu`` logger
+    when neither it nor the root logger is configured — ``verbose=True``
+    stays visible out of the box, while applications that configure
+    logging get full control (and silence) the standard way."""
+    pkg = logging.getLogger("lazzaro_tpu")
+    if pkg.handlers or logging.root.handlers:
+        return
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    pkg.addHandler(handler)
+    if pkg.level == logging.NOTSET:
+        pkg.setLevel(logging.INFO)
 
 
 class MemorySystem:
@@ -127,13 +146,21 @@ class MemorySystem:
         self.buffer = BufferGraph(self.shards, self.super_nodes)
         self.profile = Profile()
         self.mesh = mesh
+        # Serving telemetry (ISSUE 6): one registry per system — the index,
+        # the query scheduler, and the chat/consolidation paths all record
+        # into it; ``metrics_summary()`` / the dashboard ``/metrics``
+        # endpoint read it out.
+        self.telemetry = Telemetry(cfg.serve_telemetry_window,
+                                   enabled=cfg.serve_telemetry)
         self.index = MemoryIndex(dim, capacity=cfg.initial_capacity,
                                  edge_capacity=cfg.max_edges,
                                  dtype=jnp.dtype(cfg.dtype), mesh=mesh,
                                  int8_serving=cfg.int8_serving,
                                  ivf_nprobe=cfg.ivf_serving,
                                  pq_serving=cfg.pq_serving,
-                                 coarse_slack=cfg.coarse_fetch_slack)
+                                 coarse_slack=cfg.coarse_fetch_slack,
+                                 telemetry=self.telemetry,
+                                 telemetry_hbm=cfg.serve_telemetry_hbm)
 
         self.query_cache = QueryCache(cfg.cache_size) if self.enable_caching else None
 
@@ -184,12 +211,15 @@ class MemorySystem:
         self.background_executor = (ThreadPoolExecutor(max_workers=1)
                                     if self.enable_async else None)
 
+        # Monotonic call counters (reference parity). Latency tracking that
+        # used to live here as unbounded ``retrieval_times[]`` /
+        # ``consolidation_times[]`` lists is now ring-buffered Telemetry
+        # spans ("chat.retrieval_ms", "consolidation.run_ms") with
+        # percentile summaries — see ``metrics_summary()``.
         self.metrics = {
             "embedding_calls": 0,
             "llm_calls": 0,
             "edges_linked": 0,
-            "retrieval_times": [],
-            "consolidation_times": [],
         }
         self._last_version = -1
 
@@ -276,8 +306,15 @@ class MemorySystem:
 
     # ------------------------------------------------------------------ util
     def _log(self, msg: str) -> None:
+        """Verbose-mode progress lines route through ``logging`` (ISSUE 6
+        satellite: library users silence or redirect them with standard
+        logging config; the old bare ``print`` could not be turned off
+        without ``verbose=False``). A plain stderr handler is attached
+        lazily when nothing else is configured, so interactive
+        ``verbose=True`` sessions still see output by default."""
         if self.verbose:
-            print(msg)
+            _ensure_log_handler()
+            _logger.info(msg)
 
     def _q(self, node_id: str) -> str:
         """Tenant-qualified index key (node ids like 'node_1' repeat per user)."""
@@ -571,15 +608,16 @@ class MemorySystem:
         self._boost_neighbors(retrieved_ids, mode=boost_mode)
 
         retrieval_time = (time.time() - start_time) * 1000
-        self.metrics["retrieval_times"].append(retrieval_time)
+        self.telemetry.record("chat.retrieval_ms", retrieval_time,
+                              labels={"tenant": self.user_id})
 
         messages = self._assemble_messages(retrieved_ids, mode=boost_mode)
         response = self._call_llm(messages)
         self.add_to_short_term(response, "semantic", salience=0.5)
         self.conversation_history.append({"role": "assistant", "content": response})
 
-        emoji = "⚡" if retrieval_time < 100 else ("✓" if retrieval_time < 200 else "⏱")
-        self._log(f"[{emoji} Retrieval: {retrieval_time:.0f}ms, Retrieved: {len(retrieved_ids)} nodes]")
+        self._log(f"[{Telemetry.tier(retrieval_time)} Retrieval: "
+                  f"{retrieval_time:.0f}ms, Retrieved: {len(retrieved_ids)} nodes]")
         if retrieved_ids and self.verbose:
             self._log("   Retrieved Nodes:")
             for nid in retrieved_ids:
@@ -605,10 +643,11 @@ class MemorySystem:
         self._boost_neighbors(retrieved_ids, mode=boost_mode)
 
         retrieval_time = (time.time() - start_time) * 1000
-        self.metrics["retrieval_times"].append(retrieval_time)
-        emoji = "⚡" if retrieval_time < 100 else ("✓" if retrieval_time < 200 else "⏱")
+        self.telemetry.record("chat.retrieval_ms", retrieval_time,
+                              labels={"tenant": self.user_id})
         yield {"type": "info",
-               "content": f"[{emoji} Retrieval: {retrieval_time:.0f}ms, Retrieved: {len(retrieved_ids)} nodes]"}
+               "content": f"[{Telemetry.tier(retrieval_time)} Retrieval: "
+                          f"{retrieval_time:.0f}ms, Retrieved: {len(retrieved_ids)} nodes]"}
 
         messages = self._assemble_messages(retrieved_ids, mode=boost_mode)
         self.metrics["llm_calls"] += 1
@@ -797,7 +836,8 @@ class MemorySystem:
                 sched = QueryScheduler(
                     self._serve_requests,
                     max_batch=self.config.serve_batch_max,
-                    max_wait_us=self.config.serve_flush_us)
+                    max_wait_us=self.config.serve_flush_us,
+                    telemetry=self.telemetry)
                 self.query_scheduler = sched
         return sched
 
@@ -1364,7 +1404,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                     self._log("🧭 IVF coarse index rebuilt")
 
         elapsed = time.time() - start_time
-        self.metrics["consolidation_times"].append(elapsed)
+        self.telemetry.record("consolidation.run_ms", elapsed * 1e3)
         self._log(f"✓ Background consolidation complete ({elapsed:.2f}s)")
         self._save_to_persistence()
         with self._mutex:
@@ -2515,11 +2555,11 @@ Be clinical yet insightful. Do not include conversational filler."""
     # ----------------------------------------------------------- observability
     def get_stats(self) -> Dict:
         nodes, edges = self.buffer.size()
-        rt = self.metrics["retrieval_times"]
-        ct = self.metrics["consolidation_times"]
+        rt = self.telemetry.timer_values("chat.retrieval_ms")
+        ct = self.telemetry.timer_values("consolidation.run_ms")
         avg_retrieval = float(np.mean(rt)) if rt else 0
         p95_retrieval = float(np.percentile(rt, 95)) if rt else 0
-        avg_consolidation = float(np.mean(ct)) if ct else 0
+        avg_consolidation = float(np.mean(ct)) / 1e3 if ct else 0
         cache_hit_rate = self.query_cache.get_hit_rate() if self.query_cache else 0.0
         return {
             "buffer_nodes": nodes,
@@ -2550,6 +2590,42 @@ Be clinical yet insightful. Do not include conversational filler."""
                                if hasattr(self.llm, "health") else None),
                 "embedder_health": (self.embedder.health()
                                     if hasattr(self.embedder, "health") else None),
+            },
+        }
+
+    def metrics_summary(self) -> Dict:
+        """One JSON-able observability surface (ISSUE 6): the Telemetry
+        snapshot — host spans (queue wait, dispatch wall, decode), device
+        counters decoded from every fused readback (gate hit/miss, top-k
+        shortfall, dedup hits, boost-scatter rows, link-pool occupancy/
+        overflow), and gauges (batch occupancy, compile-cache sizes,
+        peak-HBM per kernel) — plus the derived headline numbers the CI
+        artifact gate checks. The dashboard's Prometheus ``/metrics``
+        endpoint renders the SAME registry, so its samples match this
+        summary by construction (a test pins that)."""
+        tel = self.telemetry
+        padded = tel.counter_total("serve.padded_slots")
+        live = tel.counter_total("serve.live_requests")
+        qw = tel.timer_values("serve.queue_wait_ms")
+        peak_hbm = {k: v for k, v in tel.gauges.items()
+                    if k.startswith("kernel.peak_hbm_bytes")}
+        return {
+            "telemetry": tel.snapshot(),
+            "pad_waste_fraction": ((1.0 - live / padded) if padded else 0.0),
+            "queue_wait_ms_p50": (float(np.percentile(qw, 50)) if qw
+                                  else None),
+            "queue_wait_ms_p95": (float(np.percentile(qw, 95)) if qw
+                                  else None),
+            "serve_dispatches": tel.counter_total("serve.dispatches"),
+            "ingest_dispatches": tel.counter_total("ingest.dispatches"),
+            "link_pool_overflows": self.index.link_pool_overflows,
+            "peak_hbm_bytes": peak_hbm or None,
+            "scheduler": (self.query_scheduler.stats()
+                          if self.query_scheduler is not None else None),
+            "counters": {
+                "llm_calls": self.metrics["llm_calls"],
+                "embedding_calls": self.metrics["embedding_calls"],
+                "edges_linked": self.metrics["edges_linked"],
             },
         }
 

@@ -754,10 +754,16 @@ def _gated_link_insert(edges, link_flat, link_pool, pool_len, src_rows,
                           -1).reshape(live.shape)
         outs.extend((scores, cand, pos_m))
         off += m
-    # trailing overflow flag, broadcast to the common readback leaf shape
-    # so the whole tuple still fetches in ONE packed transfer
-    outs.append(jnp.broadcast_to(overflow.astype(jnp.int32),
-                                 per_mode[0][2].shape))
+    # trailing counter leaves, broadcast to the common readback leaf shape
+    # so the whole tuple still fetches in ONE packed transfer (ISSUE 6:
+    # the overflow flag, the device-gated accepted-link count, and the
+    # pool-slot occupancy ride the readback — bytes, not dispatches)
+    leaf = per_mode[0][2].shape
+    accepted = live_all.sum().astype(jnp.int32)
+    pool_used = jnp.minimum(accepted, jnp.minimum(pool_len, pool_cap))
+    outs.append(jnp.broadcast_to(overflow.astype(jnp.int32), leaf))
+    outs.append(jnp.broadcast_to(accepted, leaf))
+    outs.append(jnp.broadcast_to(pool_used.astype(jnp.int32), leaf))
     return edges, tuple(outs)
 
 
@@ -1012,9 +1018,11 @@ def _search_fused(
         _search_fused_scan(state, csr_indptr, csr_nbr, q, q_valid, tenant,
                            gate_on, boost_on, super_gate, k, cap_take,
                            max_nbr)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
     state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
                            nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  acc=n_acc, nbr=n_nbr)
 
 
 def _boost_scatter(state: ArenaState, acc_rows: jax.Array,
@@ -1043,17 +1051,52 @@ def _boost_scatter(state: ArenaState, acc_rows: jax.Array,
         last_accessed=jnp.where(touched, now, state.last_accessed))
 
 
-def _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast) -> jax.Array:
-    """ONE [Q, 3 + 2k] f32 readback array: [gate_score, gate_row(bitcast),
-    ann_scores..k, ann_rows(bitcast)..k, fast]. Packing happens in-kernel so
-    the host pays exactly one device→host transfer and zero extra
-    dispatches (int rows are bitcast, not cast — undone with a host-side
-    ``.view(int32)``, same trick as ``utils.batching.fetch_packed``)."""
+# Width of the device-counter tail _pack_retrieval appends to every fused
+# serving readback (ISSUE 6): per query [n_live, n_dedup_dropped,
+# n_acc_boost_rows, n_nbr_boost_rows] as bitcast int32. The marginal cost
+# of device-side observability is these 16 bytes per query riding the ONE
+# readback that already exists — never an extra dispatch or transfer.
+RETRIEVAL_TAIL = 4
+
+
+def _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=None, acc=None,
+                    nbr=None) -> jax.Array:
+    """ONE [Q, 3 + 2k + RETRIEVAL_TAIL] f32 readback array: [gate_score,
+    gate_row(bitcast), ann_scores..k, ann_rows(bitcast)..k, fast,
+    counters..4]. Packing happens in-kernel so the host pays exactly one
+    device→host transfer and zero extra dispatches (int rows are bitcast,
+    not cast — undone with a host-side ``.view(int32)``, same trick as
+    ``utils.batching.fetch_packed``).
+
+    The counter tail carries the device-side serving counters: live top-k
+    hits (host derives the top-k shortfall against each request's k),
+    duplicate candidates the IVF in-kernel dedup suppressed (``dup``;
+    zero for the dense paths), and the access/neighbor boost-scatter row
+    counts (``acc``/``nbr``; zero for read twins, whose boost masks are
+    all-off)."""
     bc = lambda a: jax.lax.bitcast_convert_type(a.astype(jnp.int32),  # noqa: E731
                                                 jnp.float32)
+    q = gate_s.shape[0]
+    zeros = jnp.zeros((q,), jnp.int32)
+    n_live = (ann_s > NEG_INF / 2).sum(axis=-1).astype(jnp.int32)
+    dup = zeros if dup is None else dup.astype(jnp.int32)
+    acc = zeros if acc is None else acc.astype(jnp.int32)
+    nbr = zeros if nbr is None else nbr.astype(jnp.int32)
     return jnp.concatenate([
         gate_s[:, None], bc(gate_r)[:, None], ann_s, bc(ann_r),
-        fast.astype(jnp.float32)[:, None]], axis=1)
+        fast.astype(jnp.float32)[:, None],
+        bc(n_live)[:, None], bc(dup)[:, None], bc(acc)[:, None],
+        bc(nbr)[:, None]], axis=1)
+
+
+def _boost_row_counts(capacity: int, acc_rows: jax.Array,
+                      nbr_rows: jax.Array):
+    """Per-query counts of rows the boost scatter will actually touch
+    (sentinel-routed entries excluded) — the device-side 'boost-scatter
+    count' rider. Shared by every single-chip fused serving kernel."""
+    acc = (acc_rows != capacity).sum(axis=-1)
+    nbr = (nbr_rows != capacity).sum(axis=-1)
+    return acc, nbr
 
 
 search_fused, search_fused_copy = _donated_pair(
@@ -1199,9 +1242,11 @@ def _search_fused_quant(
         _search_fused_quant_scan(state, q8a, scale_a, csr_indptr, csr_nbr,
                                  q, q_valid, tenant, gate_on, boost_on,
                                  super_gate, k, slack, cap_take, max_nbr)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
     state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
                            nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  acc=n_acc, nbr=n_nbr)
 
 
 search_fused_quant, search_fused_quant_copy = _donated_pair(
@@ -1258,15 +1303,18 @@ def _dedup_topk(scores: jax.Array, rows: jax.Array, sentinel: int, k: int
     double access boost (the classic path dedups host-side in
     ``decode_topk``). ``scores`` is sorted descending (a top-k output), so
     keeping the first occurrence keeps the best. Invalid entries are
-    routed to the sentinel row with NEG_INF intact."""
+    routed to the sentinel row with NEG_INF intact. Also returns the
+    per-query count of live duplicates suppressed — the device-side
+    'dedup hits' counter riding the packed readback (ISSUE 6)."""
     r = jnp.where(scores > NEG_INF / 2, rows, sentinel)
     m = r.shape[1]
     dup = ((r[:, :, None] == r[:, None, :])
            & jnp.tri(m, k=-1, dtype=bool)[None, :, :]).any(-1)
+    n_dup = (dup & (r != sentinel)).sum(axis=-1).astype(jnp.int32)
     s = jnp.where(dup, NEG_INF, scores)
     top_s, sel = jax.lax.top_k(s, k)
     top_r = jnp.take_along_axis(r, sel, axis=1)
-    return top_s, jnp.where(top_s > NEG_INF / 2, top_r, sentinel)
+    return top_s, jnp.where(top_s > NEG_INF / 2, top_r, sentinel), n_dup
 
 
 def _ivf_two_tier(state: ArenaState, shadow, centroids: jax.Array,
@@ -1283,8 +1331,9 @@ def _ivf_two_tier(state: ArenaState, shadow, centroids: jax.Array,
     Shard-local by construction when given per-shard tables whose member/
     extras entries are LOCAL row indices (``ops.ivf.shard_serve_tables``):
     the gathers then only touch the chip's own arena slice. Returns
-    ``(gate_s [C], gate_r [C], ann_s [C,k], ann_r [C,k])`` with rows
-    routed to the sentinel (``state.capacity``) where invalid."""
+    ``(gate_s [C], gate_r [C], ann_s [C,k], ann_r [C,k], n_dup [C])``
+    with rows routed to the sentinel (``state.capacity``) where invalid;
+    ``n_dup`` counts the duplicates the in-kernel dedup dropped."""
     from lazzaro_tpu.ops.ivf import gather_rows
 
     cap = state.capacity
@@ -1348,9 +1397,9 @@ def _ivf_two_tier(state: ArenaState, shadow, centroids: jax.Array,
         gate_r0 = jnp.take_along_axis(g_rows_safe, g_sel, axis=1)[:, 0]
         a_rows = a_rows_safe
 
-    ann_s, ann_r = _dedup_topk(ann_ex, a_rows, cap, k)
+    ann_s, ann_r, n_dup = _dedup_topk(ann_ex, a_rows, cap, k)
     gate_r = jnp.where(gate_s > NEG_INF / 2, gate_r0, cap)
-    return gate_s, gate_r, ann_s, ann_r
+    return gate_s, gate_r, ann_s, ann_r, n_dup
 
 
 def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
@@ -1365,14 +1414,15 @@ def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
     then the shared gate/CSR/boost tail."""
 
     def body(q_c, valid_c, tenant_c, gate_c, boost_c):
-        gate_s, gate_r, ann_s, ann_r = _ivf_two_tier(
+        gate_s, gate_r, ann_s, ann_r, n_dup = _ivf_two_tier(
             state, shadow, centroids, members, extras, q_c, tenant_c, k,
             nprobe, slack)
         fast, acc_rows, nbr_rows = _gate_and_boost_rows(
             state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
             valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
             max_nbr)
-        return gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows
+        return (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows,
+                n_dup)
 
     return chunked_map_multi(body, (q, q_valid, tenant, gate_on, boost_on),
                              chunk=IVF_SERVE_CHUNK)
@@ -1407,14 +1457,16 @@ def _search_fused_ivf(
     centroid/member/extras tables and the optional int8 shadow are
     long-lived read-only replicas (the boost scatter touches salience/
     access/freshness, never embeddings or routing)."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
         _search_fused_ivf_scan(state, shadow, centroids, members, extras,
                                csr_indptr, csr_nbr, q, q_valid, tenant,
                                gate_on, boost_on, super_gate, k, nprobe,
                                slack, cap_take, max_nbr)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
     state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
                            nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
 
 
 search_fused_ivf, search_fused_ivf_copy = _donated_pair(
@@ -1436,11 +1488,11 @@ def search_fused_ivf_read(state: ArenaState, shadow, centroids: jax.Array,
     fleets in IVF mode): same coarse prefilter + candidate scan, no state
     mutation, no donation dance."""
     boost_off = jnp.zeros(q_valid.shape, bool)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_ivf_scan(
+    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = _search_fused_ivf_scan(
         state, shadow, centroids, members, extras, csr_indptr, csr_nbr, q,
         q_valid, tenant, gate_on, boost_off, super_gate, k, nprobe, slack,
         cap_take, max_nbr)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
 
 
 # ---------------------------------------------------------------------------
@@ -1539,7 +1591,9 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     def _scan_merge(arena, tables, q, tenant):
         """Shard-local two-tier candidates → globalize → ONE all_gather +
         global top-k per tier. Returns replicated (gate_s [Q], gate_r [Q],
-        ann_s [Q,k], ann_r [Q,k]) with GLOBAL row ids."""
+        ann_s [Q,k], ann_r [Q,k], n_dup [Q]) with GLOBAL row ids; the dup
+        counter (IVF in-kernel dedup hits, per-shard counts summed with a
+        tiny psum riding the same dispatch) is zero for the dense modes."""
         shard = jax.lax.axis_index(axis)
         local_n = arena.emb.shape[0]
         k_l = max(1, min(k, local_n))
@@ -1554,17 +1608,23 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
 
         def core(q_c, tenant_c):
             if mode == "exact":
-                return _exact_two_tier(arena, q_c, tenant_c, 1, k_l)
+                g_s, g_r, a_s, a_r = _exact_two_tier(arena, q_c, tenant_c,
+                                                     1, k_l)
+                return g_s, g_r, a_s, a_r, jnp.zeros(
+                    (q_c.shape[0],), jnp.int32)
             if mode == "quant":
-                return _quant_two_tier(arena, q8_l, scale_l, q_c, tenant_c,
-                                       k_l, slack)
-            g_s, g_r, a_s, a_r = _ivf_two_tier(arena, shadow_l, cent, mem_l,
-                                               ext_l, q_c, tenant_c, k_l,
-                                               nprobe, slack)
-            return g_s[:, None], g_r[:, None], a_s, a_r
+                g_s, g_r, a_s, a_r = _quant_two_tier(
+                    arena, q8_l, scale_l, q_c, tenant_c, k_l, slack)
+                return g_s, g_r, a_s, a_r, jnp.zeros(
+                    (q_c.shape[0],), jnp.int32)
+            g_s, g_r, a_s, a_r, n_dup = _ivf_two_tier(
+                arena, shadow_l, cent, mem_l, ext_l, q_c, tenant_c, k_l,
+                nprobe, slack)
+            return g_s[:, None], g_r[:, None], a_s, a_r, n_dup
 
-        g_s, g_r, a_s, a_r = chunked_map_multi(core, (q, tenant),
-                                               chunk=chunk)
+        g_s, g_r, a_s, a_r, dup_l = chunked_map_multi(core, (q, tenant),
+                                                      chunk=chunk)
+        n_dup = jax.lax.psum(dup_l, axis)
         ann_s, ann_r = sharded_topk_merge(
             axis, a_s, _globalize_rows(a_r, a_s, shard, local_n, n_shards),
             k)
@@ -1575,7 +1635,7 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
         # the merged top-k feeds both the packed readback and (in the
         # serve twins) the boost gather tail.
         return jax.lax.optimization_barrier(
-            (g_ms[:, 0], g_mr[:, 0], ann_s, ann_r))
+            (g_ms[:, 0], g_mr[:, 0], ann_s, ann_r, n_dup))
 
     def _boost_tail(arena, indptr_l, nbr_l, ann_s, ann_r, fast, q_valid,
                     tenant, boost_on, now, acc_boost, nbr_boost):
@@ -1618,25 +1678,36 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
                   & (arena.tenant_id[nsafe] == tenant[:, None]))
         nbr_idx = jnp.where(nvalid & ~dup & ~in_res, nloc, local_n)
         acc_idx = jnp.where(mine, loc, local_n)
+        # Device-side boost counters for the readback tail: the access
+        # rows are replicated arithmetic (count once, identically on every
+        # chip); the neighbor validity checks are per-owner, so the
+        # per-chip counts sum with one tiny psum inside the same dispatch.
+        n_acc = (acc_rows != sent).sum(axis=-1).astype(jnp.int32)
+        n_nbr = jax.lax.psum(
+            (nbr_idx != local_n).sum(axis=-1).astype(jnp.int32), axis)
         return _boost_scatter(arena, acc_idx, nbr_idx, now, acc_boost,
-                              nbr_boost, zero_last=False)
+                              nbr_boost, zero_last=False), n_acc, n_nbr
 
     def _serve_local(arena, tables, indptr2, nbr2, q, q_valid, tenant,
                      gate_on, boost_on, now, super_gate, acc_boost,
                      nbr_boost):
-        gate_s, gate_r, ann_s, ann_r = _scan_merge(arena, tables, q, tenant)
+        gate_s, gate_r, ann_s, ann_r, n_dup = _scan_merge(arena, tables, q,
+                                                          tenant)
         fast = gate_on & (gate_s > super_gate)
-        packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
-        arena = _boost_tail(arena, indptr2[0], nbr2[0], ann_s, ann_r, fast,
-                            q_valid, tenant, boost_on, now, acc_boost,
-                            nbr_boost)
+        arena, n_acc, n_nbr = _boost_tail(
+            arena, indptr2[0], nbr2[0], ann_s, ann_r, fast, q_valid,
+            tenant, boost_on, now, acc_boost, nbr_boost)
+        packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                 dup=n_dup, acc=n_acc, nbr=n_nbr)
         return arena, packed
 
     def _read_local(arena, tables, indptr2, nbr2, q, q_valid, tenant,
                     gate_on, super_gate):
-        gate_s, gate_r, ann_s, ann_r = _scan_merge(arena, tables, q, tenant)
+        gate_s, gate_r, ann_s, ann_r, n_dup = _scan_merge(arena, tables, q,
+                                                          tenant)
         fast = gate_on & (gate_s > super_gate)
-        return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+        return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                               dup=n_dup)
 
     state_specs = ArenaState(
         emb=P(axis, None), salience=P(axis), timestamp=P(axis),

@@ -12,6 +12,13 @@ surface:
   GET  /api/profile      → profile domains
   POST /api/consolidate  → run_consolidation
 
+Observability additions (ISSUE 6, no reference counterpart):
+  GET  /metrics          → Prometheus text exposition of the system's
+                           Telemetry registry (serving spans, device-side
+                           readback counters, pad-waste, peak-HBM gauges)
+  GET  /api/metrics      → the same registry as JSON
+                           (``MemorySystem.metrics_summary()``)
+
 Differences by design: built on stdlib ``http.server`` (zero extra deps in
 this image; FastAPI optional elsewhere), and the UI is fully self-contained
 vanilla JS + canvas (the reference pulls Vue/Tailwind/force-graph from CDNs,
@@ -103,7 +110,28 @@ class DashboardHandler(BaseHTTPRequestHandler):
             self._send({"error": "Memory system not initialized"}, 503)
             return
         with _ms_lock:
-            if url.path == "/api/stats":
+            if url.path == "/metrics":
+                # Prometheus scrape surface: the SAME registry
+                # metrics_summary() reads, rendered as text exposition —
+                # plus the derived headline gauges so a scrape alone
+                # carries the pad-waste/queue-wait numbers CI checks.
+                summary = ms.metrics_summary()
+                extra = []
+                for key in ("pad_waste_fraction", "queue_wait_ms_p50",
+                            "queue_wait_ms_p95", "serve_dispatches",
+                            "ingest_dispatches", "link_pool_overflows"):
+                    val = summary.get(key)
+                    if val is not None:
+                        extra.append(f"lazzaro_{key} {val}")
+                body = ms.telemetry.prometheus()
+                if extra:
+                    body += "\n".join(extra) + "\n"
+                self._send(body,
+                           content_type="text/plain; version=0.0.4; "
+                                        "charset=utf-8")
+            elif url.path == "/api/metrics":
+                self._send(ms.metrics_summary())
+            elif url.path == "/api/stats":
                 ms.check_for_updates()
                 stats = ms.get_stats()
                 stats["user_id"] = ms.user_id

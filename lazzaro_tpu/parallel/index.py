@@ -53,6 +53,9 @@ from lazzaro_tpu.parallel.mesh import shard_stacked
 from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
                                         next_pow2, pad_to_pow2,
                                         unpack_retrieval)
+from lazzaro_tpu.utils.compat import trace_annotation
+from lazzaro_tpu.utils.telemetry import (default_registry, peak_bytes,
+                                         record_device_counters)
 
 NEG_INF = -1e30
 
@@ -80,8 +83,17 @@ class ShardedMemoryIndex:
                  coarse_slack: int = 8, cap_take: int = 5,
                  max_nbr: int = 32, super_gate: float = 0.4,
                  acc_boost: float = 0.05, nbr_boost: float = 0.02,
-                 epoch: Optional[float] = None):
+                 epoch: Optional[float] = None, telemetry=None,
+                 telemetry_hbm: bool = False):
         self.mesh = mesh
+        # Serving telemetry (ISSUE 6): same registry contract as
+        # MemoryIndex — spans per dispatch, device counters decoded from
+        # the packed readback tail, opt-in peak-HBM gauges per kernel.
+        self.telemetry = telemetry if telemetry is not None \
+            else default_registry()
+        self.telemetry_hbm = bool(telemetry_hbm)
+        self._hbm_recorded: set = set()
+        self.dispatch_count = 0
         self.axis = axis
         self.dim = dim
         self.n_parts = mesh.shape[axis]
@@ -222,8 +234,12 @@ class ShardedMemoryIndex:
             self.state = out
 
     # The device-program entry point every serve goes through — tests and
-    # bench wrap it to count dispatches (one call == one dispatch).
+    # bench wrap it to count dispatches (one call == one dispatch). The
+    # count ALSO lands in the telemetry registry (ISSUE 6 satellite: it
+    # used to be reachable only by wrapping this hook).
     def _dispatch(self, fn, *args, **kwargs):
+        self.dispatch_count += 1
+        self.telemetry.bump("serve.dispatches", labels={"mode": "pod"})
         return fn(*args, **kwargs)
 
     # ------------------------------------------------------------------- api
@@ -480,6 +496,9 @@ class ShardedMemoryIndex:
                 cap_take=min(self.cap_take, k_bucket), max_nbr=self.max_nbr,
                 mode=mode, slack=self.coarse_slack, nprobe=nprobe)
             self._fused_cache[key] = kern
+            self.telemetry.gauge("kernel.cache_entries",
+                                 len(self._fused_cache),
+                                 labels={"surface": "pod_fused"})
         return kern
 
     def serve_requests(self, reqs) -> List:
@@ -523,6 +542,13 @@ class ShardedMemoryIndex:
         k_bucket = min(max(next_pow2(k_eff), 1), self.capacity)
         qp = pad_to_pow2(q)
         pad_n = qp.shape[0]
+        tel = self.telemetry
+        # Coalesce/pad inflation baseline for ROADMAP item 4 (ragged
+        # serving): padded kernel slots vs live requests, max-k bucket.
+        tel.bump("serve.live_requests", nq)
+        tel.bump("serve.padded_slots", pad_n)
+        tel.gauge("serve.batch_occupancy", nq / pad_n)
+        tel.record("serve.k_bucket", k_bucket)
 
         def padb(arr, fill=False, dt=bool):
             out = np.full((pad_n,), fill, dt)
@@ -550,39 +576,72 @@ class ShardedMemoryIndex:
                 jnp.asarray(padb(valid)),
                 jnp.asarray(padb(tids, -1, np.int32)),
                 jnp.asarray(padb(gate_on)))
-        if boost_on.any():
-            now_rel = time.time() - self.epoch
-            with self._state_lock:
-                cur = self._arena
-                fn = (kern.serve
-                      if sys.getrefcount(cur) <= self._SOLE_REFS
-                      else kern.serve_copy)
-                new_state, packed = self._dispatch(
-                    fn, cur, *args, jnp.asarray(padb(boost_on)),
-                    jnp.float32(now_rel), jnp.float32(self.super_gate),
-                    jnp.float32(self.acc_boost), jnp.float32(self.nbr_boost))
-                del cur
-                self.state = new_state
-        else:
-            packed = self._dispatch(kern.read, self.state, *args,
-                                    jnp.float32(self.super_gate))
-        host = np.asarray(packed)              # the ONE readback
-        gate_s, gate_r, ann_s, ann_r, fast = unpack_retrieval(host[:nq],
-                                                              k_bucket)
-        for i, r in enumerate(reqs):
-            if not valid[i]:
-                continue
-            res = results[i]
-            ids, scores = decode_topk(ann_s[i:i + 1], ann_r[i:i + 1],
-                                      self.row_to_id, NEG_INF,
-                                      limit=min(int(r.k), self.capacity))[0]
-            res.ids, res.scores = ids, scores
-            if gate_s[i] > NEG_INF / 2:
-                res.gate_id = self.row_to_id.get(int(gate_r[i]))
-                res.gate_score = float(gate_s[i])
-            res.fast = bool(fast[i])
-            res.boosted = bool(boost_on[i] and not fast[i])
+        self._maybe_record_hbm(mode, kern, args, k_bucket)
+        t0 = time.perf_counter()
+        with trace_annotation(f"lz.serve.pod_{mode}"):
+            if boost_on.any():
+                now_rel = time.time() - self.epoch
+                with self._state_lock:
+                    cur = self._arena
+                    fn = (kern.serve
+                          if sys.getrefcount(cur) <= self._SOLE_REFS
+                          else kern.serve_copy)
+                    new_state, packed = self._dispatch(
+                        fn, cur, *args, jnp.asarray(padb(boost_on)),
+                        jnp.float32(now_rel), jnp.float32(self.super_gate),
+                        jnp.float32(self.acc_boost),
+                        jnp.float32(self.nbr_boost))
+                    del cur
+                    self.state = new_state
+            else:
+                packed = self._dispatch(kern.read, self.state, *args,
+                                        jnp.float32(self.super_gate))
+            host = np.asarray(packed)          # the ONE readback
+        tel.record("serve.dispatch_ms", (time.perf_counter() - t0) * 1e3,
+                   labels={"mode": f"pod_{mode}"})
+        with tel.span("serve.decode_ms"):
+            gate_s, gate_r, ann_s, ann_r, fast, counters = unpack_retrieval(
+                host[:nq], k_bucket)
+            for i, r in enumerate(reqs):
+                if not valid[i]:
+                    continue
+                res = results[i]
+                ids, scores = decode_topk(
+                    ann_s[i:i + 1], ann_r[i:i + 1], self.row_to_id,
+                    NEG_INF, limit=min(int(r.k), self.capacity))[0]
+                res.ids, res.scores = ids, scores
+                if gate_s[i] > NEG_INF / 2:
+                    res.gate_id = self.row_to_id.get(int(gate_r[i]))
+                    res.gate_score = float(gate_s[i])
+                res.fast = bool(fast[i])
+                res.boosted = bool(boost_on[i] and not fast[i])
+        record_device_counters(
+            tel, counters, fast, gate_on, valid,
+            np.asarray([min(int(r.k), self.capacity) for r in reqs]))
         return results
+
+    def _maybe_record_hbm(self, mode: str, kern, args, k_bucket) -> None:
+        """Opt-in peak-HBM gauge for one pod serving geometry (AOT lower +
+        ``memory_analysis()`` of the read twin; one extra compile, zero
+        extra dispatches)."""
+        if not self.telemetry_hbm:
+            return
+        key = (mode, k_bucket)
+        if key in self._hbm_recorded:
+            return
+        self._hbm_recorded.add(key)
+        try:
+            peak = peak_bytes(kern.read.lower(
+                self.state, *args, jnp.float32(self.super_gate)
+            ).compile().memory_analysis())
+        except Exception:   # noqa: BLE001 — never fail the serve
+            return
+        if peak is not None:
+            self.telemetry.gauge(
+                "kernel.peak_hbm_bytes", peak,
+                labels={"mode": f"pod_{mode}", "k": str(k_bucket),
+                        "rows": str(self.capacity + 1),
+                        "mesh": f"{self.n_parts}x{self.axis}"})
 
     def _serve_classic(self, reqs, results, valid, qp, tids, k_bucket):
         """The pre-ISSUE-5 pod path, kept for A/B and fallback: ONE

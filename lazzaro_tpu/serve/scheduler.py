@@ -53,6 +53,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from lazzaro_tpu.utils.batching import FlushPolicy
+from lazzaro_tpu.utils.compat import step_trace_annotation
+from lazzaro_tpu.utils.telemetry import default_registry
 
 
 @dataclass
@@ -97,8 +99,15 @@ class QueryScheduler:
     amortizes). ``close()`` drains pending work before returning."""
 
     def __init__(self, executor: Executor, max_batch: int = 64,
-                 max_wait_us: int = 2000, name: str = "lz-query-scheduler"):
+                 max_wait_us: int = 2000, name: str = "lz-query-scheduler",
+                 telemetry=None):
         self._executor = executor
+        # Serving telemetry (ISSUE 6): every request records its
+        # enqueue→flush queue wait (per-tenant label), every flushed batch
+        # one batch-size sample — N coalesced requests therefore yield N
+        # queue-wait samples and the executor's ONE dispatch sample.
+        self.telemetry = telemetry if telemetry is not None \
+            else default_registry()
         self.policy = FlushPolicy(max_batch, max_wait_us / 1e6)
         self._cond = threading.Condition()
         self._pending: List[Tuple[RetrievalRequest, Future, float]] = []
@@ -162,8 +171,17 @@ class QueryScheduler:
 
     def _execute(self, batch) -> None:
         reqs = [req for req, _, _ in batch]
+        flush_t = time.time()
+        for req, _, enq in batch:
+            self.telemetry.record("serve.queue_wait_ms",
+                                  (flush_t - enq) * 1e3,
+                                  labels={"tenant": req.tenant})
         try:
-            results = self._executor(reqs)
+            # one mega-batch == one profiler step, so TPU captures line up
+            # with the host spans batch-for-batch
+            with step_trace_annotation("lz.serve.batch",
+                                       self.batches_flushed):
+                results = self._executor(reqs)
         except Exception as e:                      # noqa: BLE001 — demuxed
             for _, fut, _ in batch:
                 if not fut.cancelled():
@@ -171,6 +189,9 @@ class QueryScheduler:
             return
         self.batches_flushed += 1
         self.requests_served += len(batch)
+        self.telemetry.bump("serve.requests", len(batch))
+        self.telemetry.bump("serve.batches")
+        self.telemetry.record("serve.batch_requests", len(batch))
         self.batch_sizes.append(len(batch))
         if len(self.batch_sizes) > 1024:
             del self.batch_sizes[:512]

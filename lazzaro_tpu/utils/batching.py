@@ -103,20 +103,34 @@ def empty_results(n: int) -> List[Tuple[List[str], List[float]]]:
     return [([], []) for _ in range(n)]
 
 
+# Column names of the device-counter tail every fused serving readback
+# carries (core.state.RETRIEVAL_TAIL int32 columns after the fast bit):
+# live top-k hits, in-kernel dedup drops, access-boost rows scattered,
+# neighbor-boost rows scattered.
+RETRIEVAL_COUNTERS = ("live", "dedup_dropped", "acc_boost_rows",
+                      "nbr_boost_rows")
+
+
 def unpack_retrieval(host: np.ndarray, k: int
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
-                                np.ndarray, np.ndarray]:
+                                np.ndarray, np.ndarray, np.ndarray]:
     """Host half of ``core.state._pack_retrieval``: split the ONE
-    [Q, 3 + 2k] packed readback into (gate_scores, gate_rows, ann_scores,
-    ann_rows, fast). Row columns were bitcast (not cast) on device, so the
-    int view reverses them losslessly. Shared by the single-chip and the
+    [Q, 3 + 2k + 4] packed readback into (gate_scores, gate_rows,
+    ann_scores, ann_rows, fast, counters). Row and counter columns were
+    bitcast (not cast) on device, so the int view reverses them
+    losslessly; ``counters`` is the [Q, 4] int32 device-counter tail
+    (column names in :data:`RETRIEVAL_COUNTERS` — ISSUE 6 observability
+    riding the existing transfer). Shared by the single-chip and the
     pod-sharded fused serving decoders."""
     ann_s = host[:, 2:2 + k]
     ann_r = np.ascontiguousarray(host[:, 2 + k:2 + 2 * k]).view(np.int32)
     gate_s = host[:, 0]
     gate_r = np.ascontiguousarray(host[:, 1:2]).view(np.int32)[:, 0]
     fast = host[:, 2 + 2 * k] > 0.5
-    return gate_s, gate_r, ann_s, ann_r, fast
+    counters = np.ascontiguousarray(
+        host[:, 3 + 2 * k:3 + 2 * k + len(RETRIEVAL_COUNTERS)]
+    ).view(np.int32)
+    return gate_s, gate_r, ann_s, ann_r, fast, counters
 
 
 class FlushPolicy:

@@ -28,3 +28,52 @@ def shard_map(f=None, **kwargs):
     if f is None:                       # decorator-style usage
         return lambda g: _shard_map(g, **kwargs)
     return _shard_map(f, **kwargs)
+
+
+# --- profiler annotations ---------------------------------------------------
+# The serving telemetry layer wraps every fused dispatch in a profiler
+# annotation so TPU profiler captures (``jax.profiler.trace``) line up with
+# the host-side Telemetry spans. jax 0.4.37 ships both TraceAnnotation and
+# StepTraceAnnotation under ``jax.profiler``; older/newer pins may move or
+# drop them, so the serving path imports the shimmed constructors here and
+# degrades to a no-op context manager instead of crashing the hot path.
+
+try:
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except ImportError:                     # pragma: no cover - toolchain variance
+    _TraceAnnotation = None
+
+try:
+    from jax.profiler import StepTraceAnnotation as _StepTraceAnnotation
+except ImportError:                     # pragma: no cover - toolchain variance
+    _StepTraceAnnotation = None
+
+
+class _NullAnnotation:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullAnnotation()
+
+
+def trace_annotation(name: str):
+    """Context manager marking a named region on the device-profiler
+    timeline (``jax.profiler.TraceAnnotation``), or a no-op when this jax
+    doesn't expose it. Cheap enough for the per-dispatch hot path."""
+    if _TraceAnnotation is None:
+        return _NULL
+    return _TraceAnnotation(name)
+
+
+def step_trace_annotation(name: str, step_num: int):
+    """``jax.profiler.StepTraceAnnotation`` (gives profiler tooling a step
+    axis — one serving mega-batch == one step), or a no-op shim."""
+    if _StepTraceAnnotation is None:
+        return _NULL
+    return _StepTraceAnnotation(name, step_num=step_num)

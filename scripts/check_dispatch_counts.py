@@ -23,6 +23,19 @@ the paths passed as arguments) and exits nonzero if:
     record a measured ``dispatches_per_turn`` at all — a pod-path stage
     that stops measuring its dispatch count must fail loudly, not pass
     vacuously,
+  - (ISSUE 6) a post-observability artifact measuring a fused path (any
+    dict carrying ``dispatches_per_turn``) has NO ``telemetry`` block —
+    every fused bench stage embeds ``bench._telemetry_block`` (pad-waste
+    fraction, batch occupancy, queue-wait p50/p95, peak-HBM gauges) so
+    the ragged-serving and HBM-budget directions always have a measured
+    baseline; pre-ISSUE-6 artifacts (``pr2_``…``pr5_`` prefixes) are
+    grandfathered,
+  - (ISSUE 6) a ``telemetry`` block is malformed — missing the required
+    keys — or its registry snapshot PROVES padding waste happened
+    (``serve.padded_slots`` > ``serve.live_requests``) while the block's
+    ``pad_waste_fraction`` fails to record it: measured waste that the
+    artifact under-reports is the one observability regression this
+    whole layer exists to prevent,
 
 so any of these regressions turns red in CI instead of shipping.
 
@@ -37,8 +50,15 @@ import json
 import os
 import sys
 
+# Artifacts from before the observability layer existed: exempt from the
+# telemetry-block requirement (their numbers are still gate-checked).
+_PRE_TELEMETRY_PREFIXES = ("pr2_", "pr3_", "pr4_", "pr5_")
 
-def _walk(obj, path, hits, recalls, speedups, meshes):
+_TELEMETRY_KEYS = ("pad_waste_fraction", "queue_wait_ms_p50",
+                   "queue_wait_ms_p95", "peak_hbm_bytes")
+
+
+def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -47,15 +67,52 @@ def _walk(obj, path, hits, recalls, speedups, meshes):
                              obj["speedup_floor"]))
         if isinstance(obj.get("mesh"), dict):
             meshes.append((path, "dispatches_per_turn" in obj))
+        if "dispatches_per_turn" in obj or "telemetry" in obj:
+            tel_blocks.append((path, "dispatches_per_turn" in obj,
+                               obj.get("telemetry")))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k == "dispatches_per_turn":
                 hits.append((here, v))
             else:
-                _walk(v, here, hits, recalls, speedups, meshes)
+                _walk(v, here, hits, recalls, speedups, meshes, tel_blocks)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
-            _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes)
+            _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
+                  tel_blocks)
+
+
+def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
+    """The ISSUE 6 observability gate on one artifact dict."""
+    if block is None:
+        if measured_fused and not grandfathered:
+            bad.append((loc, "fused-path artifact (has dispatches_per_turn)"
+                             " records no 'telemetry' block"))
+        return
+    if not isinstance(block, dict):
+        bad.append((loc, f"'telemetry' is {type(block).__name__}, "
+                         f"expected a dict"))
+        return
+    for key in _TELEMETRY_KEYS:
+        if key not in block:
+            bad.append((loc, f"telemetry block missing '{key}'"))
+    counters = (block.get("snapshot") or {}).get("counters") or {}
+    live = sum(v for k, v in counters.items()
+               if k.split("{")[0] == "serve.live_requests")
+    padded = sum(v for k, v in counters.items()
+                 if k.split("{")[0] == "serve.padded_slots")
+    if padded > live > 0:
+        truth = 1.0 - live / padded
+        got = block.get("pad_waste_fraction")
+        try:
+            ok = abs(float(got) - truth) < 1e-3
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            bad.append((loc, f"padding waste happened (padded_slots="
+                             f"{padded} > live_requests={live}, waste="
+                             f"{truth:.4f}) but pad_waste_fraction "
+                             f"records {got!r}"))
 
 
 def main(argv):
@@ -69,6 +126,7 @@ def main(argv):
     checked_recall = 0
     checked_speedup = 0
     checked_mesh = 0
+    checked_telemetry = 0
     bad = []
     for p in paths:
         try:
@@ -77,8 +135,14 @@ def main(argv):
         except (OSError, ValueError) as e:
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
-        hits, recalls, speedups, meshes = [], [], [], []
-        _walk(data, os.path.basename(p), hits, recalls, speedups, meshes)
+        hits, recalls, speedups, meshes, tel_blocks = [], [], [], [], []
+        _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
+              tel_blocks)
+        grandfathered = os.path.basename(p).startswith(
+            _PRE_TELEMETRY_PREFIXES)
+        for loc, measured_fused, block in tel_blocks:
+            checked_telemetry += 1
+            _check_telemetry(loc, measured_fused, block, grandfathered, bad)
         for loc, v in hits:
             checked += 1
             if v != 1:
@@ -111,7 +175,8 @@ def main(argv):
         print(f"REGRESSION: {loc}: {msg}")
     print(f"[check] {checked} dispatches_per_turn value(s), "
           f"{checked_recall} recall pair(s), {checked_speedup} speedup "
-          f"pair(s), and {checked_mesh} sharded artifact(s) across "
+          f"pair(s), {checked_mesh} sharded artifact(s), and "
+          f"{checked_telemetry} telemetry block(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 
