@@ -107,9 +107,50 @@ class MemoryConfig:
     # serve_batch_max requests OR when its oldest request has waited
     # serve_flush_us microseconds — bursty load coalesces, a lone request
     # is never held hostage. Batches pad to power-of-two buckets so jit
-    # specializations stay bounded.
+    # specializations stay bounded. With serve_continuous (default) the
+    # wait only ever applies while a dispatch is in flight — an idle
+    # scheduler ships immediately.
     serve_batch_max: int = 64
     serve_flush_us: int = 2000
+    # Continuous batching (ISSUE 7): instead of flush-boundary mega-
+    # batches, the scheduler admits pending requests into the next
+    # dispatch the moment the worker is free — a lone request on an idle
+    # scheduler dispatches immediately (no serve_flush_us wait), and
+    # requests arriving while a dispatch is in flight coalesce naturally
+    # into the next one (the in-flight dispatch IS the batching window).
+    # Off = the PR 6 flush-boundary policy (A/B + fallback).
+    serve_continuous: bool = True
+    # Per-tenant admission control for continuous batching: at most this
+    # many of one tenant's requests are admitted into a single dispatch
+    # (oldest-first across tenants; over-cap requests stay queued for the
+    # next dispatch, so one flooding tenant cannot monopolize the batch).
+    # 0 = unlimited.
+    serve_tenant_max_inflight: int = 0
+    # Ragged fused serving (ISSUE 7): per-query k / cap_take / nprobe
+    # ride into the kernel as int32 sidecar columns (device data) instead
+    # of trace constants — the scan bodies compute to the serve_k_max
+    # ceiling and mask each query at its own top-k boundary, so ONE
+    # compiled kernel per (mode × geometry) serves any mix of request
+    # shapes: a k=100 request no longer re-keys the whole batch's kernel,
+    # and mixed-k traffic stops burning compile-cache entries. Off = the
+    # PR 6 per-(mode × batch-max-k-bucket) kernels.
+    serve_ragged: bool = True
+    # Static per-query k ceiling of the ragged kernels (requests clamp to
+    # it; raising it retraces once per mode). 128 covers the classic API
+    # surface (ann_limit, retrieval caps) with headroom.
+    serve_k_max: int = 128
+    # Query-batch padding granularity of the ragged path: batches pad to
+    # the next multiple of this instead of the next power of two — worst-
+    # case padded waste drops from ~50% of the dispatch to granularity-1
+    # slots, and jit specializations stay bounded by
+    # serve_batch_max / granularity buckets.
+    serve_pad_granularity: int = 8
+    # LRU cap on the compiled serving-kernel caches (single-chip sharded
+    # factory cache and the pod index's fused cache): with ragged kernels
+    # the keys collapse to per-mode entries anyway; the cap evicts stale
+    # per-k-bucket kernels left behind by non-ragged traffic instead of
+    # letting kernel.cache_entries grow without bound.
+    serve_kernel_cache_max: int = 8
     # Neighbor-gather width of the fused retrieval kernel: at most this
     # many CSR neighbors per retrieved row receive the neighbor-salience
     # boost on device. Nodes with higher degree get a truncated boost set

@@ -245,7 +245,8 @@ def save_index(index: MemoryIndex, ckpt_dir: str,
 
 def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
                int8_serving: bool = False, ivf_nprobe: int = 0,
-               pq_serving: bool = False, coarse_slack: int = 8) -> MemoryIndex:
+               pq_serving: bool = False, coarse_slack: int = 8,
+               **index_kwargs) -> MemoryIndex:
     """Rebuild a MemoryIndex from the snapshot ``CURRENT`` points at.
 
     ``mesh``: restore row-sharded over the mesh axis (the saved total row
@@ -273,7 +274,8 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
     index = MemoryIndex(meta["dim"], capacity=1, edge_capacity=1, dtype=dt,
                         epoch=meta["epoch"], mesh=mesh, shard_axis=shard_axis,
                         int8_serving=int8_serving, ivf_nprobe=ivf_nprobe,
-                        pq_serving=pq_serving, coarse_slack=coarse_slack)
+                        pq_serving=pq_serving, coarse_slack=coarse_slack,
+                        **index_kwargs)
     index.state = arena        # setter re-shards over the mesh if given
     index.edge_state = edges
 

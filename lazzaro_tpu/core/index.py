@@ -25,8 +25,10 @@ import numpy as np
 
 from lazzaro_tpu.core import state as S
 from lazzaro_tpu.ops import graphops
-from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
-                                        fetch_packed, next_pow2, pad_to_pow2,
+from lazzaro_tpu.utils.batching import (LRUKernelCache, bucket_size,
+                                        decode_topk, empty_results,
+                                        fetch_packed, next_pow2,
+                                        pad_to_bucket, pad_to_pow2,
                                         unpack_retrieval)
 from lazzaro_tpu.utils.compat import trace_annotation
 from lazzaro_tpu.utils.telemetry import (default_registry, peak_bytes,
@@ -104,7 +106,10 @@ class MemoryIndex:
                  mesh=None, shard_axis: str = "data",
                  int8_serving: bool = False, ivf_nprobe: int = 0,
                  pq_serving: bool = False, coarse_slack: int = 8,
-                 telemetry=None, telemetry_hbm: bool = False):
+                 telemetry=None, telemetry_hbm: bool = False,
+                 serve_ragged: bool = True, serve_k_max: int = 128,
+                 serve_pad_granularity: int = 8,
+                 serve_kernel_cache_max: int = 8):
         self.dim = dim
         self.dtype = dtype
         # Serving telemetry (ISSUE 6): spans + device counters land in this
@@ -215,11 +220,26 @@ class MemoryIndex:
         self._tenants: Dict[str, int] = {}
         self._shards: Dict[str, int] = {}
         self.tenant_nodes: Dict[str, set] = {}
-        self._mesh_topk_cache: Dict[int, object] = {}
+        # Ragged fused serving (ISSUE 7): per-query k/cap/nprobe ride as
+        # int32 sidecar columns, the kernels compute to the serve_k_max
+        # ceiling and mask per query — one compiled kernel per
+        # (mode × geometry), any mix of request shapes.
+        self.serve_ragged = bool(serve_ragged)
+        self.serve_k_max = max(1, int(serve_k_max))
+        self.serve_pad_granularity = max(1, int(serve_pad_granularity))
+        # Distinct fused serving-kernel keys this index has dispatched
+        # (mode + statics — with ragged on, exactly one per mode); the
+        # bench's compile_cache_entries measurement and the
+        # kernel.cache_entries{surface="single_fused"} gauge read it.
+        self._serve_kernel_keys: set = set()
+        self._mesh_topk_cache = LRUKernelCache(serve_kernel_cache_max)
         # Distributed fused serving programs (ISSUE 5): under a mesh the
         # whole chat-turn program runs as ONE shard_map dispatch
-        # (state.make_fused_sharded), cached per (mode, k, take, nbr).
-        self._fused_sharded_cache: Dict[tuple, object] = {}
+        # (state.make_fused_sharded) — with ragged serving cached per
+        # MODE, otherwise per (mode, k-bucket, take, nbr). LRU-capped
+        # (ISSUE 7 satellite): mixed-k non-ragged traffic used to grow
+        # this without bound while kernel.cache_entries just watched.
+        self._fused_sharded_cache = LRUKernelCache(serve_kernel_cache_max)
         # CSR adjacency shadow for the fused retrieval kernel: a device
         # (indptr, neighbors) pair built from the HOST edge map (edge_slots
         # + id_to_row — no device readback needed), invalidated by edge
@@ -1405,14 +1425,16 @@ class MemoryIndex:
         """Cached shard_map distributed top-k (ops/topk.py) per (k, mode)
         bucket."""
         key = ("int8", k) if int8 else k
-        if key not in self._mesh_topk_cache:
+        kern = self._mesh_topk_cache.get(key)
+        if kern is None:
             from lazzaro_tpu.ops.topk import (make_sharded_int8_topk,
                                               make_sharded_topk)
-            self._mesh_topk_cache[key] = (
+            kern = (
                 make_sharded_int8_topk(self.mesh, self.shard_axis, k=k)
                 if int8 else
                 make_sharded_topk(self.mesh, self.shard_axis, k=k, impl="auto"))
-        return self._mesh_topk_cache[key]
+            self._mesh_topk_cache.put(key, kern)
+        return kern
 
     # ------------------------------------------------- fused retrieval path
     def _csr_for(self, st: S.ArenaState):
@@ -1478,14 +1500,24 @@ class MemoryIndex:
         st = self.state
         cap = st.capacity
         dim = self.dim
-        k_eff = max(cap_take, max((min(int(r.k), cap) for r in reqs),
-                                  default=1), 1)
-        k_bucket = min(max(next_pow2(k_eff), 1), cap)
+        ragged = self.serve_ragged
+        if ragged:
+            # Static per-mode k CEILING (ISSUE 7): every request clamps to
+            # it, so the kernel key never depends on the batch's k mix —
+            # one compiled program per (mode × geometry) serves k∈{4..128}
+            # in one dispatch. Per-request k rides as device data below.
+            k_bucket = int(min(max(self.serve_k_max, cap_take, 1), cap))
+        else:
+            k_eff = max(cap_take, max((min(int(r.k), cap) for r in reqs),
+                                      default=1), 1)
+            k_bucket = min(max(next_pow2(k_eff), 1), cap)
         q = np.zeros((nq, dim), np.float32)
         valid = np.zeros((nq,), bool)
         tenants = np.full((nq,), -1, np.int32)
         gate_on = np.zeros((nq,), bool)
         boost_on = np.zeros((nq,), bool)
+        k_arr = np.zeros((nq,), np.int32)
+        cap_arr = np.zeros((nq,), np.int32)
         for i, r in enumerate(reqs):
             v = np.asarray(r.query, np.float32).reshape(-1)
             tid = self._tenants.get(r.tenant)
@@ -1496,18 +1528,32 @@ class MemoryIndex:
             tenants[i] = tid
             gate_on[i] = bool(r.gate_enabled)
             boost_on[i] = bool(r.boost)
+            if ragged:
+                # k_q ≥ cap so the boosted prefix is always live (the
+                # non-ragged path guaranteed the same via k_eff ≥ cap_take)
+                k_arr[i] = min(max(int(r.k), cap_take, 1), k_bucket)
+                rc = getattr(r, "cap_take", None)
+                cap_arr[i] = min(int(rc) if rc else cap_take, cap_take,
+                                 k_bucket)
         if not valid.any():
             return results
-        qp = pad_to_pow2(q)
+        # Ragged batches pad to a LINEAR granularity bucket instead of the
+        # next power of two: worst-case padded waste drops from ~50% of
+        # the dispatch to granularity-1 slots (the pow2 padding tax this
+        # PR kills), with jit specializations still bounded.
+        qp = (pad_to_bucket(q, self.serve_pad_granularity) if ragged
+              else pad_to_pow2(q))
         pad_n = qp.shape[0]
         tel = self.telemetry
         # Coalesce/pad inflation: padded kernel slots vs live requests and
-        # the per-batch max-k bucket — the pow2 padding tax ROADMAP item 4
-        # (ragged serving) needs a measured baseline for.
+        # the kernel k (per-batch max-k bucket, or the ragged ceiling).
         tel.bump("serve.live_requests", nq)
         tel.bump("serve.padded_slots", pad_n)
         tel.gauge("serve.batch_occupancy", nq / pad_n)
         tel.record("serve.k_bucket", k_bucket)
+        if ragged:
+            for kv in k_arr[valid]:
+                tel.record("serve.k_request", float(kv))
 
         def padb(arr, fill=False, dt=bool):
             out = np.full((pad_n,), fill, dt)
@@ -1522,7 +1568,8 @@ class MemoryIndex:
                 packed = self._dispatch_fused_sharded(
                     st, indptr, nbr, qp, padb, valid, tenants, gate_on,
                     boost_on, k_bucket, cap_take, max_nbr, super_gate,
-                    acc_boost, nbr_boost, now)
+                    acc_boost, nbr_boost, now, ragged=ragged,
+                    k_arr=k_arr, cap_arr=cap_arr)
                 host = np.asarray(packed)      # the ONE readback
             tel.record("serve.dispatch_ms",
                        (time.perf_counter() - t0) * 1e3,
@@ -1533,7 +1580,9 @@ class MemoryIndex:
                     unpack_retrieval(host[:nq], k_bucket)
                 out = self._demux_fused(reqs, results, valid, boost_on,
                                         gate_s, gate_r, ann_s, ann_r, fast,
-                                        cap)
+                                        cap,
+                                        lengths=(counters[:, 0] if ragged
+                                                 else None))
             record_device_counters(
                 tel, counters, fast, gate_on[:nq], valid[:nq],
                 np.asarray([min(int(r.k), cap) for r in reqs]))
@@ -1542,7 +1591,8 @@ class MemoryIndex:
                 jnp.asarray(padb(valid)),
                 jnp.asarray(padb(tenants, -1, np.int32)),
                 jnp.asarray(padb(gate_on)))
-        statics = dict(k=k_bucket, cap_take=cap_take, max_nbr=max_nbr)
+        statics = dict(k=k_bucket, cap_take=min(cap_take, k_bucket),
+                       max_nbr=max_nbr)
         # Quantized fused serving (ISSUE 3): with the int8 shadow active the
         # SAME single-dispatch program streams the int8 codes for the
         # coarse top-(k+slack), exactly rescores the survivors from the
@@ -1565,8 +1615,27 @@ class MemoryIndex:
             statics["slack"] = self.coarse_slack
         mode = ("ivf" if ivf_tabs is not None
                 else "quant" if use_quant else "exact")
+        # Ragged sidecar device columns (ISSUE 7): per-query k / cap /
+        # nprobe as int32 DATA next to the query batch. Pad rows carry 0
+        # (their top-k masks fully dead; they were q_valid=False anyway).
+        k_dev = capq_dev = npq_dev = None
+        if ragged:
+            np.minimum(cap_arr, statics["cap_take"], out=cap_arr)
+            k_dev = jnp.asarray(padb(k_arr, 0, np.int32))
+            capq_dev = jnp.asarray(padb(cap_arr, 0, np.int32))
+            if ivf_tabs is not None:
+                ceil_np = ivf_tabs[3]
+                np_arr = np.zeros((nq,), np.int32)
+                for i, r in enumerate(reqs):
+                    rn = getattr(r, "nprobe", None)
+                    np_arr[i] = (min(max(int(rn), 1), ceil_np) if rn
+                                 else ceil_np)
+                np_arr[~valid] = 0
+                npq_dev = jnp.asarray(padb(np_arr, 0, np.int32))
+        self._note_serve_kernel(mode, statics, ragged)
         self._maybe_record_hbm(mode, st, args, statics, super_gate,
-                               ivf_tabs, use_quant)
+                               ivf_tabs, use_quant, ragged=ragged,
+                               k_dev=k_dev, npq_dev=npq_dev)
         t0 = time.perf_counter()
         with trace_annotation(f"lz.serve.{mode}"):
             if boost_on.any():
@@ -1575,11 +1644,12 @@ class MemoryIndex:
                            - self.epoch)
                 with self._state_lock:
                     cur = self._state
-                    boost_args = (jnp.asarray(padb(boost_on)),
-                                  jnp.float32(now_rel),
-                                  jnp.float32(super_gate),
-                                  jnp.float32(acc_boost),
-                                  jnp.float32(nbr_boost))
+                    scalars = (jnp.float32(now_rel),
+                               jnp.float32(super_gate),
+                               jnp.float32(acc_boost),
+                               jnp.float32(nbr_boost))
+                    boost_dev = jnp.asarray(padb(boost_on))
+                    sole = sys.getrefcount(cur) <= self._SOLE_REFS
                     if ivf_tabs is not None:
                         cent, members, extras, _ = ivf_tabs
                         # shadow (when int8 is on too) taken against ``cur``
@@ -1587,9 +1657,15 @@ class MemoryIndex:
                         # tears
                         shadow = (self._int8_shadow_for(cur) if use_quant
                                   else None)
-                        fn = (S.search_fused_ivf
-                              if sys.getrefcount(cur) <= self._SOLE_REFS
-                              else S.search_fused_ivf_copy)
+                        if ragged:
+                            fn = (S.search_fused_ivf_ragged if sole
+                                  else S.search_fused_ivf_ragged_copy)
+                            boost_args = (boost_dev, k_dev, capq_dev,
+                                          npq_dev) + scalars
+                        else:
+                            fn = (S.search_fused_ivf if sole
+                                  else S.search_fused_ivf_copy)
+                            boost_args = (boost_dev,) + scalars
                         new_state, packed = fn(cur, shadow, cent, members,
                                                extras, *args, *boost_args,
                                                **statics)
@@ -1599,15 +1675,27 @@ class MemoryIndex:
                         # racing writer (re-entrant RLock; rebuild is
                         # dispatch-only)
                         q8, scale = self._int8_shadow_for(cur)
-                        fn = (S.search_fused_quant
-                              if sys.getrefcount(cur) <= self._SOLE_REFS
-                              else S.search_fused_quant_copy)
+                        if ragged:
+                            fn = (S.search_fused_quant_ragged if sole
+                                  else S.search_fused_quant_ragged_copy)
+                            boost_args = (boost_dev, k_dev,
+                                          capq_dev) + scalars
+                        else:
+                            fn = (S.search_fused_quant if sole
+                                  else S.search_fused_quant_copy)
+                            boost_args = (boost_dev,) + scalars
                         new_state, packed = fn(cur, q8, scale, *args,
                                                *boost_args, **statics)
                     else:
-                        fn = (S.search_fused
-                              if sys.getrefcount(cur) <= self._SOLE_REFS
-                              else S.search_fused_copy)
+                        if ragged:
+                            fn = (S.search_fused_ragged if sole
+                                  else S.search_fused_ragged_copy)
+                            boost_args = (boost_dev, k_dev,
+                                          capq_dev) + scalars
+                        else:
+                            fn = (S.search_fused if sole
+                                  else S.search_fused_copy)
+                            boost_args = (boost_dev,) + scalars
                         new_state, packed = fn(cur, *args, *boost_args,
                                                **statics)
                     del cur
@@ -1615,19 +1703,33 @@ class MemoryIndex:
             elif ivf_tabs is not None:
                 cent, members, extras, _ = ivf_tabs
                 shadow = self._int8_shadow_for(st) if use_quant else None
-                packed = S.search_fused_ivf_read(st, shadow, cent, members,
-                                                 extras, *args,
-                                                 jnp.float32(super_gate),
-                                                 **statics)
+                if ragged:
+                    packed = S.search_fused_ivf_ragged_read(
+                        st, shadow, cent, members, extras, *args, k_dev,
+                        npq_dev, jnp.float32(super_gate), **statics)
+                else:
+                    packed = S.search_fused_ivf_read(
+                        st, shadow, cent, members, extras, *args,
+                        jnp.float32(super_gate), **statics)
             elif use_quant:
                 q8, scale = self._int8_shadow_for(st)
-                packed = S.search_fused_quant_read(st, q8, scale, *args,
-                                                   jnp.float32(super_gate),
-                                                   **statics)
+                if ragged:
+                    packed = S.search_fused_quant_ragged_read(
+                        st, q8, scale, *args, k_dev,
+                        jnp.float32(super_gate), **statics)
+                else:
+                    packed = S.search_fused_quant_read(
+                        st, q8, scale, *args, jnp.float32(super_gate),
+                        **statics)
             else:
-                packed = S.search_fused_read(st, *args,
-                                             jnp.float32(super_gate),
-                                             **statics)
+                if ragged:
+                    packed = S.search_fused_ragged_read(
+                        st, *args, k_dev, jnp.float32(super_gate),
+                        **statics)
+                else:
+                    packed = S.search_fused_read(st, *args,
+                                                 jnp.float32(super_gate),
+                                                 **statics)
             host = np.asarray(packed)          # the ONE readback
         tel.record("serve.dispatch_ms", (time.perf_counter() - t0) * 1e3,
                    labels={"mode": mode})
@@ -1636,14 +1738,101 @@ class MemoryIndex:
             gate_s, gate_r, ann_s, ann_r, fast, counters = unpack_retrieval(
                 host[:nq], k_bucket)
             out = self._demux_fused(reqs, results, valid, boost_on, gate_s,
-                                    gate_r, ann_s, ann_r, fast, cap)
+                                    gate_r, ann_s, ann_r, fast, cap,
+                                    lengths=(counters[:, 0] if ragged
+                                             else None))
         record_device_counters(
             tel, counters, fast, gate_on[:nq], valid[:nq],
             np.asarray([min(int(r.k), cap) for r in reqs]))
         return out
 
+    def _note_serve_kernel(self, mode: str, statics: dict,
+                           ragged: bool) -> None:
+        """Track the distinct fused serving-kernel keys this index has
+        dispatched — with ragged serving exactly ONE per mode (the k/cap/
+        nprobe ceilings are fixed), without it one per (mode × k-bucket).
+        The bench's ``compile_cache_entries`` measurement and the CI gate
+        (``check_dispatch_counts.py``: ragged artifacts must record a
+        count ≤ the mode count) read the gauge this maintains."""
+        key = (mode, "ragged" if ragged else "classic",
+               tuple(sorted(statics.items())))
+        if key not in self._serve_kernel_keys:
+            self._serve_kernel_keys.add(key)
+            self.telemetry.gauge("kernel.cache_entries",
+                                 len(self._serve_kernel_keys),
+                                 labels={"surface": "single_fused"})
+
+    def warmup_serving(self, geometries=(8, 64), *, cap_take: int = 5,
+                       max_nbr: int = 32, super_gate: float = 0.4,
+                       acc_boost: float = 0.05, nbr_boost: float = 0.02,
+                       k: Optional[int] = None) -> Dict[tuple, float]:
+        """Pre-compile the fused serving kernels (ISSUE 7 satellite) so
+        the FIRST live request doesn't eat a cold multi-second XLA
+        compile. ``geometries`` are query-batch sizes (rounded to the
+        serving pad bucket); for each, the current mode's read twin AND
+        donated serve twin are driven once through the REAL dispatch path
+        (``search_fused_requests``) with queries of a synthetic tenant
+        that owns no rows — numerically a no-op on the arena (no live
+        hits, every boost scatter routes to the sentinel), but it
+        populates exactly the jit cache entries live traffic will hit,
+        shapes and dtypes included. Serving counters are suppressed while
+        warming (a warmup must not skew the pad-waste / dispatch
+        baselines); wall time lands in ``kernel.warmup_ms{mode,batch}``.
+        Returns ``{(mode, padded_batch): ms}``. Call AFTER the corpus and
+        edge graph are in place (the CSR buffer's padded shape is part of
+        the kernel key) — bench.py does, right before its timed sections.
+        No-op on an empty index (no tenant ever resolves there)."""
+        from lazzaro_tpu.serve.scheduler import RetrievalRequest
+
+        out: Dict[tuple, float] = {}
+        if not self.id_to_row:
+            return out
+        tel = self.telemetry
+        cap = self.state.capacity
+        if self.mesh is not None:
+            mode = "sharded_quant" if self.int8_serving else "sharded_exact"
+        else:
+            k_kernel = (int(min(max(self.serve_k_max, cap_take, 1), cap))
+                        if self.serve_ragged else
+                        min(max(next_pow2(max(cap_take,
+                                              int(k or cap_take))), 1), cap))
+            mode = ("ivf" if self._ivf_fused_pack(k_kernel) is not None
+                    else "quant" if self.int8_serving else "exact")
+        # the warmup tenant matches no arena row (never allocated to one)
+        self._tenants.setdefault("~warmup", -2)
+        kk = int(k if k is not None else self.serve_k_max)
+        buckets = sorted({
+            (bucket_size(g, self.serve_pad_granularity)
+             if self.serve_ragged else next_pow2(g))
+            for g in geometries if g > 0})
+        kw = dict(cap_take=cap_take, max_nbr=max_nbr, super_gate=super_gate,
+                  acc_boost=acc_boost, nbr_boost=nbr_boost)
+        for g in buckets:
+            zero_q = np.zeros((self.dim,), np.float32)
+            t0 = time.perf_counter()
+            prev = tel.enabled
+            tel.enabled = False
+            try:
+                # serve twin (one boosting request), then the read twin
+                self.search_fused_requests(
+                    [RetrievalRequest(query=zero_q, tenant="~warmup", k=kk,
+                                      gate_enabled=True, boost=(i == 0))
+                     for i in range(g)], **kw)
+                self.search_fused_requests(
+                    [RetrievalRequest(query=zero_q, tenant="~warmup", k=kk,
+                                      gate_enabled=True)
+                     for i in range(g)], **kw)
+            finally:
+                tel.enabled = prev
+            ms = (time.perf_counter() - t0) * 1e3
+            tel.record("kernel.warmup_ms", ms,
+                       labels={"mode": mode, "batch": str(g)})
+            out[(mode, g)] = ms
+        return out
+
     def _maybe_record_hbm(self, mode: str, st, args, statics, super_gate,
-                          ivf_tabs, use_quant) -> None:
+                          ivf_tabs, use_quant, ragged: bool = False,
+                          k_dev=None, npq_dev=None) -> None:
         """Record the ``memory_analysis()`` peak-HBM gauge for one fused
         serving geometry, once per (mode × k-bucket × cap/nbr) key —
         "Memory Safe Computations with XLA": compiled-program introspection
@@ -1653,7 +1842,7 @@ class MemoryIndex:
         read twin is an extra compile (never an extra dispatch)."""
         if not self.telemetry_hbm:
             return
-        key = (mode,) + tuple(sorted(statics.items()))
+        key = (mode, ragged) + tuple(sorted(statics.items()))
         if key in self._hbm_recorded:
             return
         self._hbm_recorded.add(key)
@@ -1661,14 +1850,27 @@ class MemoryIndex:
             if ivf_tabs is not None:
                 cent, members, extras, _ = ivf_tabs
                 shadow = self._int8_shadow_for(st) if use_quant else None
-                lowered = S.search_fused_ivf_read.lower(
-                    st, shadow, cent, members, extras, *args,
-                    jnp.float32(super_gate), **statics)
+                if ragged:
+                    lowered = S.search_fused_ivf_ragged_read.lower(
+                        st, shadow, cent, members, extras, *args, k_dev,
+                        npq_dev, jnp.float32(super_gate), **statics)
+                else:
+                    lowered = S.search_fused_ivf_read.lower(
+                        st, shadow, cent, members, extras, *args,
+                        jnp.float32(super_gate), **statics)
             elif use_quant:
                 q8, scale = self._int8_shadow_for(st)
-                lowered = S.search_fused_quant_read.lower(
-                    st, q8, scale, *args, jnp.float32(super_gate),
-                    **statics)
+                if ragged:
+                    lowered = S.search_fused_quant_ragged_read.lower(
+                        st, q8, scale, *args, k_dev,
+                        jnp.float32(super_gate), **statics)
+                else:
+                    lowered = S.search_fused_quant_read.lower(
+                        st, q8, scale, *args, jnp.float32(super_gate),
+                        **statics)
+            elif ragged:
+                lowered = S.search_fused_ragged_read.lower(
+                    st, *args, k_dev, jnp.float32(super_gate), **statics)
             else:
                 lowered = S.search_fused_read.lower(
                     st, *args, jnp.float32(super_gate), **statics)
@@ -1685,16 +1887,20 @@ class MemoryIndex:
                                  if self.mesh is not None else "1")})
 
     def _demux_fused(self, reqs, results, valid, boost_on, gate_s, gate_r,
-                     ann_s, ann_r, fast, cap):
+                     ann_s, ann_r, fast, cap, lengths=None):
         """Per-request demux of the unpacked fused readback — shared by the
-        single-chip and the pod-sharded dispatch."""
+        single-chip and the pod-sharded dispatch. ``lengths`` is the
+        ragged decode bound: the readback's per-query live-length counter,
+        so a k=4 request in a K-ceiling batch decodes 4 columns, not K."""
         for i, r in enumerate(reqs):
             if not valid[i]:
                 continue
             res = results[i]
             ids, scores = decode_topk(ann_s[i:i + 1], ann_r[i:i + 1],
                                       self.row_to_id, S.NEG_INF,
-                                      limit=min(int(r.k), cap))[0]
+                                      limit=min(int(r.k), cap),
+                                      lengths=(None if lengths is None
+                                               else lengths[i:i + 1]))[0]
             res.ids, res.scores = ids, scores
             if gate_s[i] > S.NEG_INF / 2:
                 res.gate_id = self.row_to_id.get(int(gate_r[i]))
@@ -1704,15 +1910,20 @@ class MemoryIndex:
         return results
 
     def _fused_sharded_kernels(self, mode: str, k_bucket: int,
-                               cap_take: int, max_nbr: int):
-        key = (mode, k_bucket, cap_take, max_nbr)
+                               cap_take: int, max_nbr: int,
+                               ragged: bool = False):
+        # Ragged kernels collapse to per-mode keys — k_bucket IS the
+        # static ceiling then, identical for every batch — so a mixed-k
+        # request stream compiles one distributed program per mode.
+        key = ((mode, "ragged", k_bucket, cap_take, max_nbr) if ragged
+               else (mode, k_bucket, cap_take, max_nbr))
         kern = self._fused_sharded_cache.get(key)
         if kern is None:
             kern = S.make_fused_sharded(
                 self.mesh, self.shard_axis, k=k_bucket,
                 cap_take=min(cap_take, k_bucket), max_nbr=max_nbr,
-                mode=mode, slack=self.coarse_slack)
-            self._fused_sharded_cache[key] = kern
+                mode=mode, slack=self.coarse_slack, ragged=ragged)
+            self._fused_sharded_cache.put(key, kern)
             self.telemetry.gauge("kernel.cache_entries",
                                  len(self._fused_sharded_cache),
                                  labels={"surface": "fused_sharded"})
@@ -1721,7 +1932,8 @@ class MemoryIndex:
     def _dispatch_fused_sharded(self, st, indptr, nbr, qp, padb, valid,
                                 tenants, gate_on, boost_on, k_bucket,
                                 cap_take, max_nbr, super_gate, acc_boost,
-                                nbr_boost, now):
+                                nbr_boost, now, ragged=False, k_arr=None,
+                                cap_arr=None):
         """The pod serving dispatch (ISSUE 5): the full chat-turn program
         as ONE distributed shard_map dispatch against the row-sharded
         arena. Exact by default; with ``int8_serving`` the shard-local
@@ -1731,21 +1943,35 @@ class MemoryIndex:
         are the PER-SHARD CSR slices ``_csr_for`` builds under a mesh.
         The donation gate is the same refcount contract as every other
         mutation: donate only when this index provably holds the sole
-        arena reference."""
+        arena reference. ``ragged=True`` threads the per-query (k, cap)
+        sidecars into the ragged distributed program — ``k_bucket`` is
+        then the static ceiling and the kernel cache key is per-mode."""
         use_quant = bool(self.int8_serving)
         mode = "quant" if use_quant else "exact"
-        kern = self._fused_sharded_kernels(mode, k_bucket, cap_take, max_nbr)
+        kern = self._fused_sharded_kernels(mode, k_bucket, cap_take,
+                                           max_nbr, ragged=ragged)
         sargs = (indptr, nbr, jnp.asarray(qp), jnp.asarray(padb(valid)),
                  jnp.asarray(padb(tenants, -1, np.int32)),
                  jnp.asarray(padb(gate_on)))
+        if ragged:
+            cap_s = min(cap_take, k_bucket)
+            k_dev = jnp.asarray(padb(np.minimum(k_arr, k_bucket), 0,
+                                     np.int32))
+            capq_dev = jnp.asarray(padb(np.minimum(cap_arr, cap_s), 0,
+                                        np.int32))
+            # dense modes share the ragged ABI; nprobe_q is inert here
+            npq_dev = jnp.asarray(np.zeros((qp.shape[0],), np.int32))
+            read_extra = (k_dev, npq_dev, jnp.float32(super_gate))
+        else:
+            read_extra = (jnp.float32(super_gate),)
         if self.telemetry_hbm:
-            hkey = ("sharded", mode, k_bucket, cap_take, max_nbr)
+            hkey = ("sharded", mode, ragged, k_bucket, cap_take, max_nbr)
             if hkey not in self._hbm_recorded:
                 self._hbm_recorded.add(hkey)
                 try:
                     tables = self._int8_shadow_for(st) if use_quant else ()
                     peak = peak_bytes(kern.read.lower(
-                        st, tables, *sargs, jnp.float32(super_gate)
+                        st, tables, *sargs, *read_extra
                     ).compile().memory_analysis())
                 except Exception:   # noqa: BLE001 — never fail the serve
                     peak = None
@@ -1765,8 +1991,11 @@ class MemoryIndex:
                 fn = (kern.serve
                       if sys.getrefcount(cur) <= self._SOLE_REFS
                       else kern.serve_copy)
+                boost_extra = ((jnp.asarray(padb(boost_on)), k_dev,
+                                capq_dev, npq_dev) if ragged
+                               else (jnp.asarray(padb(boost_on)),))
                 new_state, packed = fn(cur, tables, *sargs,
-                                       jnp.asarray(padb(boost_on)),
+                                       *boost_extra,
                                        jnp.float32(now_rel),
                                        jnp.float32(super_gate),
                                        jnp.float32(acc_boost),
@@ -1775,7 +2004,7 @@ class MemoryIndex:
                 self.state = new_state
             return packed
         tables = self._int8_shadow_for(st) if use_quant else ()
-        return kern.read(st, tables, *sargs, jnp.float32(super_gate))
+        return kern.read(st, tables, *sargs, *read_extra)
 
     def apply_boosts(self, entries: Dict[str, Tuple[int, int, float]],
                      acc_boost: float, nbr_boost: float) -> None:

@@ -160,7 +160,11 @@ class MemorySystem:
                                  pq_serving=cfg.pq_serving,
                                  coarse_slack=cfg.coarse_fetch_slack,
                                  telemetry=self.telemetry,
-                                 telemetry_hbm=cfg.serve_telemetry_hbm)
+                                 telemetry_hbm=cfg.serve_telemetry_hbm,
+                                 serve_ragged=cfg.serve_ragged,
+                                 serve_k_max=cfg.serve_k_max,
+                                 serve_pad_granularity=cfg.serve_pad_granularity,
+                                 serve_kernel_cache_max=cfg.serve_kernel_cache_max)
 
         self.query_cache = QueryCache(cfg.cache_size) if self.enable_caching else None
 
@@ -837,7 +841,9 @@ class MemorySystem:
                     self._serve_requests,
                     max_batch=self.config.serve_batch_max,
                     max_wait_us=self.config.serve_flush_us,
-                    telemetry=self.telemetry)
+                    telemetry=self.telemetry,
+                    continuous=self.config.serve_continuous,
+                    tenant_max_inflight=self.config.serve_tenant_max_inflight)
                 self.query_scheduler = sched
         return sched
 
@@ -850,6 +856,21 @@ class MemorySystem:
             super_gate=self.config.super_node_gate,
             acc_boost=self.config.access_salience_boost,
             nbr_boost=self.config.neighbor_salience_boost)
+
+    def warmup_serving(self, geometries=(8, 64)):
+        """Pre-compile the fused serving kernels for the given query-batch
+        geometries with THIS system's serving parameters (ISSUE 7
+        satellite: the first live request must not eat a cold multi-second
+        XLA compile). Call after the corpus/edge graph are in place —
+        bench.py does, right before its timed sections. Warmup wall time
+        lands in ``kernel.warmup_ms{mode,batch}``."""
+        return self.index.warmup_serving(
+            geometries, cap_take=self.config.retrieval_cap,
+            max_nbr=self.config.serve_max_nbr,
+            super_gate=self.config.super_node_gate,
+            acc_boost=self.config.access_salience_boost,
+            nbr_boost=self.config.neighbor_salience_boost,
+            k=self.config.serve_k_max)
 
     def _retrieve_for_chat(self, query_emb: List[float],
                            query_text: str) -> Tuple[List[str], str]:
@@ -2342,7 +2363,11 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                                         int8_serving=self.config.int8_serving,
                                         ivf_nprobe=self.config.ivf_serving,
                                         pq_serving=self.config.pq_serving,
-                                        coarse_slack=self.config.coarse_fetch_slack)
+                                        coarse_slack=self.config.coarse_fetch_slack,
+                                        serve_ragged=self.config.serve_ragged,
+                                        serve_k_max=self.config.serve_k_max,
+                                        serve_pad_granularity=self.config.serve_pad_granularity,
+                                        serve_kernel_cache_max=self.config.serve_kernel_cache_max)
             # Pairing check: both halves carry the save's snapshot_id; a
             # mismatch means a crash landed between the two writes and one
             # half is stale. Restore proceeds (both halves are individually

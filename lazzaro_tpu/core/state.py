@@ -923,22 +923,44 @@ def _csr_neighbor_rows(state: ArenaState, csr_indptr: jax.Array,
     return jnp.where(valid_n & ~dup & ~in_res, flat, cap)
 
 
+def _ragged_topk_mask(ann_s: jax.Array, ann_r: jax.Array, k_c: jax.Array,
+                      sentinel: int):
+    """Per-query top-k boundary mask — the core ragged-serving move
+    (ISSUE 7): the scan computed top-``K`` to the batch CEILING (a static
+    kernel constant), and each query's own ``k`` arrives as DEVICE data
+    (``k_c`` [C] i32). Positions at or past a query's k are routed to
+    (NEG_INF, sentinel), so decode, the live-length counter, and the boost
+    tail all see exactly the per-request result — one compiled kernel per
+    (mode × geometry) serves any mix of request shapes. Equivalent to a
+    per-query ``top_k(k_i)`` because the ceiling top-k is score-sorted."""
+    col = jnp.arange(ann_s.shape[1])[None, :]
+    live = col < k_c[:, None]
+    return (jnp.where(live, ann_s, NEG_INF),
+            jnp.where(live, ann_r, sentinel))
+
+
 def _gate_and_boost_rows(state: ArenaState, csr_indptr, csr_nbr, gate_s,
                          gate_r, ann_s, ann_r, valid_c, tenant_c, gate_c,
-                         boost_c, super_gate, cap_take: int, max_nbr: int):
+                         boost_c, super_gate, cap_take: int, max_nbr: int,
+                         cap_c=None):
     """The post-top-k tail both serving scans share: the device-side gate
     verdict, the access-boost row list, and the CSR neighbor gather.
 
     The hierarchy decision happens ON DEVICE: where the gate fires the host
     serves super-node children it alone knows, so the device must NOT boost
     the ANN rows (the host falls back to the classic boost for those
-    queries — exact parity on the fast path)."""
+    queries — exact parity on the fast path).
+
+    ``cap_c`` (optional [C] i32) is the ragged per-query retrieval cap:
+    ``cap_take`` stays the STATIC slice ceiling, and each query's own cap
+    masks within it, so one kernel serves mixed per-request caps."""
     cap = state.capacity
     fast = gate_c & (gate_s > super_gate)
     do_boost = boost_c & valid_c & ~fast                  # [C]
-    hit = ann_s[:, :cap_take] > NEG_INF / 2
-    acc_rows = jnp.where(hit & do_boost[:, None],
-                         ann_r[:, :cap_take], cap)        # [C, cap_take]
+    take = (ann_s[:, :cap_take] > NEG_INF / 2) & do_boost[:, None]
+    if cap_c is not None:
+        take = take & (jnp.arange(cap_take)[None, :] < cap_c[:, None])
+    acc_rows = jnp.where(take, ann_r[:, :cap_take], cap)  # [C, cap_take]
     nbr_rows = _csr_neighbor_rows(state, csr_indptr, csr_nbr, acc_rows,
                                   tenant_c, max_nbr)
     return fast, acc_rows, nbr_rows
@@ -972,23 +994,39 @@ def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
                        csr_nbr: jax.Array, q: jax.Array, q_valid: jax.Array,
                        tenant: jax.Array, gate_on: jax.Array,
                        boost_on: jax.Array, super_gate: jax.Array,
-                       k: int, cap_take: int, max_nbr: int):
+                       k: int, cap_take: int, max_nbr: int,
+                       k_q=None, cap_q=None):
     """Per-chunk compute phase: the exact two-tier top-k core, the
     device-side gate verdict, and the CSR neighbor gather with per-query
     dedup. Returns sentinel-padded row lists for the scatter phase
-    (``capacity`` is the sentinel row index)."""
+    (``capacity`` is the sentinel row index).
 
-    def chunk(q_c, valid_c, tenant_c, gate_c, boost_c):
+    With ``k_q``/``cap_q`` ([Q] i32 device sidecars) the scan is RAGGED:
+    ``k`` and ``cap_take`` become the static batch ceilings the compute
+    runs to, and each query masks at its own top-k boundary
+    (``_ragged_topk_mask``) — per-request shapes are data, not trace
+    constants."""
+    ragged = k_q is not None
+
+    def chunk(q_c, valid_c, tenant_c, gate_c, boost_c, *rag):
         gate_s, gate_r, ann_s, ann_r = _exact_two_tier(state, q_c, tenant_c,
                                                        1, k)
         gate_s, gate_r = gate_s[:, 0], gate_r[:, 0]
+        cap_c = None
+        if ragged:
+            k_c, cap_c = rag
+            ann_s, ann_r = _ragged_topk_mask(ann_s, ann_r, k_c,
+                                             state.capacity)
         fast, acc_rows, nbr_rows = _gate_and_boost_rows(
             state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
             valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
-            max_nbr)
+            max_nbr, cap_c=cap_c)
         return gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows
 
-    return chunked_map_multi(chunk, (q, q_valid, tenant, gate_on, boost_on))
+    arrays = (q, q_valid, tenant, gate_on, boost_on)
+    if ragged:
+        arrays = arrays + (k_q, cap_q)
+    return chunked_map_multi(chunk, arrays)
 
 
 def _search_fused(
@@ -1196,21 +1234,34 @@ def _search_fused_quant_scan(state: ArenaState, q8a: jax.Array,
                              q_valid: jax.Array, tenant: jax.Array,
                              gate_on: jax.Array, boost_on: jax.Array,
                              super_gate: jax.Array, k: int, slack: int,
-                             cap_take: int, max_nbr: int):
+                             cap_take: int, max_nbr: int,
+                             k_q=None, cap_q=None):
     """Quantized per-chunk compute phase: the int8 coarse-scan + exact
-    rescore core, then the shared gate/CSR/boost tail."""
+    rescore core, then the shared gate/CSR/boost tail. ``k_q``/``cap_q``
+    make it ragged (see ``_search_fused_scan``): the coarse fetch and the
+    exact rescore run to the static ceiling, the boundary mask is
+    per-query data."""
+    ragged = k_q is not None
 
-    def chunk(q_c, valid_c, tenant_c, gate_c, boost_c):
+    def chunk(q_c, valid_c, tenant_c, gate_c, boost_c, *rag):
         g_s, g_r, ann_s, ann_r = _quant_two_tier(state, q8a, scale_a, q_c,
                                                  tenant_c, k, slack)
         gate_s, gate_r = g_s[:, 0], g_r[:, 0]
+        cap_c = None
+        if ragged:
+            k_c, cap_c = rag
+            ann_s, ann_r = _ragged_topk_mask(ann_s, ann_r, k_c,
+                                             state.capacity)
         fast, acc_rows, nbr_rows = _gate_and_boost_rows(
             state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
             valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
-            max_nbr)
+            max_nbr, cap_c=cap_c)
         return gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows
 
-    return chunked_map_multi(chunk, (q, q_valid, tenant, gate_on, boost_on))
+    arrays = (q, q_valid, tenant, gate_on, boost_on)
+    if ragged:
+        arrays = arrays + (k_q, cap_q)
+    return chunked_map_multi(chunk, arrays)
 
 
 def _search_fused_quant(
@@ -1319,7 +1370,8 @@ def _dedup_topk(scores: jax.Array, rows: jax.Array, sentinel: int, k: int
 
 def _ivf_two_tier(state: ArenaState, shadow, centroids: jax.Array,
                   members: jax.Array, extras: jax.Array, q_c: jax.Array,
-                  tenant_c: jax.Array, k: int, nprobe: int, slack: int):
+                  tenant_c: jax.Array, k: int, nprobe: int, slack: int,
+                  nprobe_c=None):
     """IVF two-tier core: coarse centroid prefilter + member gather
     (``ops.ivf.gather_rows`` — the same candidate assembly as the classic
     IVF scan, barrier included), per-query tenant masking over the
@@ -1333,7 +1385,16 @@ def _ivf_two_tier(state: ArenaState, shadow, centroids: jax.Array,
     the gathers then only touch the chip's own arena slice. Returns
     ``(gate_s [C], gate_r [C], ann_s [C,k], ann_r [C,k], n_dup [C])``
     with rows routed to the sentinel (``state.capacity``) where invalid;
-    ``n_dup`` counts the duplicates the in-kernel dedup dropped."""
+    ``n_dup`` counts the duplicates the in-kernel dedup dropped.
+
+    ``nprobe_c`` (optional [C] i32) makes the probe width RAGGED: the
+    gather still visits the static ceiling ``nprobe`` clusters (the
+    candidate tensor shape is a trace constant), but a query's candidates
+    from clusters ranked at or past its own nprobe are masked invalid —
+    per-query recall/latency trade as device data, one compiled kernel.
+    The gather layout is cluster-rank-major (``gather_rows``), so the
+    rank of a member candidate is just its position divided by the
+    member-table width; extras stay valid at every probe width."""
     from lazzaro_tpu.ops.ivf import gather_rows
 
     cap = state.capacity
@@ -1344,6 +1405,13 @@ def _ivf_two_tier(state: ArenaState, shadow, centroids: jax.Array,
     cand, safe = gather_rows(centroids, members, extras, qn, nprobe)
     valid = ((cand >= 0) & state.alive[safe]
              & (state.tenant_id[safe] == tenant_c[:, None]))
+    if nprobe_c is not None:
+        m_w = members.shape[1]
+        pos = jnp.arange(L)
+        in_members = pos < nprobe * m_w
+        rank = pos // max(m_w, 1)
+        valid = valid & (~in_members[None, :]
+                         | (rank[None, :] < nprobe_c[:, None]))
     sup = state.is_super[safe]
     qd = qn.astype(state.emb.dtype)
 
@@ -1409,23 +1477,36 @@ def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
                            tenant: jax.Array, gate_on: jax.Array,
                            boost_on: jax.Array, super_gate: jax.Array,
                            k: int, nprobe: int, slack: int, cap_take: int,
-                           max_nbr: int):
+                           max_nbr: int, k_q=None, cap_q=None,
+                           nprobe_q=None):
     """IVF per-chunk compute phase: the coarse-prefilter two-tier core,
-    then the shared gate/CSR/boost tail."""
+    then the shared gate/CSR/boost tail. ``k_q``/``cap_q``/``nprobe_q``
+    make it ragged: the gather and candidate scan run to the static
+    ceilings, each query masks at its own k / cap / probe-width boundary
+    (see ``_search_fused_scan`` / ``_ivf_two_tier``)."""
+    ragged = k_q is not None
 
-    def body(q_c, valid_c, tenant_c, gate_c, boost_c):
+    def body(q_c, valid_c, tenant_c, gate_c, boost_c, *rag):
+        nprobe_c = rag[2] if ragged else None
         gate_s, gate_r, ann_s, ann_r, n_dup = _ivf_two_tier(
             state, shadow, centroids, members, extras, q_c, tenant_c, k,
-            nprobe, slack)
+            nprobe, slack, nprobe_c=nprobe_c)
+        cap_c = None
+        if ragged:
+            k_c, cap_c = rag[0], rag[1]
+            ann_s, ann_r = _ragged_topk_mask(ann_s, ann_r, k_c,
+                                             state.capacity)
         fast, acc_rows, nbr_rows = _gate_and_boost_rows(
             state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
             valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
-            max_nbr)
+            max_nbr, cap_c=cap_c)
         return (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows,
                 n_dup)
 
-    return chunked_map_multi(body, (q, q_valid, tenant, gate_on, boost_on),
-                             chunk=IVF_SERVE_CHUNK)
+    arrays = (q, q_valid, tenant, gate_on, boost_on)
+    if ragged:
+        arrays = arrays + (k_q, cap_q, nprobe_q)
+    return chunked_map_multi(body, arrays, chunk=IVF_SERVE_CHUNK)
 
 
 def _search_fused_ivf(
@@ -1496,6 +1577,207 @@ def search_fused_ivf_read(state: ArenaState, shadow, centroids: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Ragged fused serving (ISSUE 7): the SAME three single-dispatch chat-turn
+# programs, but per-query k / cap_take / nprobe are DEVICE DATA — int32
+# sidecar columns riding next to the query batch — instead of trace
+# constants. The static kernel constants collapse to per-mode CEILINGS
+# (``k`` = serve_k_max, ``cap_take`` = the config cap, ``nprobe`` = the
+# build's probe width): the scan bodies compute to the ceiling and each
+# query masks at its own top-k boundary (``_ragged_topk_mask``), its own
+# retrieval cap (``_gate_and_boost_rows`` cap_c), and its own probe width
+# (``_ivf_two_tier`` nprobe_c). One compiled kernel per (mode × geometry)
+# therefore serves ANY mix of request shapes — a k=100 request no longer
+# re-keys the whole batch's kernel or inflates its neighbors' top-k
+# beyond masked compute, and mixed-size traffic stops burning compile
+# cache entries. The packed readback's n_live counter becomes the
+# per-query live LENGTH (the PR 6 shortfall tail generalized): decode
+# reads exactly k_i live entries per request out of the K-wide rows.
+# ---------------------------------------------------------------------------
+
+
+def _search_fused_ragged(
+    state: ArenaState,
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,            # [Q, d] padded query batch
+    q_valid: jax.Array,      # [Q] bool
+    tenant: jax.Array,       # [Q] i32
+    gate_on: jax.Array,      # [Q] bool
+    boost_on: jax.Array,     # [Q] bool
+    k_q: jax.Array,          # [Q] i32 per-query k (0 for pad rows)
+    cap_q: jax.Array,        # [Q] i32 per-query retrieval cap
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,                  # STATIC k ceiling (serve_k_max)
+    cap_take: int,           # STATIC cap ceiling
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """``search_fused`` with the per-query (k, cap) sidecar: ONE donated
+    dispatch + ONE packed readback for a mixed-shape batch."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
+        _search_fused_scan(state, csr_indptr, csr_nbr, q, q_valid, tenant,
+                           gate_on, boost_on, super_gate, k, cap_take,
+                           max_nbr, k_q=k_q, cap_q=cap_q)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  acc=n_acc, nbr=n_nbr)
+
+
+search_fused_ragged, search_fused_ragged_copy = _donated_pair(
+    _search_fused_ragged, static_argnames=("k", "cap_take", "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "cap_take", "max_nbr"))
+def search_fused_ragged_read(state: ArenaState, csr_indptr: jax.Array,
+                             csr_nbr: jax.Array, q: jax.Array,
+                             q_valid: jax.Array, tenant: jax.Array,
+                             gate_on: jax.Array, k_q: jax.Array,
+                             super_gate: jax.Array, k: int, cap_take: int,
+                             max_nbr: int) -> jax.Array:
+    """Read-only ragged twin (pure ``search_memories`` fleets): per-query
+    k as data, no state mutation."""
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    cap_q = jnp.zeros(q_valid.shape, jnp.int32)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_scan(
+        state, csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+        super_gate, k, cap_take, max_nbr, k_q=k_q, cap_q=cap_q)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+def _search_fused_quant_ragged(
+    state: ArenaState,
+    q8a: jax.Array,
+    scale_a: jax.Array,
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    k_q: jax.Array,
+    cap_q: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """``search_fused_quant`` with the (k, cap) sidecar: the int8 coarse
+    fetch and exact rescore run to the k ceiling, the boundary is data."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
+        _search_fused_quant_scan(state, q8a, scale_a, csr_indptr, csr_nbr,
+                                 q, q_valid, tenant, gate_on, boost_on,
+                                 super_gate, k, slack, cap_take, max_nbr,
+                                 k_q=k_q, cap_q=cap_q)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  acc=n_acc, nbr=n_nbr)
+
+
+search_fused_quant_ragged, search_fused_quant_ragged_copy = _donated_pair(
+    _search_fused_quant_ragged,
+    static_argnames=("k", "slack", "cap_take", "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
+                                             "max_nbr"))
+def search_fused_quant_ragged_read(state: ArenaState, q8a: jax.Array,
+                                   scale_a: jax.Array,
+                                   csr_indptr: jax.Array,
+                                   csr_nbr: jax.Array, q: jax.Array,
+                                   q_valid: jax.Array, tenant: jax.Array,
+                                   gate_on: jax.Array, k_q: jax.Array,
+                                   super_gate: jax.Array, k: int,
+                                   slack: int, cap_take: int,
+                                   max_nbr: int) -> jax.Array:
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    cap_q = jnp.zeros(q_valid.shape, jnp.int32)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_quant_scan(
+        state, q8a, scale_a, csr_indptr, csr_nbr, q, q_valid, tenant,
+        gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr,
+        k_q=k_q, cap_q=cap_q)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+def _search_fused_ivf_ragged(
+    state: ArenaState,
+    shadow,
+    centroids: jax.Array,
+    members: jax.Array,
+    extras: jax.Array,
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    k_q: jax.Array,
+    cap_q: jax.Array,
+    nprobe_q: jax.Array,     # [Q] i32 per-query probe width (≤ nprobe)
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    nprobe: int,             # STATIC probe ceiling (the build's width)
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """``search_fused_ivf`` with the (k, cap, nprobe) sidecar: the member
+    gather visits the ceiling probe width, each query masks candidates
+    past its own — recall/latency per request, one kernel."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
+        _search_fused_ivf_scan(state, shadow, centroids, members, extras,
+                               csr_indptr, csr_nbr, q, q_valid, tenant,
+                               gate_on, boost_on, super_gate, k, nprobe,
+                               slack, cap_take, max_nbr, k_q=k_q,
+                               cap_q=cap_q, nprobe_q=nprobe_q)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+
+
+search_fused_ivf_ragged, search_fused_ivf_ragged_copy = _donated_pair(
+    _search_fused_ivf_ragged,
+    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
+                                             "cap_take", "max_nbr"))
+def search_fused_ivf_ragged_read(state: ArenaState, shadow,
+                                 centroids: jax.Array, members: jax.Array,
+                                 extras: jax.Array, csr_indptr: jax.Array,
+                                 csr_nbr: jax.Array, q: jax.Array,
+                                 q_valid: jax.Array, tenant: jax.Array,
+                                 gate_on: jax.Array, k_q: jax.Array,
+                                 nprobe_q: jax.Array,
+                                 super_gate: jax.Array, k: int, nprobe: int,
+                                 slack: int, cap_take: int, max_nbr: int
+                                 ) -> jax.Array:
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    cap_q = jnp.zeros(q_valid.shape, jnp.int32)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = _search_fused_ivf_scan(
+        state, shadow, centroids, members, extras, csr_indptr, csr_nbr, q,
+        q_valid, tenant, gate_on, boost_off, super_gate, k, nprobe, slack,
+        cap_take, max_nbr, k_q=k_q, cap_q=cap_q, nprobe_q=nprobe_q)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+
+
+# ---------------------------------------------------------------------------
 # Pod-scale fused serving (ISSUE 5): the SAME chat-turn program — two-tier
 # scan, super gate, CSR neighbor gather, boost scatters — composed with the
 # device mesh as ONE distributed shard_map dispatch + ONE packed readback.
@@ -1543,7 +1825,8 @@ def _globalize_rows(rows: jax.Array, scores: jax.Array, shard: jax.Array,
 
 def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
                        max_nbr: int, mode: str = "exact", slack: int = 0,
-                       nprobe: int = 0) -> FusedShardedKernels:
+                       nprobe: int = 0,
+                       ragged: bool = False) -> FusedShardedKernels:
     """Build the distributed fused chat-turn serving program for ``mesh``.
 
     ``mode`` picks the shard-local coarse stage:
@@ -1575,7 +1858,19 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     GLOBAL neighbor ids; Q is bounded by the scheduler's padded batch
     (≤ ``QUERY_CHUNK`` — the local cores stream bigger fleets through the
     usual chunked tiles, IVF at ``IVF_SERVE_CHUNK`` to bound the gather
-    footprint)."""
+    footprint).
+
+    ``ragged=True`` (ISSUE 7) builds the per-query-shape variant: ``k`` /
+    ``cap_take`` / ``nprobe`` become static CEILINGS and the call
+    signatures gain three replicated [Q] i32 sidecar columns —
+    ``serve(state, tables, csr_indptr, csr_nbr, q, q_valid, tenant,
+    gate_on, boost_on, k_q, cap_q, nprobe_q, now, super_gate, acc_boost,
+    nbr_boost)`` and ``read(..., gate_on, k_q, nprobe_q, super_gate)`` —
+    so ONE compiled distributed program serves any mix of request shapes
+    (the shard-local scans and the all_gather merge run to the ceiling;
+    each query masks at its own boundaries, ``ops.topk.sharded_topk_merge``
+    applying the k mask at the merge). ``nprobe_q`` is accepted and
+    ignored by the dense modes so every mode shares one ragged ABI."""
     from jax.sharding import PartitionSpec as P
 
     from lazzaro_tpu.ops.topk import sharded_topk_merge
@@ -1588,12 +1883,14 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     n_shards = mesh.shape[axis]
     chunk = IVF_SERVE_CHUNK if mode.startswith("ivf") else QUERY_CHUNK
 
-    def _scan_merge(arena, tables, q, tenant):
+    def _scan_merge(arena, tables, q, tenant, k_q=None, nprobe_q=None):
         """Shard-local two-tier candidates → globalize → ONE all_gather +
         global top-k per tier. Returns replicated (gate_s [Q], gate_r [Q],
         ann_s [Q,k], ann_r [Q,k], n_dup [Q]) with GLOBAL row ids; the dup
         counter (IVF in-kernel dedup hits, per-shard counts summed with a
-        tiny psum riding the same dispatch) is zero for the dense modes."""
+        tiny psum riding the same dispatch) is zero for the dense modes.
+        ``k_q``/``nprobe_q`` make it ragged: local scans run to the
+        ceiling, the merge masks each query at its own k boundary."""
         shard = jax.lax.axis_index(axis)
         local_n = arena.emb.shape[0]
         k_l = max(1, min(k, local_n))
@@ -1606,7 +1903,8 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
             q8_l, scale_l, cent, mem2, ext2 = tables
             mem_l, ext_l, shadow_l = mem2[0], ext2[0], (q8_l, scale_l)
 
-        def core(q_c, tenant_c):
+        def core(q_c, tenant_c, *rag):
+            nprobe_c = rag[0] if rag else None
             if mode == "exact":
                 g_s, g_r, a_s, a_r = _exact_two_tier(arena, q_c, tenant_c,
                                                      1, k_l)
@@ -1619,15 +1917,19 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
                     (q_c.shape[0],), jnp.int32)
             g_s, g_r, a_s, a_r, n_dup = _ivf_two_tier(
                 arena, shadow_l, cent, mem_l, ext_l, q_c, tenant_c, k_l,
-                nprobe, slack)
+                nprobe, slack, nprobe_c=nprobe_c)
             return g_s[:, None], g_r[:, None], a_s, a_r, n_dup
 
-        g_s, g_r, a_s, a_r, dup_l = chunked_map_multi(core, (q, tenant),
+        arrays = (q, tenant)
+        if nprobe_q is not None and mode.startswith("ivf"):
+            arrays = arrays + (nprobe_q,)
+        g_s, g_r, a_s, a_r, dup_l = chunked_map_multi(core, arrays,
                                                       chunk=chunk)
         n_dup = jax.lax.psum(dup_l, axis)
+        sent = n_shards * local_n - 1          # the global sentinel row
         ann_s, ann_r = sharded_topk_merge(
             axis, a_s, _globalize_rows(a_r, a_s, shard, local_n, n_shards),
-            k)
+            k, k_q=k_q, sentinel=sent)
         g_ms, g_mr = sharded_topk_merge(
             axis, g_s, _globalize_rows(g_r, g_s, shard, local_n, n_shards),
             1)
@@ -1638,7 +1940,8 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
             (g_ms[:, 0], g_mr[:, 0], ann_s, ann_r, n_dup))
 
     def _boost_tail(arena, indptr_l, nbr_l, ann_s, ann_r, fast, q_valid,
-                    tenant, boost_on, now, acc_boost, nbr_boost):
+                    tenant, boost_on, now, acc_boost, nbr_boost,
+                    cap_q=None):
         """The gate/CSR/boost tail against the row-sharded edge arena:
         owner chips gather their rows' CSR neighbor windows (merged to all
         chips with one small pmax), the per-query dedup / in-result masks
@@ -1650,9 +1953,10 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
         local_n = arena.emb.shape[0]
         sent = n_shards * local_n - 1          # == the global sentinel row
         do_boost = boost_on & q_valid & ~fast
-        hit = ann_s[:, :cap_take] > NEG_INF / 2
-        acc_rows = jnp.where(hit & do_boost[:, None],
-                             ann_r[:, :cap_take], sent)     # global rows
+        take = (ann_s[:, :cap_take] > NEG_INF / 2) & do_boost[:, None]
+        if cap_q is not None:
+            take = take & (jnp.arange(cap_take)[None, :] < cap_q[:, None])
+        acc_rows = jnp.where(take, ann_r[:, :cap_take], sent)  # global rows
         base = shard * local_n
         loc = acc_rows - base
         mine = (loc >= 0) & (loc < local_n) & (acc_rows != sent)
@@ -1709,6 +2013,28 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
         return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
                                dup=n_dup)
 
+    def _serve_local_ragged(arena, tables, indptr2, nbr2, q, q_valid,
+                            tenant, gate_on, boost_on, k_q, cap_q,
+                            nprobe_q, now, super_gate, acc_boost,
+                            nbr_boost):
+        gate_s, gate_r, ann_s, ann_r, n_dup = _scan_merge(
+            arena, tables, q, tenant, k_q=k_q, nprobe_q=nprobe_q)
+        fast = gate_on & (gate_s > super_gate)
+        arena, n_acc, n_nbr = _boost_tail(
+            arena, indptr2[0], nbr2[0], ann_s, ann_r, fast, q_valid,
+            tenant, boost_on, now, acc_boost, nbr_boost, cap_q=cap_q)
+        packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                 dup=n_dup, acc=n_acc, nbr=n_nbr)
+        return arena, packed
+
+    def _read_local_ragged(arena, tables, indptr2, nbr2, q, q_valid,
+                           tenant, gate_on, k_q, nprobe_q, super_gate):
+        gate_s, gate_r, ann_s, ann_r, n_dup = _scan_merge(
+            arena, tables, q, tenant, k_q=k_q, nprobe_q=nprobe_q)
+        fast = gate_on & (gate_s > super_gate)
+        return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                               dup=n_dup)
+
     state_specs = ArenaState(
         emb=P(axis, None), salience=P(axis), timestamp=P(axis),
         last_accessed=P(axis), access_count=P(axis), type_id=P(axis),
@@ -1723,13 +2049,25 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     }[mode]
     common = (state_specs, tables_specs, P(axis, None), P(axis, None),
               P(None, None), P(None), P(None), P(None))
-    mapped_serve = shard_map(
-        _serve_local, mesh=mesh,
-        in_specs=common + (P(None), P(), P(), P(), P()),
-        out_specs=(state_specs, P(None, None)), check_vma=False)
-    mapped_read = shard_map(
-        _read_local, mesh=mesh, in_specs=common + (P(),),
-        out_specs=P(None, None), check_vma=False)
+    if ragged:
+        # + (boost_on, k_q, cap_q, nprobe_q) replicated sidecars
+        mapped_serve = shard_map(
+            _serve_local_ragged, mesh=mesh,
+            in_specs=common + (P(None), P(None), P(None), P(None),
+                               P(), P(), P(), P()),
+            out_specs=(state_specs, P(None, None)), check_vma=False)
+        mapped_read = shard_map(
+            _read_local_ragged, mesh=mesh,
+            in_specs=common + (P(None), P(None), P()),
+            out_specs=P(None, None), check_vma=False)
+    else:
+        mapped_serve = shard_map(
+            _serve_local, mesh=mesh,
+            in_specs=common + (P(None), P(), P(), P(), P()),
+            out_specs=(state_specs, P(None, None)), check_vma=False)
+        mapped_read = shard_map(
+            _read_local, mesh=mesh, in_specs=common + (P(),),
+            out_specs=P(None, None), check_vma=False)
     return FusedShardedKernels(
         serve=jax.jit(mapped_serve, donate_argnums=(0,)),
         serve_copy=jax.jit(mapped_serve),

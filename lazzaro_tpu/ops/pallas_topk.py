@@ -97,6 +97,44 @@ def pallas_masked_topk(emb: jax.Array, madd: jax.Array, queries: jax.Array,
     return top_s, top_i
 
 
+@functools.partial(jax.jit, static_argnames=("k", "block_rows", "interpret"))
+def pallas_masked_topk_ragged(emb: jax.Array, madd: jax.Array,
+                              queries: jax.Array, k_q: jax.Array,
+                              k: int = 10, block_rows: int = 4096,
+                              interpret: bool = False
+                              ) -> Tuple[jax.Array, jax.Array]:
+    """Ragged-K variant of the blocked scan (ISSUE 7): ``k`` is the STATIC
+    batch ceiling the VMEM-streaming kernel computes to — the per-block
+    max-and-suppress loop and the stage-2 candidate sort are compiled
+    once per (geometry, ceiling) — and ``k_q`` ([Q] i32 device data) is
+    each query's own k. Positions at or past a query's k come back as
+    (NEG, -1), exactly the per-query ``top_k(k_i)`` result because the
+    ceiling output is score-sorted. One compiled kernel therefore serves
+    any mix of request k's ≤ the ceiling; mixed-k fleets stop burning a
+    compile-cache entry per distinct k."""
+    top_s, top_i = pallas_masked_topk(emb, madd, queries, k=k,
+                                      block_rows=block_rows,
+                                      interpret=interpret)
+    live = jnp.arange(k)[None, :] < k_q[:, None]
+    return jnp.where(live, top_s, NEG), jnp.where(live, top_i, -1)
+
+
+def masked_topk_arena_ragged(emb: jax.Array, mask: jax.Array,
+                             queries: jax.Array, k_q: jax.Array,
+                             k: int = 10) -> Tuple[jax.Array, jax.Array]:
+    """Ragged twin of :func:`masked_topk_arena`: boolean mask → additive
+    mask, block size fitted to VMEM, per-query k as data against the
+    static ``k`` ceiling."""
+    n, d = emb.shape
+    blk = fit_block_rows(n, d, emb.dtype.itemsize)
+    assert blk, f"arena rows {n} have no VMEM-fitting block divisor >= 512"
+    madd = jnp.where(mask, 0.0, NEG).astype(jnp.float32)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    return pallas_masked_topk_ragged(emb, madd, queries.astype(emb.dtype),
+                                     k_q, k=k, block_rows=blk,
+                                     interpret=not on_tpu)
+
+
 def masked_topk_auto(emb, madd, queries, k=10, block_rows=4096):
     """Dispatch: pallas on TPU, interpret-mode pallas elsewhere."""
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")

@@ -11,7 +11,7 @@ index is ~1.5 GB — resident in HBM across a v5e-8 with room to spare.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +37,8 @@ def masked_topk(emb: jax.Array, mask: jax.Array, query: jax.Array, k: int
 
 
 def sharded_topk_merge(axis: str, top_s: jax.Array, top_i: jax.Array,
-                       k: int) -> Tuple[jax.Array, jax.Array]:
+                       k: int, k_q: Optional[jax.Array] = None,
+                       sentinel: int = -1) -> Tuple[jax.Array, jax.Array]:
     """The ONE cross-chip combine every sharded retrieval kernel shares:
     all_gather the per-chip candidate lists ``(top_s, top_i) [Q, k_local]``
     over the mesh ``axis`` and take a global top-``k`` of the
@@ -50,7 +51,14 @@ def sharded_topk_merge(axis: str, top_s: jax.Array, top_i: jax.Array,
     resolve in global-row order as long as each survived its local top-k.
     Used by ``make_sharded_topk`` / ``make_sharded_int8_topk`` /
     ``make_sharded_multitenant_topk`` below and by the fused sharded
-    serving programs (``core.state.make_fused_sharded``)."""
+    serving programs (``core.state.make_fused_sharded``).
+
+    ``k_q`` ([Q] i32, optional) makes the merge RAGGED (ISSUE 7): the
+    combine still runs to the static ``k`` ceiling, but each query's
+    merged list is masked at its OWN k boundary — scores past it become
+    NEG_INF and rows route to ``sentinel`` — so one compiled distributed
+    kernel serves a mixed-k batch. The masked merge is exactly the
+    per-query top-``k_i``: the ceiling merge is score-sorted."""
     all_s = jax.lax.all_gather(top_s, axis)                 # [n, Q, k_l]
     all_i = jax.lax.all_gather(top_i, axis)
     q = top_s.shape[0]
@@ -58,6 +66,10 @@ def sharded_topk_merge(axis: str, top_s: jax.Array, top_i: jax.Array,
     all_i = jnp.moveaxis(all_i, 0, 1).reshape(q, -1)
     fin_s, fin_pos = jax.lax.top_k(all_s, k)
     fin_i = jnp.take_along_axis(all_i, fin_pos, axis=1)
+    if k_q is not None:
+        live = jnp.arange(k)[None, :] < k_q[:, None]
+        fin_s = jnp.where(live, fin_s, NEG_INF)
+        fin_i = jnp.where(live, fin_i, sentinel)
     return fin_s, fin_i
 
 

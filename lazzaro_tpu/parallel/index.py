@@ -50,9 +50,10 @@ from lazzaro_tpu.core import state as S
 from lazzaro_tpu.core.index import build_host_csr, split_csr
 from lazzaro_tpu.ops.topk import make_sharded_topk
 from lazzaro_tpu.parallel.mesh import shard_stacked
-from lazzaro_tpu.utils.batching import (decode_topk, empty_results,
-                                        next_pow2, pad_to_pow2,
-                                        unpack_retrieval)
+from lazzaro_tpu.utils.batching import (LRUKernelCache, bucket_size,
+                                        decode_topk, empty_results,
+                                        next_pow2, pad_to_bucket,
+                                        pad_to_pow2, unpack_retrieval)
 from lazzaro_tpu.utils.compat import trace_annotation
 from lazzaro_tpu.utils.telemetry import (default_registry, peak_bytes,
                                          record_device_counters)
@@ -84,7 +85,9 @@ class ShardedMemoryIndex:
                  max_nbr: int = 32, super_gate: float = 0.4,
                  acc_boost: float = 0.05, nbr_boost: float = 0.02,
                  epoch: Optional[float] = None, telemetry=None,
-                 telemetry_hbm: bool = False):
+                 telemetry_hbm: bool = False, serve_ragged: bool = True,
+                 serve_k_max: int = 128, serve_pad_granularity: int = 8,
+                 serve_kernel_cache_max: int = 8):
         self.mesh = mesh
         # Serving telemetry (ISSUE 6): same registry contract as
         # MemoryIndex — spans per dispatch, device counters decoded from
@@ -156,12 +159,20 @@ class ShardedMemoryIndex:
 
         self._k = k
         self._search = make_sharded_topk(mesh, axis, k=k)
+        # Ragged pod serving (ISSUE 7): per-query k/cap/nprobe sidecars,
+        # kernels keyed per MODE at the serve_k_max ceiling.
+        self.serve_ragged = bool(serve_ragged)
+        self.serve_k_max = max(1, int(serve_k_max))
+        self.serve_pad_granularity = max(1, int(serve_pad_granularity))
         # Classic pod serving kernels (serve_fused=False A/B + fallback),
         # keyed by the batch max-k pow2 bucket so a request's k above the
         # construction-time default retraces instead of truncating.
-        self._serve_search_cache: Dict[int, object] = {}
-        # Fused distributed serving programs, keyed (mode, k_bucket).
-        self._fused_cache: Dict[Tuple[str, int], S.FusedShardedKernels] = {}
+        # LRU-capped (ISSUE 7 satellite) like the fused cache below.
+        self._serve_search_cache = LRUKernelCache(serve_kernel_cache_max)
+        # Fused distributed serving programs — per-mode keys with ragged
+        # serving, (mode, k_bucket) without; LRU-capped so mixed-k
+        # non-ragged traffic can no longer grow it without bound.
+        self._fused_cache = LRUKernelCache(serve_kernel_cache_max)
 
     # ------------------------------------------------------------------ util
     def _reshard(self, pytree):
@@ -486,16 +497,20 @@ class ShardedMemoryIndex:
         self._ivf_tabs_cache = (k_bucket, tabs)
         return tabs
 
-    def _fused_kernels(self, mode: str, k_bucket: int,
-                       nprobe: int) -> S.FusedShardedKernels:
-        key = (mode, k_bucket, nprobe)
+    def _fused_kernels(self, mode: str, k_bucket: int, nprobe: int,
+                       ragged: bool = False) -> S.FusedShardedKernels:
+        # With ragged kernels k_bucket/nprobe are the fixed per-mode
+        # ceilings, so the cache key collapses to one entry per mode.
+        key = ((mode, "ragged", k_bucket, nprobe) if ragged
+               else (mode, k_bucket, nprobe))
         kern = self._fused_cache.get(key)
         if kern is None:
             kern = S.make_fused_sharded(
                 self.mesh, self.axis, k=k_bucket,
                 cap_take=min(self.cap_take, k_bucket), max_nbr=self.max_nbr,
-                mode=mode, slack=self.coarse_slack, nprobe=nprobe)
-            self._fused_cache[key] = kern
+                mode=mode, slack=self.coarse_slack, nprobe=nprobe,
+                ragged=ragged)
+            self._fused_cache.put(key, kern)
             self.telemetry.gauge("kernel.cache_entries",
                                  len(self._fused_cache),
                                  labels={"surface": "pod_fused"})
@@ -519,11 +534,21 @@ class ShardedMemoryIndex:
         if nq == 0 or not self.id_to_row:
             return results
         dim = self.dim
+        ragged = self.serve_ragged and self.serve_fused
+        cap_s = self.cap_take
+        if ragged:
+            # static per-mode k ceiling: the kernel key never depends on
+            # the batch's k mix (ISSUE 7)
+            k_bucket = int(min(max(self.serve_k_max, cap_s, 1),
+                               self.capacity))
+            cap_s = min(self.cap_take, k_bucket)
         q = np.zeros((nq, dim), np.float32)
         valid = np.zeros((nq,), bool)
         tids = np.full((nq,), -1, np.int32)
         gate_on = np.zeros((nq,), bool)
         boost_on = np.zeros((nq,), bool)
+        k_arr = np.zeros((nq,), np.int32)
+        cap_arr = np.zeros((nq,), np.int32)
         for i, r in enumerate(reqs):
             v = np.asarray(r.query, np.float32).reshape(-1)
             tid = self._tenants.get(r.tenant)
@@ -534,21 +559,34 @@ class ShardedMemoryIndex:
             tids[i] = tid
             gate_on[i] = bool(getattr(r, "gate_enabled", False))
             boost_on[i] = bool(getattr(r, "boost", False))
+            if ragged:
+                k_arr[i] = min(max(int(r.k), cap_s, 1), k_bucket)
+                rc = getattr(r, "cap_take", None)
+                cap_arr[i] = min(int(rc) if rc else cap_s, cap_s)
         if not valid.any():
             return results
-        k_req = max((min(int(r.k), self.capacity)
-                     for i, r in enumerate(reqs) if valid[i]), default=1)
-        k_eff = max(self.cap_take, k_req, 1)
-        k_bucket = min(max(next_pow2(k_eff), 1), self.capacity)
-        qp = pad_to_pow2(q)
+        if not ragged:
+            k_req = max((min(int(r.k), self.capacity)
+                         for i, r in enumerate(reqs) if valid[i]),
+                        default=1)
+            k_eff = max(self.cap_take, k_req, 1)
+            k_bucket = min(max(next_pow2(k_eff), 1), self.capacity)
+        # Ragged batches bucket LINEARLY (granularity slots of worst-case
+        # padding) instead of to the next power of two (~50% worst case —
+        # the pow2 padding tax this PR kills).
+        qp = (pad_to_bucket(q, self.serve_pad_granularity) if ragged
+              else pad_to_pow2(q))
         pad_n = qp.shape[0]
         tel = self.telemetry
-        # Coalesce/pad inflation baseline for ROADMAP item 4 (ragged
-        # serving): padded kernel slots vs live requests, max-k bucket.
+        # Coalesce/pad inflation: padded kernel slots vs live requests,
+        # kernel k (max-k bucket, or the ragged ceiling).
         tel.bump("serve.live_requests", nq)
         tel.bump("serve.padded_slots", pad_n)
         tel.gauge("serve.batch_occupancy", nq / pad_n)
         tel.record("serve.k_bucket", k_bucket)
+        if ragged:
+            for kv in k_arr[valid]:
+                tel.record("serve.k_request", float(kv))
 
         def padb(arr, fill=False, dt=bool):
             out = np.full((pad_n,), fill, dt)
@@ -570,13 +608,32 @@ class ShardedMemoryIndex:
             nprobe = 0
             mode = "quant" if use_quant else "exact"
             tables = self._int8_shadow_for() if use_quant else ()
-        kern = self._fused_kernels(mode, k_bucket, nprobe)
+        kern = self._fused_kernels(mode, k_bucket, nprobe, ragged=ragged)
         csr_i, csr_n = self._csr_sharded()
         args = (tables, csr_i, csr_n, jnp.asarray(qp),
                 jnp.asarray(padb(valid)),
                 jnp.asarray(padb(tids, -1, np.int32)),
                 jnp.asarray(padb(gate_on)))
-        self._maybe_record_hbm(mode, kern, args, k_bucket)
+        if ragged:
+            # per-query sidecar columns (replicated over the mesh): k,
+            # retrieval cap, and — for the IVF modes — probe width
+            k_dev = jnp.asarray(padb(k_arr, 0, np.int32))
+            capq_dev = jnp.asarray(padb(cap_arr, 0, np.int32))
+            if ivf_tabs is not None:
+                np_arr = np.zeros((nq,), np.int32)
+                for i, r in enumerate(reqs):
+                    rn = getattr(r, "nprobe", None)
+                    np_arr[i] = (min(max(int(rn), 1), nprobe) if rn
+                                 else nprobe)
+                np_arr[~valid] = 0
+            else:
+                np_arr = np.zeros((nq,), np.int32)
+            npq_dev = jnp.asarray(padb(np_arr, 0, np.int32))
+            read_extra = (k_dev, npq_dev, jnp.float32(self.super_gate))
+        else:
+            read_extra = (jnp.float32(self.super_gate),)
+        self._maybe_record_hbm(mode, kern, args, k_bucket,
+                               read_extra=read_extra, ragged=ragged)
         t0 = time.perf_counter()
         with trace_annotation(f"lz.serve.pod_{mode}"):
             if boost_on.any():
@@ -586,8 +643,11 @@ class ShardedMemoryIndex:
                     fn = (kern.serve
                           if sys.getrefcount(cur) <= self._SOLE_REFS
                           else kern.serve_copy)
+                    boost_extra = ((jnp.asarray(padb(boost_on)), k_dev,
+                                    capq_dev, npq_dev) if ragged
+                                   else (jnp.asarray(padb(boost_on)),))
                     new_state, packed = self._dispatch(
-                        fn, cur, *args, jnp.asarray(padb(boost_on)),
+                        fn, cur, *args, *boost_extra,
                         jnp.float32(now_rel), jnp.float32(self.super_gate),
                         jnp.float32(self.acc_boost),
                         jnp.float32(self.nbr_boost))
@@ -595,7 +655,7 @@ class ShardedMemoryIndex:
                     self.state = new_state
             else:
                 packed = self._dispatch(kern.read, self.state, *args,
-                                        jnp.float32(self.super_gate))
+                                        *read_extra)
             host = np.asarray(packed)          # the ONE readback
         tel.record("serve.dispatch_ms", (time.perf_counter() - t0) * 1e3,
                    labels={"mode": f"pod_{mode}"})
@@ -608,7 +668,8 @@ class ShardedMemoryIndex:
                 res = results[i]
                 ids, scores = decode_topk(
                     ann_s[i:i + 1], ann_r[i:i + 1], self.row_to_id,
-                    NEG_INF, limit=min(int(r.k), self.capacity))[0]
+                    NEG_INF, limit=min(int(r.k), self.capacity),
+                    lengths=(counters[i:i + 1, 0] if ragged else None))[0]
                 res.ids, res.scores = ids, scores
                 if gate_s[i] > NEG_INF / 2:
                     res.gate_id = self.row_to_id.get(int(gate_r[i]))
@@ -620,19 +681,22 @@ class ShardedMemoryIndex:
             np.asarray([min(int(r.k), self.capacity) for r in reqs]))
         return results
 
-    def _maybe_record_hbm(self, mode: str, kern, args, k_bucket) -> None:
+    def _maybe_record_hbm(self, mode: str, kern, args, k_bucket,
+                          read_extra=None, ragged: bool = False) -> None:
         """Opt-in peak-HBM gauge for one pod serving geometry (AOT lower +
         ``memory_analysis()`` of the read twin; one extra compile, zero
         extra dispatches)."""
         if not self.telemetry_hbm:
             return
-        key = (mode, k_bucket)
+        key = (mode, k_bucket, ragged)
         if key in self._hbm_recorded:
             return
         self._hbm_recorded.add(key)
+        if read_extra is None:
+            read_extra = (jnp.float32(self.super_gate),)
         try:
             peak = peak_bytes(kern.read.lower(
-                self.state, *args, jnp.float32(self.super_gate)
+                self.state, *args, *read_extra
             ).compile().memory_analysis())
         except Exception:   # noqa: BLE001 — never fail the serve
             return
@@ -642,6 +706,52 @@ class ShardedMemoryIndex:
                 labels={"mode": f"pod_{mode}", "k": str(k_bucket),
                         "rows": str(self.capacity + 1),
                         "mesh": f"{self.n_parts}x{self.axis}"})
+
+    def warmup_serving(self, geometries=(8, 64),
+                       k: Optional[int] = None) -> Dict[tuple, float]:
+        """Pod twin of ``MemoryIndex.warmup_serving`` (ISSUE 7 satellite):
+        pre-compile the distributed fused serving program for the given
+        query-batch geometries by driving ``serve_requests`` with a
+        synthetic tenant that owns no rows — a numeric no-op on the arena
+        that populates exactly the jit cache entries live traffic hits.
+        Telemetry counters are suppressed while warming; wall time lands
+        in ``kernel.warmup_ms{mode,batch}``."""
+        from lazzaro_tpu.serve.scheduler import RetrievalRequest
+
+        out: Dict[tuple, float] = {}
+        if not self.id_to_row:
+            return out
+        tel = self.telemetry
+        mode = ("quant" if self.int8_serving else "exact")
+        if self._ivf is not None:
+            mode = "ivf_quant" if self.int8_serving else "ivf"
+        self._tenants.setdefault("~warmup", -2)   # matches no arena row
+        kk = int(k if k is not None else self.serve_k_max)
+        buckets = sorted({
+            (bucket_size(g, self.serve_pad_granularity)
+             if (self.serve_ragged and self.serve_fused) else next_pow2(g))
+            for g in geometries if g > 0})
+        for g in buckets:
+            zero_q = np.zeros((self.dim,), np.float32)
+            t0 = time.perf_counter()
+            prev = tel.enabled
+            tel.enabled = False
+            try:
+                self.serve_requests(
+                    [RetrievalRequest(query=zero_q, tenant="~warmup", k=kk,
+                                      gate_enabled=True, boost=(i == 0))
+                     for i in range(g)])
+                self.serve_requests(
+                    [RetrievalRequest(query=zero_q, tenant="~warmup", k=kk,
+                                      gate_enabled=True)
+                     for i in range(g)])
+            finally:
+                tel.enabled = prev
+            ms = (time.perf_counter() - t0) * 1e3
+            tel.record("kernel.warmup_ms", ms,
+                       labels={"mode": f"pod_{mode}", "batch": str(g)})
+            out[(f"pod_{mode}", g)] = ms
+        return out
 
     def _serve_classic(self, reqs, results, valid, qp, tids, k_bucket):
         """The pre-ISSUE-5 pod path, kept for A/B and fallback: ONE
@@ -655,7 +765,7 @@ class ShardedMemoryIndex:
         if kern is None:
             kern = make_sharded_multitenant_topk(self.mesh, self.axis,
                                                  k=k_bucket)
-            self._serve_search_cache[k_bucket] = kern
+            self._serve_search_cache.put(k_bucket, kern)
         norms = np.maximum(np.linalg.norm(qp, axis=1, keepdims=True), 1e-9)
         tp = np.full((qp.shape[0],), -1, np.int32)
         tp[:len(tids)] = tids

@@ -74,6 +74,11 @@ class RetrievalRequest:
     gate_enabled: bool = False
     boost: bool = False
     super_filter: int = -1      # reserved; the fused kernel serves both tiers
+    # Ragged per-request knobs (ISSUE 7): ride into the fused kernel as
+    # int32 sidecar data, so one compiled kernel serves any mix. None =
+    # the index's configured default (retrieval cap / build nprobe).
+    cap_take: Optional[int] = None   # per-request boost/retrieval cap
+    nprobe: Optional[int] = None     # per-request IVF probe width
 
 
 @dataclass
@@ -92,15 +97,30 @@ Executor = Callable[[List[RetrievalRequest]], List[RetrievalResult]]
 class QueryScheduler:
     """Coalesce concurrent retrievals into dense device batches.
 
-    One daemon worker thread pops up to ``max_batch`` pending requests per
-    flush and runs ``executor`` on them; callers block on per-request
-    futures. ``max_wait_us`` bounds the latency a lone request pays for
-    batching (default 2 ms — noise next to the ~70 ms tunnel round trip it
-    amortizes). ``close()`` drains pending work before returning."""
+    One daemon worker thread pops pending requests and runs ``executor``
+    on them; callers block on per-request futures. ``close()`` drains
+    pending work before returning.
+
+    Two batching disciplines (ISSUE 7):
+
+    - **continuous** (default): requests admit into the NEXT dispatch the
+      moment the worker is free — the in-flight dispatch is the batching
+      window. A lone request on an idle scheduler ships immediately
+      (latency = dispatch time, never the flush timeout), and arrivals
+      during a dispatch coalesce into the next one without any timer.
+      Per-tenant admission control (``tenant_max_inflight``) caps how
+      many of one tenant's requests enter a single dispatch, walking the
+      queue oldest-first so over-cap requests keep their place for the
+      next batch — one flooding tenant cannot monopolize the device.
+    - **flush-boundary** (``continuous=False``, the PR 2–6 policy): a
+      batch ships when it holds ``max_batch`` requests or its oldest has
+      waited ``max_wait_us`` (default 2 ms). Kept for A/B and fallback.
+    """
 
     def __init__(self, executor: Executor, max_batch: int = 64,
                  max_wait_us: int = 2000, name: str = "lz-query-scheduler",
-                 telemetry=None):
+                 telemetry=None, continuous: bool = True,
+                 tenant_max_inflight: int = 0):
         self._executor = executor
         # Serving telemetry (ISSUE 6): every request records its
         # enqueue→flush queue wait (per-tenant label), every flushed batch
@@ -109,12 +129,15 @@ class QueryScheduler:
         self.telemetry = telemetry if telemetry is not None \
             else default_registry()
         self.policy = FlushPolicy(max_batch, max_wait_us / 1e6)
+        self.continuous = bool(continuous)
+        self.tenant_max_inflight = max(0, int(tenant_max_inflight))
         self._cond = threading.Condition()
         self._pending: List[Tuple[RetrievalRequest, Future, float]] = []
         self._inflight = 0
         self._closed = False
         self.batches_flushed = 0
         self.requests_served = 0
+        self.requests_deferred = 0           # tenant-cap admission defers
         self.batch_sizes: List[int] = []     # observability (bench reads it)
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name=name)
@@ -150,17 +173,20 @@ class QueryScheduler:
                     now = time.time()
                     oldest = self._pending[0][2] if self._pending else None
                     if self._pending and (
-                            self._closed
+                            self._closed or self.continuous
                             or self.policy.should_flush(len(self._pending),
                                                         now, oldest)):
+                        # continuous mode: the worker being free IS the
+                        # flush signal — pending work admits immediately
+                        # (ISSUE 7 lone-request fix: no serve_flush_us
+                        # wait on an idle scheduler).
                         break
                     if self._closed:
                         return
                     timeout = (self.policy.wait_remaining(now, oldest)
                                if self._pending else None)
                     self._cond.wait(timeout)
-                batch = self._pending[:self.policy.max_items]
-                del self._pending[:len(batch)]
+                batch = self._admit_locked()
                 self._inflight += 1
             try:
                 self._execute(batch)
@@ -168,6 +194,37 @@ class QueryScheduler:
                 with self._cond:
                     self._inflight -= 1
                     self._cond.notify_all()
+
+    def _admit_locked(self) -> List[Tuple[RetrievalRequest, Future, float]]:
+        """Pop the next dispatch's batch from the pending queue (caller
+        holds the lock). Oldest-first; at most ``max_batch``; with a
+        tenant cap, at most ``tenant_max_inflight`` requests per tenant
+        admit — over-cap requests KEEP their queue position (fairness:
+        the deferred oldest request is first in line next dispatch)."""
+        limit = self.policy.max_items
+        cap = self.tenant_max_inflight
+        if not cap:
+            batch = self._pending[:limit]
+            del self._pending[:len(batch)]
+            return batch
+        batch: List[Tuple[RetrievalRequest, Future, float]] = []
+        kept: List[Tuple[RetrievalRequest, Future, float]] = []
+        counts: dict = {}
+        deferred = 0
+        for item in self._pending:
+            tenant = item[0].tenant
+            if len(batch) < limit and counts.get(tenant, 0) < cap:
+                batch.append(item)
+                counts[tenant] = counts.get(tenant, 0) + 1
+            else:
+                kept.append(item)
+                if len(batch) < limit:
+                    deferred += 1        # capped out, not batch-full
+        self._pending = kept
+        if deferred:
+            self.requests_deferred += deferred
+            self.telemetry.bump("serve.admission_deferred", deferred)
+        return batch
 
     def _execute(self, batch) -> None:
         reqs = [req for req, _, _ in batch]
@@ -225,6 +282,8 @@ class QueryScheduler:
             return {
                 "batches_flushed": self.batches_flushed,
                 "requests_served": self.requests_served,
+                "requests_deferred": self.requests_deferred,
+                "continuous": self.continuous,
                 "pending": len(self._pending),
                 "mean_batch": (round(float(np.mean(sizes)), 2)
                                if sizes else None),

@@ -64,9 +64,76 @@ def pad_to_pow2(arr: np.ndarray) -> np.ndarray:
     return np.concatenate([arr, pad])
 
 
+def bucket_size(n: int, granularity: int) -> int:
+    """Query-batch bucket of the ragged serving path (ISSUE 7): LINEAR
+    multiples of ``granularity`` instead of powers of two once batches
+    pass the granularity — pow2 wastes up to ~50% of every dispatch's
+    padded slots (a 33-request batch pays 64 kernel slots), linear
+    buckets waste at most ``granularity - 1``. Below the granularity the
+    pow2 ladder is kept (1, 2, 4): a lone request must keep costing a
+    1-slot dispatch, not ``granularity`` slots. Distinct jit
+    specializations stay bounded either way (log2(g) small buckets +
+    max_batch/g linear ones)."""
+    g = max(1, int(granularity))
+    n = max(1, int(n))
+    if n <= g:
+        return next_pow2(n)
+    return -(-n // g) * g
+
+
+def pad_to_bucket(arr: np.ndarray, granularity: int) -> np.ndarray:
+    """Pad axis 0 with zero rows up to the linear ``granularity`` bucket
+    (the ragged-serving replacement for :func:`pad_to_pow2`)."""
+    n = arr.shape[0]
+    bucket = bucket_size(n, granularity)
+    if bucket == n:
+        return arr
+    pad = np.zeros((bucket - n,) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+class LRUKernelCache:
+    """Tiny LRU map bounding a compiled-kernel cache (ISSUE 7 satellite):
+    before ragged serving, per-(mode × k-bucket) keys grew without bound
+    under mixed-k traffic and ``kernel.cache_entries`` could only watch;
+    now the cap evicts the least-recently-served program (dropping a jit
+    wrapper frees its compiled executables once no caller holds it).
+    Not thread-safe by itself — callers serialize through their own
+    locks (the serving dispatch already does)."""
+
+    def __init__(self, max_entries: int = 8):
+        from collections import OrderedDict
+        self.max_entries = max(1, int(max_entries))
+        self._d = OrderedDict()
+        self.evictions = 0
+
+    def get(self, key):
+        v = self._d.get(key)
+        if v is not None:
+            self._d.move_to_end(key)
+        return v
+
+    def put(self, key, value) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.max_entries:
+            self._d.popitem(last=False)
+            self.evictions += 1
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def keys(self):
+        return list(self._d.keys())
+
+
 def decode_topk(scores: np.ndarray, rows: np.ndarray,
                 row_to_id: Dict[int, str], neg_inf: float,
-                limit: Optional[int] = None
+                limit: Optional[int] = None,
+                lengths: Optional[Sequence[int]] = None
                 ) -> List[Tuple[List[str], List[float]]]:
     """Per query: drop NEG_INF sentinels, rows without a live id mapping,
     and repeated rows (a slot reused after delete can appear in both a
@@ -74,13 +141,20 @@ def decode_topk(scores: np.ndarray, rows: np.ndarray,
     descending, so keeping the first occurrence keeps the best); return
     (ids, scores) pairs. ``limit`` caps each list AFTER dedup — the IVF
     serving path over-fetches k + slack so duplicates can't shrink the
-    result below k, then trims back here."""
+    result below k, then trims back here. ``lengths`` is the RAGGED
+    decode bound (ISSUE 7): the packed readback's per-query live-length
+    counter, so a k=4 request in a K-ceiling batch scans 4 columns of its
+    row instead of all K (live entries are a sorted prefix — everything
+    past a query's own k was masked to NEG_INF on device)."""
     out: List[Tuple[List[str], List[float]]] = []
     for qi in range(scores.shape[0]):
         ids: List[str] = []
         sc: List[float] = []
         seen = set()
-        for s, r in zip(scores[qi], rows[qi]):
+        n_cols = scores.shape[1]
+        if lengths is not None:
+            n_cols = min(n_cols, max(0, int(lengths[qi])))
+        for s, r in zip(scores[qi, :n_cols], rows[qi, :n_cols]):
             if limit is not None and len(ids) >= limit:
                 break
             if s <= neg_inf / 2:

@@ -36,6 +36,15 @@ the paths passed as arguments) and exits nonzero if:
     ``pad_waste_fraction`` fails to record it: measured waste that the
     artifact under-reports is the one observability regression this
     whole layer exists to prevent,
+  - (ISSUE 7) a RAGGED artifact (any top-level dict with ``"ragged":
+    true``) records a ``pad_waste_fraction`` above 0.15 — the whole
+    point of the ragged layout is killing the pow2 padding tax, so
+    waste creeping back past the linear-bucket ceiling is a
+    regression — or records ``compile_cache_entries`` >
+    ``modes_exercised`` (a per-k or per-shape kernel specialization
+    snuck back in; ragged kernels are keyed per (mode × geometry)
+    only); pre-ragged artifacts (``pr2_``…``pr6_`` prefixes) are
+    grandfathered,
 
 so any of these regressions turns red in CI instead of shipping.
 
@@ -54,11 +63,20 @@ import sys
 # telemetry-block requirement (their numbers are still gate-checked).
 _PRE_TELEMETRY_PREFIXES = ("pr2_", "pr3_", "pr4_", "pr5_")
 
+# Artifacts from before ragged serving existed: exempt from the padding
+# ceiling and the compile-cache bound (their pow2 waste is the measured
+# BASELINE the ragged numbers are judged against, not a regression).
+_PRE_RAGGED_PREFIXES = _PRE_TELEMETRY_PREFIXES + ("pr6_",)
+
+# Hard ceiling on recorded padding waste for ragged artifacts: linear
+# pad buckets admit at most ~15% dead slots at the smallest bucket.
+_RAGGED_PAD_WASTE_MAX = 0.15
+
 _TELEMETRY_KEYS = ("pad_waste_fraction", "queue_wait_ms_p50",
                    "queue_wait_ms_p95", "peak_hbm_bytes")
 
 
-def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks):
+def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -70,16 +88,19 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks):
         if "dispatches_per_turn" in obj or "telemetry" in obj:
             tel_blocks.append((path, "dispatches_per_turn" in obj,
                                obj.get("telemetry")))
+        if obj.get("ragged") is True:
+            raggeds.append((path, obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k == "dispatches_per_turn":
                 hits.append((here, v))
             else:
-                _walk(v, here, hits, recalls, speedups, meshes, tel_blocks)
+                _walk(v, here, hits, recalls, speedups, meshes, tel_blocks,
+                      raggeds)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
             _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
-                  tel_blocks)
+                  tel_blocks, raggeds)
 
 
 def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
@@ -115,6 +136,35 @@ def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
                              f"records {got!r}"))
 
 
+def _check_ragged(loc, obj, bad):
+    """The ISSUE 7 ragged-serving gate on one ``"ragged": true`` dict."""
+    tel = obj.get("telemetry")
+    waste = (tel or {}).get("pad_waste_fraction") \
+        if isinstance(tel, dict) else None
+    try:
+        waste_ok = float(waste) <= _RAGGED_PAD_WASTE_MAX
+    except (TypeError, ValueError):
+        waste_ok = False
+    if not waste_ok:
+        bad.append((loc, f"ragged artifact records pad_waste_fraction "
+                         f"{waste!r} (must be <= {_RAGGED_PAD_WASTE_MAX} "
+                         f"— the pow2 padding tax crept back)"))
+    entries = obj.get("compile_cache_entries")
+    modes = obj.get("modes_exercised")
+    if entries is None or modes is None:
+        bad.append((loc, "ragged artifact must record both "
+                         "'compile_cache_entries' and 'modes_exercised'"))
+        return
+    try:
+        cache_ok = int(entries) <= int(modes)
+    except (TypeError, ValueError):
+        cache_ok = False
+    if not cache_ok:
+        bad.append((loc, f"compile_cache_entries == {entries!r} > "
+                         f"modes_exercised {modes!r} (a per-k kernel "
+                         f"specialization snuck back in)"))
+
+
 def main(argv):
     if argv:
         paths = argv
@@ -127,6 +177,7 @@ def main(argv):
     checked_speedup = 0
     checked_mesh = 0
     checked_telemetry = 0
+    checked_ragged = 0
     bad = []
     for p in paths:
         try:
@@ -135,14 +186,19 @@ def main(argv):
         except (OSError, ValueError) as e:
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
-        hits, recalls, speedups, meshes, tel_blocks = [], [], [], [], []
+        hits, recalls, speedups, meshes, tel_blocks, raggeds = \
+            [], [], [], [], [], []
         _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
-              tel_blocks)
+              tel_blocks, raggeds)
         grandfathered = os.path.basename(p).startswith(
             _PRE_TELEMETRY_PREFIXES)
         for loc, measured_fused, block in tel_blocks:
             checked_telemetry += 1
             _check_telemetry(loc, measured_fused, block, grandfathered, bad)
+        if not os.path.basename(p).startswith(_PRE_RAGGED_PREFIXES):
+            for loc, obj in raggeds:
+                checked_ragged += 1
+                _check_ragged(loc, obj, bad)
         for loc, v in hits:
             checked += 1
             if v != 1:
@@ -175,8 +231,9 @@ def main(argv):
         print(f"REGRESSION: {loc}: {msg}")
     print(f"[check] {checked} dispatches_per_turn value(s), "
           f"{checked_recall} recall pair(s), {checked_speedup} speedup "
-          f"pair(s), {checked_mesh} sharded artifact(s), and "
-          f"{checked_telemetry} telemetry block(s) across "
+          f"pair(s), {checked_mesh} sharded artifact(s), "
+          f"{checked_telemetry} telemetry block(s), and "
+          f"{checked_ragged} ragged gate(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 

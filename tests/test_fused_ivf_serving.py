@@ -38,7 +38,11 @@ def _system(tmp, serve_fused=True, nprobe=4, per=20, super_threshold=100,
         super_node_threshold=super_threshold,
         config=MemoryConfig(journal=False, auto_consolidate=False,
                             decay_rate=0.0, ivf_serving=nprobe,
-                            int8_serving=int8))
+                            int8_serving=int8,
+                            # tier-1 arenas are tiny: the ragged k ceiling
+                            # must stay below the visited-candidate count
+                            # or the IVF pack falls back to the dense scan
+                            serve_k_max=16))
     ms.config.serve_fused = serve_fused
     return ms
 
@@ -60,6 +64,11 @@ _COUNTED = ("search_fused_ivf", "search_fused_ivf_copy",
             "search_fused_ivf_read", "search_fused_quant",
             "search_fused_quant_copy", "search_fused_quant_read",
             "search_fused", "search_fused_copy", "search_fused_read",
+            "search_fused_ivf_ragged", "search_fused_ivf_ragged_copy",
+            "search_fused_ivf_ragged_read", "search_fused_quant_ragged",
+            "search_fused_quant_ragged_copy",
+            "search_fused_quant_ragged_read", "search_fused_ragged",
+            "search_fused_ragged_copy", "search_fused_ragged_read",
             "arena_search", "arena_update_access",
             "arena_update_access_copy", "arena_boost", "arena_boost_copy",
             "arena_apply_boosts", "arena_apply_boosts_copy")
@@ -90,9 +99,9 @@ def test_one_ivf_dispatch_per_chat_turn(monkeypatch):
         ms.chat("fact 3 body")                 # warm: compiles the kernel
         calls = _count_dispatches(monkeypatch)
         ms.chat("fact 7 body")
-        assert calls["search_fused_ivf"] == 1      # donated single-writer
+        assert calls["search_fused_ivf_ragged"] == 1   # donated single-writer
         for name in calls:
-            if name != "search_fused_ivf":
+            if name != "search_fused_ivf_ragged":
                 assert calls[name] == 0, (name, calls)
         ms.close()
 
@@ -107,10 +116,10 @@ def test_ivf_search_memories_takes_readonly_twin(monkeypatch):
         calls = _count_dispatches(monkeypatch)
         hits = ms.search_memories("fact 3 body")
         assert hits
-        assert calls["search_fused_ivf_read"] == 1
-        assert calls["search_fused_ivf"] == 0
+        assert calls["search_fused_ivf_ragged_read"] == 1
+        assert calls["search_fused_ivf_ragged"] == 0
         ms.search_memories_batch([f"fact {i} body" for i in range(8)])
-        assert calls["search_fused_ivf_read"] == 2
+        assert calls["search_fused_ivf_ragged_read"] == 2
         ms.close()
 
 
@@ -337,7 +346,7 @@ def test_ivf_int8_composition_single_dispatch(monkeypatch):
     idx.search_fused_requests(reqs, **kw)      # warm + shadow build
     calls = _count_dispatches(monkeypatch)
     res = idx.search_fused_requests(reqs, **kw)
-    assert calls["search_fused_ivf_read"] == 1
+    assert calls["search_fused_ivf_ragged_read"] == 1
     assert sum(calls.values()) == 1
     for i, r in enumerate(res):
         assert r.ids[0] == f"m{i}"             # exact rescore self-hit
@@ -381,6 +390,6 @@ def test_no_build_falls_back_to_dense_fused(monkeypatch):
         calls = _count_dispatches(monkeypatch)
         hits = ms.search_memories("fact 3 body")
         assert hits
-        assert calls["search_fused_read"] == 1
-        assert calls["search_fused_ivf_read"] == 0
+        assert calls["search_fused_ragged_read"] == 1
+        assert calls["search_fused_ivf_ragged_read"] == 0
         ms.close()

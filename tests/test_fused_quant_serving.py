@@ -48,7 +48,11 @@ def _ingest(ms, convs=2):
 
 _COUNTED = ("search_fused_quant", "search_fused_quant_copy",
             "search_fused_quant_read", "search_fused", "search_fused_copy",
-            "search_fused_read", "arena_search", "arena_update_access",
+            "search_fused_read", "search_fused_quant_ragged",
+            "search_fused_quant_ragged_copy",
+            "search_fused_quant_ragged_read", "search_fused_ragged",
+            "search_fused_ragged_copy", "search_fused_ragged_read",
+            "arena_search", "arena_update_access",
             "arena_update_access_copy", "arena_boost", "arena_boost_copy",
             "arena_apply_boosts", "arena_apply_boosts_copy")
 
@@ -86,9 +90,9 @@ def test_one_quant_dispatch_per_chat_turn(monkeypatch):
         ms.chat("fact 3 body")                 # warm: builds the int8 shadow
         calls = _count_dispatches(monkeypatch)
         ms.chat("fact 7 body")
-        assert calls["search_fused_quant"] == 1    # donated single-writer
+        assert calls["search_fused_quant_ragged"] == 1  # donated single-writer
         for name in calls:
-            if name != "search_fused_quant":
+            if name != "search_fused_quant_ragged":
                 assert calls[name] == 0, (name, calls)
         ms.close()
 
@@ -102,11 +106,11 @@ def test_quant_search_memories_takes_readonly_twin(monkeypatch):
         calls = _count_dispatches(monkeypatch)
         hits = ms.search_memories("fact 3 body")
         assert hits
-        assert calls["search_fused_quant_read"] == 1
-        assert calls["search_fused_quant"] == 0
+        assert calls["search_fused_quant_ragged_read"] == 1
+        assert calls["search_fused_quant_ragged"] == 0
         assert calls["quantized_topk"] == 0
         ms.search_memories_batch([f"fact {i} body" for i in range(8)])
-        assert calls["search_fused_quant_read"] == 2
+        assert calls["search_fused_quant_ragged_read"] == 2
         ms.close()
 
 
@@ -316,7 +320,7 @@ def test_fused_quant_1m_rows_fixture(monkeypatch):
     idx.search_fused_requests(reqs, **kw)      # warm + shadow build
     calls = _count_dispatches(monkeypatch)
     res = idx.search_fused_requests(reqs, **kw)
-    assert calls["search_fused_quant_read"] == 1
+    assert calls["search_fused_quant_ragged_read"] == 1
     assert sum(calls.values()) == 1
     shadow = idx.search_batch(queries, "u0", k=1)
     for i, r in enumerate(probe_rows):

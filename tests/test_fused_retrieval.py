@@ -44,6 +44,8 @@ def _ingest(ms, convs=2):
 
 
 _COUNTED = ("search_fused", "search_fused_copy", "search_fused_read",
+            "search_fused_ragged", "search_fused_ragged_copy",
+            "search_fused_ragged_read",
             "arena_search", "arena_update_access", "arena_update_access_copy",
             "arena_boost", "arena_boost_copy", "arena_apply_boosts",
             "arena_apply_boosts_copy")
@@ -65,33 +67,35 @@ def _count_dispatches(monkeypatch):
 def test_one_fused_dispatch_per_chat_turn(monkeypatch):
     """The jit-call counter: a chat turn's retrieval (gate + ANN + neighbor
     boost + access boost) costs exactly ONE device dispatch — the donated
-    ``search_fused`` program — and zero classic search/boost dispatches."""
+    ragged ``search_fused_ragged`` program (ISSUE 7: per-query k rides as
+    device data) — and zero classic search/boost dispatches."""
     with tempfile.TemporaryDirectory() as tmp:
         ms = _ingest(_system(tmp))
         ms.start_conversation()
         calls = _count_dispatches(monkeypatch)
         ms.chat("fact 7 body")
-        assert calls["search_fused"] == 1      # donated: single-writer path
+        assert calls["search_fused_ragged"] == 1  # donated single-writer
         for name in _COUNTED:
-            if name != "search_fused":
+            if name != "search_fused_ragged":
                 assert calls[name] == 0, (name, calls)
         ms.close()
 
 
 def test_search_memories_takes_readonly_twin(monkeypatch):
     """A pure read (no boosts requested anywhere in the batch) must take
-    ``search_fused_read`` — same compute, no donation dance, ONE dispatch."""
+    the ragged read twin — same compute, no donation dance, ONE dispatch."""
     with tempfile.TemporaryDirectory() as tmp:
         ms = _ingest(_system(tmp))
         calls = _count_dispatches(monkeypatch)
         hits = ms.search_memories("fact 3 body")
         assert hits
-        assert calls["search_fused_read"] == 1
+        assert calls["search_fused_ragged_read"] == 1
+        assert calls["search_fused_ragged"] == 0
         assert calls["search_fused"] == calls["search_fused_copy"] == 0
         assert calls["arena_search"] == 0
         # a whole fleet is still one dispatch
         ms.search_memories_batch([f"fact {i} body" for i in range(8)])
-        assert calls["search_fused_read"] == 2
+        assert calls["search_fused_ragged_read"] == 2
         ms.close()
 
 

@@ -1,0 +1,461 @@
+"""Ragged continuous serving (ISSUE 7; tier-1 smoke, CPU, tiny arenas).
+
+Per-query k / cap_take / nprobe ride into the fused serving kernels as
+int32 sidecar DATA instead of trace constants: the scan bodies compute to
+the static per-mode ceiling (``serve_k_max``) and each query masks at its
+own top-k boundary, so ONE compiled kernel per (mode × geometry) serves any
+mix of request shapes. These tests pin:
+
+- bit-exact parity of a mixed-k ragged batch against per-request
+  non-ragged fused serving across exact / quant / IVF / sharded, on
+  gate-hit, gate-miss, and multi-tenant fixtures (including boost
+  numerics on the arena columns);
+- the jit-counter claim: ONE compiled ragged kernel serves k ∈ {4, 16,
+  100} in one dispatch — no per-k retraces;
+- continuous batching: a lone request on an idle scheduler dispatches
+  immediately (never waits the flush timeout), and per-tenant admission
+  control caps a flooding tenant per dispatch with oldest-first fairness;
+- the LRU bound on the compiled-kernel caches and ``warmup_serving``
+  (a warmed geometry adds no jit entries on the first live request).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lazzaro_tpu.core import state as S
+from lazzaro_tpu.core.index import MemoryIndex
+from lazzaro_tpu.serve import (QueryScheduler, RetrievalRequest,
+                               RetrievalResult)
+from lazzaro_tpu.utils.batching import LRUKernelCache, bucket_size
+from lazzaro_tpu.utils.telemetry import Telemetry
+
+D = 16
+KW = dict(cap_take=5, max_nbr=8, super_gate=0.4, acc_boost=0.05,
+          nbr_boost=0.02)
+MIXED_K = (4, 16, 100, 1, 7)
+
+
+def _build(n=120, seed=1, supers=True, two_tenants=True, edges=True, **kw):
+    """Tiny two-tenant arena with supers (gate tier) and a chain graph."""
+    rng = np.random.default_rng(seed)
+    kw.setdefault("serve_k_max", 32)
+    idx = MemoryIndex(dim=D, capacity=256, edge_capacity=1024, **kw)
+    emb = rng.standard_normal((n, D)).astype(np.float32)
+    n_a = n - 20 if two_tenants else n
+    ids_a = [f"a{i}" for i in range(n_a)]
+    sup = [supers and i % 11 == 0 for i in range(n_a)]
+    idx.ingest_batch(ids_a, emb[:n_a], [0.5] * n_a, [0.0] * n_a,
+                     ["semantic"] * n_a, ["s"] * n_a, "ta",
+                     is_super=sup,
+                     chain_pairs=(list(zip(ids_a, ids_a[1:]))
+                                  if edges else ()))
+    if two_tenants:
+        ids_b = [f"b{i}" for i in range(20)]
+        idx.ingest_batch(ids_b, emb[n_a:], [0.5] * 20, [0.0] * 20,
+                         ["semantic"] * 20, ["s"] * 20, "tb")
+    return idx, emb
+
+
+def _mixed_reqs(emb, boost=False):
+    reqs = []
+    for i, k in enumerate(MIXED_K):
+        reqs.append(RetrievalRequest(query=emb[3 * i], tenant="ta", k=k,
+                                     gate_enabled=(i % 2 == 0),
+                                     boost=boost))
+    reqs.append(RetrievalRequest(query=emb[-1], tenant="tb", k=6,
+                                 boost=boost))
+    return reqs
+
+
+def _assert_matches_per_request(ragged_res, reqs, classic_idx, k_max):
+    """Each ragged result must equal the same request served alone through
+    the non-ragged fused path (k above the ceiling truncates to it)."""
+    for req, got in zip(reqs, ragged_res):
+        solo = classic_idx.search_fused_requests(
+            [RetrievalRequest(query=req.query, tenant=req.tenant,
+                              k=req.k, gate_enabled=req.gate_enabled)],
+            **KW)[0]
+        kc = min(int(req.k), k_max)
+        assert got.ids == solo.ids[:kc], (req.k, got.ids[:3], solo.ids[:3])
+        np.testing.assert_allclose(got.scores, solo.scores[:kc], rtol=1e-5)
+        assert got.fast == solo.fast
+        if got.gate_id is not None and kc == min(int(req.k), k_max):
+            assert got.gate_id == solo.gate_id
+
+
+# ------------------------------------------------------------ mixed-k parity
+def test_mixed_k_parity_exact():
+    idx, emb = _build()
+    classic, _ = _build(serve_ragged=False)
+    reqs = _mixed_reqs(emb)
+    res = idx.search_fused_requests(reqs, **KW)
+    for req, r in zip(reqs, res):
+        assert len(r.ids) == min(int(req.k), 32)
+    _assert_matches_per_request(res, reqs, classic, k_max=32)
+
+
+def test_mixed_k_parity_quant():
+    idx, emb = _build(int8_serving=True)
+    classic, _ = _build(serve_ragged=False, int8_serving=True)
+    reqs = _mixed_reqs(emb)
+    res = idx.search_fused_requests(reqs, **KW)
+    _assert_matches_per_request(res, reqs, classic, k_max=32)
+
+
+def test_mixed_k_parity_ivf():
+    idx, emb = _build(ivf_nprobe=4, serve_k_max=8)
+    idx._IVF_MIN_ROWS = 1
+    assert idx.ivf_maintenance()
+    classic, _ = _build(serve_ragged=False, ivf_nprobe=4)
+    classic._IVF_MIN_ROWS = 1
+    assert classic.ivf_maintenance()
+    reqs = _mixed_reqs(emb)
+    res = idx.search_fused_requests(reqs, **KW)
+    # both paths assemble candidates via ops.ivf.gather_rows at the same
+    # nprobe; the ragged ceiling is 8 so every k clamps to ≤ 8
+    _assert_matches_per_request(res, reqs, classic, k_max=8)
+
+
+def test_mixed_k_boost_parity_exact():
+    """Boost numerics: ONE ragged mixed-k boosting batch leaves the arena
+    columns exactly where the same requests served one-by-one through the
+    non-ragged fused path leave them (positive capped adds commute)."""
+    idx, emb = _build()
+    classic, _ = _build(serve_ragged=False)
+    reqs = _mixed_reqs(emb, boost=True)
+    now = 123.0
+    idx.search_fused_requests(reqs, now=now + idx.epoch, **KW)
+    for r in reqs:
+        classic.search_fused_requests(
+            [RetrievalRequest(query=r.query, tenant=r.tenant, k=r.k,
+                              gate_enabled=r.gate_enabled, boost=True)],
+            now=now + classic.epoch, **KW)
+    np.testing.assert_allclose(np.asarray(idx.state.salience),
+                               np.asarray(classic.state.salience),
+                               atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx.state.access_count),
+                                  np.asarray(classic.state.access_count))
+
+
+def test_per_request_cap_take_and_nprobe():
+    """The other two sidecar columns: a per-request ``cap_take`` bounds the
+    device boost rows (readback counter), a per-request ``nprobe`` narrows
+    the probe width without losing the self-hit."""
+    tel = Telemetry()
+    idx, emb = _build(telemetry=tel)
+    idx.search_fused_requests(
+        [RetrievalRequest(query=emb[0], tenant="ta", k=10, boost=True,
+                          cap_take=2)], **KW)
+    assert tel.counter_total("device.boost_rows") == 2
+    ivf, embi = _build(ivf_nprobe=4, serve_k_max=8)
+    ivf._IVF_MIN_ROWS = 1
+    assert ivf.ivf_maintenance()
+    res = ivf.search_fused_requests(
+        [RetrievalRequest(query=embi[5], tenant="ta", k=5, nprobe=1),
+         RetrievalRequest(query=embi[5], tenant="ta", k=5)], **KW)
+    assert res[0].ids[0] == res[1].ids[0] == "a5"  # own cluster is rank 1
+    assert len(res[0].ids) == len(res[1].ids) == 5
+
+
+def test_shortfall_counts_against_requested_k():
+    """A request whose k exceeds the ceiling (or the live row count) reads
+    back a per-query live LENGTH below its k — the PR 6 shortfall tail
+    generalized to ragged decode."""
+    tel = Telemetry()
+    idx, emb = _build(telemetry=tel, serve_k_max=16)
+    res = idx.search_fused_requests(
+        [RetrievalRequest(query=emb[0], tenant="ta", k=100)], **KW)
+    assert len(res[0].ids) == 16               # ceiling-truncated
+    assert tel.counter_total("device.topk_shortfall") == 100 - 16
+
+
+# -------------------------------------------------- one kernel, one dispatch
+def test_one_compiled_kernel_serves_mixed_k(monkeypatch):
+    """The acceptance jit-counter: ONE compiled ragged kernel serves
+    k ∈ {4, 16, 100} — the mixed batch costs one dispatch, and successive
+    batches with different k mixes (same geometry) add ZERO new jit cache
+    entries to the ragged read twin."""
+    idx, emb = _build()
+    calls = {"n": 0}
+    orig = S.search_fused_ragged_read
+
+    def wrapped(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(S, "search_fused_ragged_read", wrapped)
+    reqs = [RetrievalRequest(query=emb[i], tenant="ta", k=k)
+            for i, k in enumerate((4, 16, 100, 4))]
+    idx.search_fused_requests(reqs, **KW)
+    assert calls["n"] == 1                     # ONE dispatch, mixed k
+    size_after_first = orig._cache_size()
+    for ks in ((4, 4, 4, 4), (100, 100, 100, 100), (16, 1, 100, 7)):
+        idx.search_fused_requests(
+            [RetrievalRequest(query=emb[i], tenant="ta", k=k)
+             for i, k in enumerate(ks)], **KW)
+    assert orig._cache_size() == size_after_first   # no per-k recompiles
+    assert calls["n"] == 4
+    # the index-side kernel-key ledger agrees: one key for the mode
+    assert len(idx._serve_kernel_keys) == 1
+
+
+def test_sharded_ragged_one_kernel_mixed_k():
+    """Pod path: one ragged distributed program (per-mode cache key)
+    serves a mixed-k mega-batch in ONE distributed dispatch, with parity
+    against the non-ragged pod kernels per request."""
+    from lazzaro_tpu.parallel.index import ShardedMemoryIndex
+    from lazzaro_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 host devices")
+    mesh = make_mesh(("data",), (2,), devices=jax.devices()[:2])
+    rng = np.random.default_rng(3)
+    emb = rng.standard_normal((60, D)).astype(np.float32)
+
+    def fill(idx):
+        idx.add([f"a{i}" for i in range(40)], emb[:40], "ta",
+                supers=[i % 13 == 0 for i in range(40)])
+        idx.add([f"b{i}" for i in range(20)], emb[40:], "tb")
+        idx.add_edges([(f"a{i}", f"a{i + 1}", 0.8) for i in range(10)])
+        return idx
+
+    idx = fill(ShardedMemoryIndex(mesh, dim=D, capacity=255, k=8,
+                                  serve_k_max=32))
+    classic = fill(ShardedMemoryIndex(mesh, dim=D, capacity=255, k=8,
+                                      serve_ragged=False))
+    reqs = [RetrievalRequest(query=emb[1], tenant="ta", k=4,
+                             gate_enabled=True),
+            RetrievalRequest(query=emb[41], tenant="tb", k=100),
+            RetrievalRequest(query=emb[3], tenant="ta", k=16)]
+    before = idx.dispatch_count
+    res = idx.serve_requests(reqs)
+    assert idx.dispatch_count == before + 1    # ONE distributed dispatch
+    assert len(idx._fused_cache) == 1          # per-MODE kernel key
+    for req, got in zip(reqs, res):
+        solo = classic.serve_requests(
+            [RetrievalRequest(query=req.query, tenant=req.tenant, k=req.k,
+                              gate_enabled=req.gate_enabled)])[0]
+        kc = min(int(req.k), 32)
+        assert got.ids == solo.ids[:kc]
+        np.testing.assert_allclose(got.scores, solo.scores[:kc], rtol=1e-5)
+    # tenant isolation survives the ragged merge
+    assert all(i.startswith("b") for i in res[1].ids)
+    # a second mixed-k batch re-uses the same compiled program
+    idx.serve_requests([RetrievalRequest(query=emb[9], tenant="ta", k=30)])
+    assert len(idx._fused_cache) == 1
+
+
+def test_ragged_pallas_topk_matches_per_k():
+    """The ragged-K blocked scan: ceiling compute + per-query boundary mask
+    equals per-k ``lax.top_k`` results for every k in the batch."""
+    from lazzaro_tpu.ops.pallas_topk import pallas_masked_topk_ragged
+
+    rng = np.random.default_rng(0)
+    emb = jnp.asarray(rng.standard_normal((512, D)).astype(np.float32))
+    madd = jnp.where(jnp.arange(512) % 7 == 0, -1e30, 0.0
+                     ).astype(jnp.float32)
+    q = jnp.asarray(rng.standard_normal((4, D)).astype(np.float32))
+    k_q = jnp.asarray([2, 8, 1, 5], jnp.int32)
+    s, i = pallas_masked_topk_ragged(emb, madd, q, k_q, k=8,
+                                     block_rows=128, interpret=True)
+    scores = q @ emb.T + madd[None, :]
+    for qi, kk in enumerate([2, 8, 1, 5]):
+        ts, ti = jax.lax.top_k(scores[qi], kk)
+        np.testing.assert_allclose(np.asarray(s)[qi, :kk], np.asarray(ts),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i)[qi, :kk],
+                                      np.asarray(ti))
+        assert (np.asarray(i)[qi, kk:] == -1).all()
+
+
+# --------------------------------------------------- continuous batching
+def test_lone_request_dispatches_immediately():
+    """Regression (ISSUE 7 satellite): a single request on an idle
+    continuous scheduler must NOT wait the flush timeout — latency is the
+    dispatch time, not ``serve_flush_us``."""
+    def echo(reqs):
+        return [RetrievalResult(ids=["x"], scores=[1.0]) for _ in reqs]
+
+    flush_s = 0.5
+    s = QueryScheduler(echo, max_batch=64, max_wait_us=int(flush_s * 1e6),
+                       continuous=True)
+    try:
+        t0 = time.perf_counter()
+        fut = s.submit(RetrievalRequest(query=np.zeros(1, np.float32),
+                                        tenant="u"))
+        fut.result(timeout=10)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < flush_s / 2, (
+            f"lone request waited {elapsed:.3f}s — flush-boundary latency "
+            f"leaked into the continuous scheduler")
+    finally:
+        s.close()
+
+
+def test_flush_boundary_mode_still_waits():
+    """The A/B control: with continuous OFF, a lone request is held until
+    the flush window closes (the PR 2–6 policy, kept for fallback)."""
+    def echo(reqs):
+        return [RetrievalResult(ids=["x"], scores=[1.0]) for _ in reqs]
+
+    flush_s = 0.3
+    s = QueryScheduler(echo, max_batch=64, max_wait_us=int(flush_s * 1e6),
+                       continuous=False)
+    try:
+        t0 = time.perf_counter()
+        fut = s.submit(RetrievalRequest(query=np.zeros(1, np.float32),
+                                        tenant="u"))
+        fut.result(timeout=10)
+        assert time.perf_counter() - t0 >= flush_s * 0.8
+    finally:
+        s.close()
+
+
+def test_continuous_admits_arrivals_into_next_dispatch():
+    """Requests arriving while a dispatch is in flight admit into the NEXT
+    dispatch as one dense batch (the in-flight dispatch is the batching
+    window — no timer involved)."""
+    release = threading.Event()
+    batches = []
+
+    def blocking(reqs):
+        batches.append(len(reqs))
+        if len(batches) == 1:
+            release.wait(timeout=10)
+        return [RetrievalResult(ids=["x"], scores=[1.0]) for _ in reqs]
+
+    s = QueryScheduler(blocking, max_batch=64, max_wait_us=10_000_000,
+                       continuous=True)
+    try:
+        first = s.submit(RetrievalRequest(query=np.zeros(1, np.float32),
+                                          tenant="u"))
+        time.sleep(0.05)
+        rest = s.submit_many([
+            RetrievalRequest(query=np.zeros(1, np.float32), tenant="u")
+            for _ in range(9)])
+        release.set()
+        first.result(timeout=10)
+        for f in rest:
+            f.result(timeout=10)
+        assert batches == [1, 9]
+    finally:
+        s.close()
+
+
+def test_tenant_admission_cap_with_oldest_first_fairness():
+    """Per-tenant admission control: a flooding tenant is capped per
+    dispatch; deferred requests keep their queue position and ship in the
+    following dispatches (every future still completes)."""
+    release = threading.Event()
+    batches = []
+
+    def executor(reqs):
+        batches.append([r.tenant for r in reqs])
+        if len(batches) == 1:
+            release.wait(timeout=10)
+        return [RetrievalResult(ids=[r.tenant], scores=[1.0])
+                for r in reqs]
+
+    s = QueryScheduler(executor, max_batch=8, max_wait_us=500,
+                       continuous=True, tenant_max_inflight=2)
+    try:
+        first = s.submit(RetrievalRequest(query=np.zeros(1, np.float32),
+                                          tenant="warm"))
+        time.sleep(0.05)
+        flood = s.submit_many([
+            RetrievalRequest(query=np.zeros(1, np.float32), tenant="hog")
+            for _ in range(6)])
+        trickle = s.submit_many([
+            RetrievalRequest(query=np.zeros(1, np.float32), tenant="small")
+            for _ in range(2)])
+        release.set()
+        for f in [first] + flood + trickle:
+            f.result(timeout=10)
+        # no post-warmup batch carries more than 2 of the flooding tenant,
+        # and the small tenant rode the FIRST post-warmup dispatch (it was
+        # not starved behind the hog's queue depth)
+        for b in batches[1:]:
+            assert b.count("hog") <= 2
+        assert "small" in batches[1]
+        assert s.requests_deferred > 0
+        assert sum(len(b) for b in batches) == 9
+    finally:
+        s.close()
+
+
+# ------------------------------------------------------- LRU + warmup
+def test_lru_kernel_cache_bounds_entries():
+    c = LRUKernelCache(2)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1                     # refresh a
+    c.put("c", 3)                              # evicts b (LRU)
+    assert len(c) == 2 and c.evictions == 1
+    assert c.get("b") is None
+    assert c.get("a") == 1 and c.get("c") == 3
+
+
+def test_pod_kernel_cache_is_lru_capped():
+    """Non-ragged mixed-k traffic used to grow the pod kernel cache one
+    entry per k-bucket with no bound; the cap evicts the stale buckets."""
+    from lazzaro_tpu.parallel.index import ShardedMemoryIndex
+    from lazzaro_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 host devices")
+    mesh = make_mesh(("data",), (2,), devices=jax.devices()[:2])
+    rng = np.random.default_rng(5)
+    idx = ShardedMemoryIndex(mesh, dim=D, capacity=255, k=4,
+                             serve_ragged=False, serve_kernel_cache_max=2)
+    emb = rng.standard_normal((30, D)).astype(np.float32)
+    idx.add([f"n{i}" for i in range(30)], emb, "u")
+    for k in (4, 16, 32, 64):                  # four distinct k-buckets
+        idx.serve_requests([RetrievalRequest(query=emb[0], tenant="u",
+                                             k=k)])
+    assert len(idx._fused_cache) <= 2
+    assert idx._fused_cache.evictions >= 2
+
+
+def test_warmup_precompiles_serving_kernels():
+    """``warmup_serving`` drives the real dispatch path on a tenant that
+    owns no rows: the arena numerics are untouched, ``kernel.warmup_ms``
+    is recorded, and the first live request at a warmed geometry adds
+    ZERO new jit cache entries to the ragged twins."""
+    tel = Telemetry()
+    idx, emb = _build(telemetry=tel)
+    sal_before = np.asarray(idx.state.salience).copy()
+    out = idx.warmup_serving((3,), **KW)
+    assert out and all(v > 0 for v in out.values())
+    np.testing.assert_array_equal(np.asarray(idx.state.salience),
+                                  sal_before)
+    assert tel.timer_count("kernel.warmup_ms") == 1
+    # warmup must not skew the serving counters
+    assert tel.counter_total("serve.live_requests") == 0
+    read_size = S.search_fused_ragged_read._cache_size()
+    serve_size = S.search_fused_ragged._cache_size()
+    idx.search_fused_requests(
+        [RetrievalRequest(query=emb[i], tenant="ta", k=5 + i,
+                          boost=(i == 0)) for i in range(3)], **KW)
+    idx.search_fused_requests(
+        [RetrievalRequest(query=emb[i], tenant="ta", k=9)
+         for i in range(3)], **KW)
+    assert S.search_fused_ragged_read._cache_size() == read_size
+    assert S.search_fused_ragged._cache_size() == serve_size
+
+
+def test_bucket_size_schedule():
+    """Linear buckets above the granularity, pow2 below: a lone request
+    stays a 1-slot dispatch, a 33-request batch pays 40 slots (pow2 paid
+    64 — the padding tax), and specializations stay bounded."""
+    assert bucket_size(1, 8) == 1
+    assert bucket_size(2, 8) == 2
+    assert bucket_size(3, 8) == 4
+    assert bucket_size(8, 8) == 8
+    assert bucket_size(9, 8) == 16
+    assert bucket_size(33, 8) == 40            # pow2 would pay 64
+    assert bucket_size(63, 8) == 64

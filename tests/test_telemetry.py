@@ -311,7 +311,7 @@ def test_telemetry_adds_zero_dispatches(monkeypatch):
         ms.start_conversation()
         calls = _count_dispatches(monkeypatch)
         ms.chat("fact 7 body")
-        assert calls["search_fused"] == 1
+        assert calls["search_fused_ragged"] == 1
         assert sum(calls.values()) == 1
         # the turn actually landed in the registry (spans + device tail)
         assert ms.telemetry.counter_total("serve.dispatches") == 1
