@@ -10,7 +10,7 @@ hardcoded to 1536 in the store schema (reference ``vector_store.py:37`` quirk).
 from __future__ import annotations
 
 from dataclasses import dataclass, field, fields
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 
 @dataclass
@@ -161,6 +161,39 @@ class MemoryConfig:
     # conversation end / save; the flush also triggers early past this
     # many distinct nodes.
     serve_boost_flush_max: int = 4096
+
+    # --- tiered memory (ISSUE 8) -------------------------------------------
+    # Hot-row budget: > 0 attaches the tiered-memory manager + pump
+    # (tier.TierManager / tier.TierPump). The int8 shadow stays HBM-
+    # resident for EVERY row so the fused coarse scan still covers the
+    # whole corpus in one dispatch; rows past the budget demote their
+    # full-precision embedding to a host ColdStore (optionally memory-
+    # mapped under tier_cold_dir), chosen coldest-first by the salience/
+    # recency signal the decay sweeps already maintain. Hot-only chat
+    # turns stay ONE dispatch; a turn whose candidates touch cold rows
+    # pays one bounded second dispatch (exact rescore of the host-
+    # gathered rows + the deferred boosts) — never a full-arena fault-in.
+    # 0 (default) = single-tier, everything HBM-resident.
+    tier_hot_budget_rows: int = 0
+    # Demotion fires when hot rows exceed high_watermark · budget and
+    # drains down to low_watermark · budget; the gap is the anti-thrash
+    # hysteresis band.
+    tier_high_watermark: float = 0.9
+    tier_low_watermark: float = 0.75
+    # Rows per pump chunk (double-buffered device↔host transfers).
+    tier_chunk_rows: int = 4096
+    # Never demote a row accessed within this many seconds (0 = off).
+    tier_min_idle_s: float = 0.0
+    # A cold row promotes back to HBM after this many serving hits.
+    tier_promote_hits: int = 1
+    # A freshly promoted row is demotion-immune for this many seconds.
+    tier_hysteresis_s: float = 30.0
+    # Background pump cadence; 0 disables the thread (call
+    # index.tiering.run_once() manually — tests and bench do).
+    tier_pump_interval_s: float = 1.0
+    # Directory for memory-mapped cold vector slabs (the SSD tier);
+    # None keeps the cold tier in host RAM.
+    tier_cold_dir: Optional[str] = None
 
     # --- serving telemetry (ISSUE 6) ---------------------------------------
     # Host spans + device counters: every request records enqueue→flush

@@ -238,6 +238,23 @@ def save_index(index: MemoryIndex, ckpt_dir: str,
         # dashboard's overflow rate reset on every restore).
         "counters": {"link_pool_overflows": index.link_pool_overflows},
     }
+    # Tiered memory (ISSUE 8): the residency column and the cold store's
+    # payload (exact vectors in the wire dtype + their shadow codes) ride
+    # the same snapshot, so a reloaded index serves bit-identically to the
+    # pre-save one on a mixed hot/cold corpus — the arena's zeroed cold
+    # embeddings alone would silently lose those rows.
+    if getattr(index, "tiering", None) is not None:
+        tier = index.tiering
+        arrays.update(tier.export_arrays())
+        meta["tier"] = {
+            "hot_budget_rows": tier.hot_budget_rows,
+            "high_watermark": tier.high_watermark,
+            "low_watermark": tier.low_watermark,
+            "chunk_rows": tier.chunk_rows,
+            "min_idle_s": tier.min_idle_s,
+            "promote_hits": tier.promote_hits,
+            "hysteresis_s": tier.hysteresis_s,
+        }
     if extra_meta:
         meta.update(extra_meta)
     _write_versioned(ckpt_dir, arrays, meta)
@@ -327,6 +344,15 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
     index.tenant_nodes = {
         t: set(node_ids[tenant_per_node == tid].tolist())
         for t, tid in index._tenants.items()}
+
+    # Tiered memory (ISSUE 8): reattach the manager and restore residency
+    # + cold-store contents (``tier_cold_dir`` is a runtime choice, so a
+    # restored cold tier starts in host RAM regardless of where it lived).
+    if "tier" in meta and "tier_cold_mask" in data:
+        tier_kw = dict(meta["tier"])
+        budget = int(tier_kw.pop("hot_budget_rows"))
+        tmgr = index.enable_tiering(budget, **tier_kw)
+        tmgr.import_arrays(data)
     return index
 
 

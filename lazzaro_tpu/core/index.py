@@ -247,6 +247,14 @@ class MemoryIndex:
         # neighbor-boost semantics don't read).
         self._csr_cache = None             # (rows, indptr_dev, nbr_dev)
         self._csr_dirty = True
+        # Tiered memory (ISSUE 8): None until ``enable_tiering`` attaches a
+        # ``tier.TierManager`` (residency column + host cold stores + the
+        # watermark pump policy). ``_emb_gen`` is the embedding-write
+        # generation counter the pump's gather→scatter window checks so a
+        # racing add/ingest can never be clobbered by a stale demotion.
+        self.tiering = None
+        self._emb_gen = 0
+        self._csr_flat_cache = None        # replicated flat CSR (cold finish)
 
     # Compat views over the atomic pack (tests/bench poke these; assigning
     # ``_ivf = None`` drops the whole build, freeing members + residual).
@@ -489,7 +497,50 @@ class MemoryIndex:
                     if self.ivf_nprobe else None),
             "mesh": (f"{self._n_parts}x {self.shard_axis}"
                      if self.mesh is not None else None),
+            "tier": (self.tiering.stats() if self.tiering is not None
+                     else None),
         }
+
+    # ------------------------------------------------------- tiered memory
+    def enable_tiering(self, hot_budget_rows: int, **kw):
+        """Attach a :class:`tier.TierManager`: a per-row residency column,
+        host cold stores (one per mesh partition), and the watermark/
+        hysteresis demotion policy. Serving switches to the tiered fused
+        program the moment any row is cold: the int8 coarse scan covers
+        the whole corpus from the (always-maintained) shadow, hot-only
+        turns stay ONE dispatch, cold-hit turns pay one bounded finish
+        dispatch. Incompatible with ``pq_serving`` (the PQ member scan
+        rescores from the master, which a cold row no longer has).
+        Returns the manager (also at ``self.tiering``)."""
+        from lazzaro_tpu.tier import TierManager
+
+        if self.pq_serving:
+            raise ValueError("tiering is incompatible with pq_serving")
+        self.tiering = TierManager(self, hot_budget_rows, **kw)
+        return self.tiering
+
+    def _tiered_active(self) -> bool:
+        return self.tiering is not None and self.tiering.cold_count > 0
+
+    def _flat_csr_for(self):
+        """FLAT (single-chip layout) device CSR for the tiered cold-finish
+        kernel. Single-chip this IS ``_csr_for``'s cache; under a mesh the
+        per-shard split the distributed kernel wants is useless to the
+        finish (plain jnp under jit, GSPMD-partitioned), so a replicated
+        flat pair is built and cached against the split cache's identity."""
+        st = self.state
+        if self.mesh is None:
+            return self._csr_for(st)
+        self._csr_for(st)                  # refresh the split cache first
+        key = id(self._csr_cache)
+        cache = self._csr_flat_cache
+        if cache is not None and cache[0] == key:
+            return cache[1], cache[2]
+        indptr, nbr = build_host_csr(list(self.edge_slots.keys()),
+                                     self.id_to_row, st.emb.shape[0])
+        dev = (jnp.asarray(indptr), jnp.asarray(nbr))
+        self._csr_flat_cache = (key, dev[0], dev[1])
+        return dev
 
     # ---------------------------------------------------------------- nodes
     def _alloc_rows(self, n: int) -> List[int]:
@@ -499,6 +550,9 @@ class MemoryIndex:
             self.state = S.grow_arena(self.state, new_cap)
             self._int8_dirty = True        # emb shape changed
             self._pq_dirty = True
+            self._emb_gen += 1
+            if self.tiering is not None:
+                self.tiering.on_grow(new_cap + 1)
             self._free_rows = list(range(new_cap - 1, old_cap - 1, -1)) + self._free_rows
         return [self._free_rows.pop() for _ in range(n)]
 
@@ -554,8 +608,11 @@ class MemoryIndex:
         )
         self._int8_dirty = True            # emb rows written
         self._pq_dirty = True
+        self._emb_gen += 1
         self._note_super(rows, [bool(x) for x in is_super])
         self._ivf_note_added(rows)
+        if self.tiering is not None:       # a re-added cold row is hot again
+            self.tiering.on_rows_written(rows)
         return rows
 
     def _note_super(self, rows: Sequence[int], flags: Sequence[bool]) -> None:
@@ -746,8 +803,11 @@ class MemoryIndex:
             if not shadow_fresh:
                 self._int8_dirty = True
             self._pq_dirty = True
+            self._emb_gen += 1
             self._note_super(rows, [bool(x) for x in is_super])
             self._ivf_note_added(rows)
+            if self.tiering is not None:   # a re-added cold row is hot again
+                self.tiering.on_rows_written(rows)
 
             host = fetch_packed(*link_flat)    # the ONE readback
         self.telemetry.record("ingest.dispatch_ms",
@@ -948,6 +1008,7 @@ class MemoryIndex:
             if not shadow_fresh:
                 self._int8_dirty = True
             self._pq_dirty = True
+            self._emb_gen += 1
             host = fetch_packed(*flat)         # the ONE readback
         self.telemetry.record("ingest.dispatch_ms",
                               (time.perf_counter() - t0) * 1e3,
@@ -1089,6 +1150,8 @@ class MemoryIndex:
         self._apply_edges(S.edges_delete_for_nodes,
                           S.edges_delete_for_nodes_copy, jnp.asarray(padded))
         self._free_rows.extend(rows)
+        if self.tiering is not None:       # freed cold rows leave the store
+            self.tiering.on_rows_deleted(rows)
         if self._super_rows:
             self._note_super(rows, [False] * len(rows))
         routed = self._ivf_routed
@@ -1409,6 +1472,18 @@ class MemoryIndex:
                 return shadow[0], shadow[1]
         from lazzaro_tpu.ops.quant import quantize_rows
         shadow = quantize_rows(st.emb)
+        tm = self.tiering
+        if tm is not None and tm.cold_count:
+            # Cold rows hold ZEROS in the master (their exact bytes live
+            # in the host cold store), so a rebuild from ``emb`` would
+            # wipe their codes out of the coarse scan — patch them back
+            # from the store (codes travel with the demoted row).
+            rows, codes, scales = tm.snapshot_codes()
+            keep = rows < st.emb.shape[0]
+            if keep.any():
+                r = jnp.asarray(rows[keep].astype(np.int32))
+                shadow = (shadow[0].at[r].set(jnp.asarray(codes[keep])),
+                          shadow[1].at[r].set(jnp.asarray(scales[keep])))
         if self.mesh is not None:
             shadow = (jax.device_put(shadow[0], self._mat_sharding),
                       jax.device_put(shadow[1], self._row_sharding))
@@ -1561,20 +1636,42 @@ class MemoryIndex:
             return out
 
         indptr, nbr = self._csr_for(st)
+        # Tiered memory (ISSUE 8): with any row demoted, serving routes
+        # through the tier-aware program — int8 coarse scan over the
+        # full-corpus shadow, exact in-kernel rescore for hot rows, ONE
+        # bounded finish dispatch for queries whose candidates touch cold
+        # rows. Hot-only turns stay ONE dispatch + ONE readback.
+        tm = self.tiering
+        tiered = tm is not None and tm.cold_count > 0
         if self.mesh is not None:
-            mode = "sharded_quant" if self.int8_serving else "sharded_exact"
+            mode = ("sharded_tiered" if tiered
+                    else "sharded_quant" if self.int8_serving
+                    else "sharded_exact")
             t0 = time.perf_counter()
             with trace_annotation(f"lz.serve.{mode}"):
                 packed = self._dispatch_fused_sharded(
                     st, indptr, nbr, qp, padb, valid, tenants, gate_on,
                     boost_on, k_bucket, cap_take, max_nbr, super_gate,
                     acc_boost, nbr_boost, now, ragged=ragged,
-                    k_arr=k_arr, cap_arr=cap_arr)
+                    k_arr=k_arr, cap_arr=cap_arr, tiered=tiered)
                 host = np.asarray(packed)      # the ONE readback
             tel.record("serve.dispatch_ms",
                        (time.perf_counter() - t0) * 1e3,
                        labels={"mode": mode})
             tel.bump("serve.dispatches", labels={"mode": mode})
+            if tiered:
+                from lazzaro_tpu.tier.serve import tiered_decode_and_finish
+                del st                     # the finish may donate the state
+                now_rel = ((now if now is not None else time.time())
+                           - self.epoch)
+                with tel.span("serve.decode_ms"):
+                    return tiered_decode_and_finish(
+                        self, tm, reqs, results, valid, boost_on, q,
+                        tenants, host, k_bucket=k_bucket,
+                        cap_take=min(cap_take, k_bucket), max_nbr=max_nbr,
+                        acc_boost=acc_boost, nbr_boost=nbr_boost,
+                        now_rel=now_rel, ragged=ragged,
+                        cap_arr=(cap_arr if ragged else None), tel=tel)
             with tel.span("serve.decode_ms"):
                 gate_s, gate_r, ann_s, ann_r, fast, counters = \
                     unpack_retrieval(host[:nq], k_bucket)
@@ -1600,20 +1697,25 @@ class MemoryIndex:
         # path no longer steps aside for int8 mode. Only the arena is
         # donated; the shadow is a read-only replica that the boost scatter
         # (salience/access/freshness only) can never invalidate.
-        use_quant = bool(self.int8_serving) and self.mesh is None
+        use_quant = (bool(self.int8_serving) and self.mesh is None
+                     and not tiered)
         # Fused IVF serving (ISSUE 4): with a coarse build published,
         # the single-dispatch program starts from the centroid prefilter +
         # member gather instead of a whole-arena stream — candidate HBM
         # traffic ~(C + nprobe·N/C)·d per query — and ``ivf_nprobe > 0``
         # no longer opts out of fusion. With int8 ALSO on, the candidate
         # scan itself is two-stage (int8 gathered coarse + exact rescore).
-        ivf_tabs = self._ivf_fused_pack(k_bucket)
+        # With cold rows present the tiered program takes precedence: its
+        # full-corpus int8 coarse scan is the only structure that still
+        # covers demoted rows (their master embedding is host-resident).
+        ivf_tabs = None if tiered else self._ivf_fused_pack(k_bucket)
         if ivf_tabs is not None:
             statics["nprobe"] = ivf_tabs[3]
             statics["slack"] = self.coarse_slack
-        elif use_quant:
+        elif use_quant or tiered:
             statics["slack"] = self.coarse_slack
-        mode = ("ivf" if ivf_tabs is not None
+        mode = ("tiered" if tiered
+                else "ivf" if ivf_tabs is not None
                 else "quant" if use_quant else "exact")
         # Ragged sidecar device columns (ISSUE 7): per-query k / cap /
         # nprobe as int32 DATA next to the query batch. Pad rows carry 0
@@ -1633,9 +1735,12 @@ class MemoryIndex:
                 np_arr[~valid] = 0
                 npq_dev = jnp.asarray(padb(np_arr, 0, np.int32))
         self._note_serve_kernel(mode, statics, ragged)
+        tier_pack = ((*self._int8_shadow_for(st), tm.cold_mask_dev())
+                     if tiered else None)
         self._maybe_record_hbm(mode, st, args, statics, super_gate,
                                ivf_tabs, use_quant, ragged=ragged,
-                               k_dev=k_dev, npq_dev=npq_dev)
+                               k_dev=k_dev, npq_dev=npq_dev,
+                               tier_pack=tier_pack)
         t0 = time.perf_counter()
         with trace_annotation(f"lz.serve.{mode}"):
             if boost_on.any():
@@ -1650,7 +1755,24 @@ class MemoryIndex:
                                jnp.float32(nbr_boost))
                     boost_dev = jnp.asarray(padb(boost_on))
                     sole = sys.getrefcount(cur) <= self._SOLE_REFS
-                    if ivf_tabs is not None:
+                    if tiered:
+                        # (arena, shadow, residency) all taken against
+                        # ``cur`` under the lock — the triple never tears
+                        q8, scale = self._int8_shadow_for(cur)
+                        cold_dev = tm.cold_mask_dev()
+                        if ragged:
+                            fn = (S.search_fused_tiered_ragged if sole
+                                  else S.search_fused_tiered_ragged_copy)
+                            boost_args = (boost_dev, k_dev,
+                                          capq_dev) + scalars
+                        else:
+                            fn = (S.search_fused_tiered if sole
+                                  else S.search_fused_tiered_copy)
+                            boost_args = (boost_dev,) + scalars
+                        new_state, packed = fn(cur, q8, scale, cold_dev,
+                                               *args, *boost_args,
+                                               **statics)
+                    elif ivf_tabs is not None:
                         cent, members, extras, _ = ivf_tabs
                         # shadow (when int8 is on too) taken against ``cur``
                         # under the lock — the (arena, codes) pair never
@@ -1700,6 +1822,17 @@ class MemoryIndex:
                                                **statics)
                     del cur
                     self.state = new_state
+            elif tiered:
+                q8, scale = self._int8_shadow_for(st)
+                cold_dev = tm.cold_mask_dev()
+                if ragged:
+                    packed = S.search_fused_tiered_ragged_read(
+                        st, q8, scale, cold_dev, *args, k_dev,
+                        jnp.float32(super_gate), **statics)
+                else:
+                    packed = S.search_fused_tiered_read(
+                        st, q8, scale, cold_dev, *args,
+                        jnp.float32(super_gate), **statics)
             elif ivf_tabs is not None:
                 cent, members, extras, _ = ivf_tabs
                 shadow = self._int8_shadow_for(st) if use_quant else None
@@ -1734,6 +1867,27 @@ class MemoryIndex:
         tel.record("serve.dispatch_ms", (time.perf_counter() - t0) * 1e3,
                    labels={"mode": mode})
         tel.bump("serve.dispatches", labels={"mode": mode})
+        if tiered:
+            from lazzaro_tpu.tier.serve import tiered_decode_and_finish
+            try:
+                del st                     # the finish may donate the state
+            except NameError:
+                pass                       # boost path already dropped it
+            now_rel = (now if now is not None else time.time()) - self.epoch
+            with tel.span("serve.decode_ms"):
+                out = tiered_decode_and_finish(
+                    self, tm, reqs, results, valid, boost_on, q, tenants,
+                    host, k_bucket=k_bucket, cap_take=statics["cap_take"],
+                    max_nbr=max_nbr, acc_boost=acc_boost,
+                    nbr_boost=nbr_boost, now_rel=now_rel, ragged=ragged,
+                    cap_arr=(cap_arr if ragged else None), tel=tel)
+            k_unpack = (host.shape[1] - 7) // 2
+            _, _, _, _, fast_np, counters = unpack_retrieval(host[:nq],
+                                                             k_unpack)
+            record_device_counters(
+                tel, counters, fast_np, gate_on[:nq], valid[:nq],
+                np.asarray([min(int(r.k), cap) for r in reqs]))
+            return out
         with tel.span("serve.decode_ms"):
             gate_s, gate_r, ann_s, ann_r, fast, counters = unpack_retrieval(
                 host[:nq], k_bucket)
@@ -1832,7 +1986,8 @@ class MemoryIndex:
 
     def _maybe_record_hbm(self, mode: str, st, args, statics, super_gate,
                           ivf_tabs, use_quant, ragged: bool = False,
-                          k_dev=None, npq_dev=None) -> None:
+                          k_dev=None, npq_dev=None,
+                          tier_pack=None) -> None:
         """Record the ``memory_analysis()`` peak-HBM gauge for one fused
         serving geometry, once per (mode × k-bucket × cap/nbr) key —
         "Memory Safe Computations with XLA": compiled-program introspection
@@ -1847,7 +2002,17 @@ class MemoryIndex:
             return
         self._hbm_recorded.add(key)
         try:
-            if ivf_tabs is not None:
+            if tier_pack is not None:
+                q8, scale, cold_dev = tier_pack
+                if ragged:
+                    lowered = S.search_fused_tiered_ragged_read.lower(
+                        st, q8, scale, cold_dev, *args, k_dev,
+                        jnp.float32(super_gate), **statics)
+                else:
+                    lowered = S.search_fused_tiered_read.lower(
+                        st, q8, scale, cold_dev, *args,
+                        jnp.float32(super_gate), **statics)
+            elif ivf_tabs is not None:
                 cent, members, extras, _ = ivf_tabs
                 shadow = self._int8_shadow_for(st) if use_quant else None
                 if ragged:
@@ -1933,7 +2098,7 @@ class MemoryIndex:
                                 tenants, gate_on, boost_on, k_bucket,
                                 cap_take, max_nbr, super_gate, acc_boost,
                                 nbr_boost, now, ragged=False, k_arr=None,
-                                cap_arr=None):
+                                cap_arr=None, tiered=False):
         """The pod serving dispatch (ISSUE 5): the full chat-turn program
         as ONE distributed shard_map dispatch against the row-sharded
         arena. Exact by default; with ``int8_serving`` the shard-local
@@ -1947,7 +2112,15 @@ class MemoryIndex:
         sidecars into the ragged distributed program — ``k_bucket`` is
         then the static ceiling and the kernel cache key is per-mode."""
         use_quant = bool(self.int8_serving)
-        mode = "quant" if use_quant else "exact"
+        mode = "tiered" if tiered else ("quant" if use_quant else "exact")
+
+        def _tables(st_):
+            if tiered:
+                # (shadow, residency) both row-sharded like the master
+                return (*self._int8_shadow_for(st_),
+                        self.tiering.cold_mask_dev())
+            return self._int8_shadow_for(st_) if use_quant else ()
+
         kern = self._fused_sharded_kernels(mode, k_bucket, cap_take,
                                            max_nbr, ragged=ragged)
         sargs = (indptr, nbr, jnp.asarray(qp), jnp.asarray(padb(valid)),
@@ -1969,7 +2142,7 @@ class MemoryIndex:
             if hkey not in self._hbm_recorded:
                 self._hbm_recorded.add(hkey)
                 try:
-                    tables = self._int8_shadow_for(st) if use_quant else ()
+                    tables = _tables(st)
                     peak = peak_bytes(kern.read.lower(
                         st, tables, *sargs, *read_extra
                     ).compile().memory_analysis())
@@ -1987,7 +2160,7 @@ class MemoryIndex:
             now_rel = (now if now is not None else time.time()) - self.epoch
             with self._state_lock:
                 cur = self._state
-                tables = self._int8_shadow_for(cur) if use_quant else ()
+                tables = _tables(cur)
                 fn = (kern.serve
                       if sys.getrefcount(cur) <= self._SOLE_REFS
                       else kern.serve_copy)
@@ -2003,7 +2176,7 @@ class MemoryIndex:
                 del cur
                 self.state = new_state
             return packed
-        tables = self._int8_shadow_for(st) if use_quant else ()
+        tables = _tables(st)
         return kern.read(st, tables, *sargs, *read_extra)
 
     def apply_boosts(self, entries: Dict[str, Tuple[int, int, float]],
@@ -2230,6 +2403,8 @@ class MemoryIndex:
         r = self.id_to_row.get(node_id)
         if r is None:
             return None
+        if self.tiering is not None and self.tiering.cold_np[r]:
+            return np.asarray(self.tiering.gather_cold([r])[0], np.float32)
         return np.asarray(self.state.emb[r], np.float32)
 
     def pull_numeric(self) -> Dict[str, np.ndarray]:

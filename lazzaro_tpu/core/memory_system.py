@@ -166,6 +166,25 @@ class MemorySystem:
                                  serve_pad_granularity=cfg.serve_pad_granularity,
                                  serve_kernel_cache_max=cfg.serve_kernel_cache_max)
 
+        # Tiered memory (ISSUE 8): a hot-row budget attaches the residency
+        # manager and (with async on) the background demotion/promotion
+        # pump, so tier traffic overlaps serving dispatches.
+        self.tier_pump = None
+        if cfg.tier_hot_budget_rows > 0:
+            tmgr = self.index.enable_tiering(
+                cfg.tier_hot_budget_rows,
+                high_watermark=cfg.tier_high_watermark,
+                low_watermark=cfg.tier_low_watermark,
+                chunk_rows=cfg.tier_chunk_rows,
+                min_idle_s=cfg.tier_min_idle_s,
+                promote_hits=cfg.tier_promote_hits,
+                hysteresis_s=cfg.tier_hysteresis_s,
+                cold_dir=cfg.tier_cold_dir)
+            if cfg.tier_pump_interval_s > 0 and self.enable_async:
+                from lazzaro_tpu.tier import TierPump
+                self.tier_pump = TierPump(
+                    tmgr, cfg.tier_pump_interval_s).start()
+
         self.query_cache = QueryCache(cfg.cache_size) if self.enable_caching else None
 
         self.short_term_memory: List[Dict] = []
@@ -319,6 +338,18 @@ class MemorySystem:
         if self.verbose:
             _ensure_log_handler()
             _logger.info(msg)
+
+    def _status(self, results: List[str], msg: str) -> str:
+        """Consolidation/lifecycle status strings (``"✓ Applied temporal
+        decay"`` and friends) route through ``logging`` AS they are
+        produced — not only through the joined return string — so library
+        users see them under standard logging config and
+        ``scripts/lint_no_print.py`` keeps ``core/`` print-free with no
+        exemptions (ISSUE 8 satellite). Appends to ``results`` and
+        returns the message for call sites that also return it."""
+        self._log(msg)
+        results.append(msg)
+        return msg
 
     def _q(self, node_id: str) -> str:
         """Tenant-qualified index key (node ids like 'node_1' repeat per user)."""
@@ -544,12 +575,12 @@ class MemorySystem:
         if self.enable_async and self.background_executor:
             self._log(f"🔄 Queueing consolidation for {n_turns} exchanges...")
             self.background_executor.submit(self._async_consolidate)
-            results.append("✓ Conversation ended (consolidation queued)")
+            self._status(results, "✓ Conversation ended (consolidation queued)")
         else:
             self._log(f"🔄 Consolidating {n_turns} exchanges...")
             self._async_consolidate()
             nodes, edges = self.buffer.size()
-            results.append(f"✓ Consolidation complete. Memory: {nodes} nodes, {edges} edges")
+            self._status(results, f"✓ Consolidation complete. Memory: {nodes} nodes, {edges} edges")
 
         with self._mutex:
             # Deferred cache-hit boosts land BEFORE the decay sweep, so the
@@ -561,14 +592,14 @@ class MemorySystem:
             if self.auto_prune:
                 pruned = self._prune_weak_edges(self.prune_threshold)
                 if pruned > 0:
-                    results.append(f"✓ Auto-pruned {pruned} weak edges")
+                    self._status(results, f"✓ Auto-pruned {pruned} weak edges")
             # Small graphs keep every host copy exactly fresh (parity
             # surfaces read node.salience directly); at scale the dirty rows
             # are synced inside the save itself and clean rows are
             # reconstructed on load by the closed-form decay replay.
             if len(self.index) <= self._SYNC_FULL_MAX:
                 self._sync_from_arena()
-        results.append("✓ Applied temporal decay")
+        self._status(results, "✓ Applied temporal decay")
 
         self._enforce_buffer_limit()
         self.conversation_count += 1
@@ -1637,7 +1668,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         if merge_similar:
             merged = self._merge_similar_nodes(self.config.merge_similarity)
             if merged > 0:
-                results.append(f"✓ Merged {merged} similar nodes")
+                self._status(results, f"✓ Merged {merged} similar nodes")
 
         components = self.buffer.get_connected_components()
         # ONE pass over all edges, bucketing intra-component weights by
@@ -1669,10 +1700,10 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
 
         pruned = self._prune_weak_edges(self.prune_threshold)
         if pruned > 0:
-            results.append(f"✓ Pruned {pruned} weak edges")
+            self._status(results, f"✓ Pruned {pruned} weak edges")
 
         if profile_updates > 0:
-            results.append(f"✓ Updated {profile_updates} profile domains")
+            self._status(results, f"✓ Updated {profile_updates} profile domains")
         else:
             all_contents = [n.content for n in self.buffer.nodes.values()
                             if not n.is_super_node]
@@ -1682,7 +1713,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                     results.append(update)
 
         if not results:
-            results.append("✓ No consolidation actions needed")
+            self._status(results, "✓ No consolidation actions needed")
         elif persist:
             # Standalone callers (CLI /consolidate, dashboard POST) get the
             # merged rows and profile updates made durable immediately; the
@@ -1904,9 +1935,18 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                 valid.append((i, r))
         if not valid:
             return
-        gathered = np.asarray(
-            self.index.state.emb[np.asarray([r for _, r in valid])],
-            np.float32)
+        rows_arr = np.asarray([r for _, r in valid])
+        gathered = np.asarray(self.index.state.emb[rows_arr], np.float32)
+        # Tiered memory (ISSUE 8): a demoted row's master embedding is
+        # ZEROED — persisting that would corrupt the durable row store.
+        # Its exact bytes live in the host cold store.
+        tm = self.index.tiering
+        if tm is not None and tm.cold_count:
+            cold_mask = tm.is_cold_rows(rows_arr)
+            if cold_mask.any():
+                gathered[cold_mask] = np.asarray(
+                    tm.gather_cold(rows_arr[cold_mask].tolist()),
+                    np.float32)
         for (i, _), e in zip(valid, gathered):
             dicts[i]["embedding"] = [float(x) for x in e]
 
@@ -2364,6 +2404,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
                                         ivf_nprobe=self.config.ivf_serving,
                                         pq_serving=self.config.pq_serving,
                                         coarse_slack=self.config.coarse_fetch_slack,
+                                        telemetry=self.telemetry,
                                         serve_ragged=self.config.serve_ragged,
                                         serve_k_max=self.config.serve_k_max,
                                         serve_pad_granularity=self.config.serve_pad_granularity,
@@ -2392,8 +2433,20 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             return f"⚠ Corrupt snapshot at {snapshot_dir}: {e}"
 
         self._drain_background()   # outside the mutex: the worker needs it
+        # The tier pump (if any) drives the OLD index's manager — stop it
+        # before the swap and restart it against the restored one.
+        if self.tier_pump is not None:
+            self.tier_pump.stop()
+            self.tier_pump = None
         with self._mutex:
             self.index = new_index
+            if (new_index.tiering is not None
+                    and self.config.tier_pump_interval_s > 0
+                    and self.enable_async):
+                from lazzaro_tpu.tier import TierPump
+                self.tier_pump = TierPump(
+                    new_index.tiering,
+                    self.config.tier_pump_interval_s).start()
             self.user_id = host.get("user_id", self.user_id)
             self.shards.clear()
             self.super_nodes.clear()
@@ -2636,6 +2689,11 @@ Be clinical yet insightful. Do not include conversational filler."""
                     if k.startswith("kernel.peak_hbm_bytes")}
         return {
             "telemetry": tel.snapshot(),
+            # Tiered memory (ISSUE 8): the tier gauges also live in the
+            # registry snapshot above; this block is the derived headline
+            # view (None when tiering is off).
+            "tier": (self.index.tiering.stats()
+                     if self.index.tiering is not None else None),
             "pad_waste_fraction": ((1.0 - live / padded) if padded else 0.0),
             "queue_wait_ms_p50": (float(np.percentile(qw, 50)) if qw
                                   else None),
@@ -2704,6 +2762,9 @@ STORAGE:
 
     # ------------------------------------------------------------------- close
     def close(self) -> None:
+        pump = getattr(self, "tier_pump", None)
+        if pump is not None:
+            pump.stop()
         sched = getattr(self, "query_scheduler", None)
         if sched is not None:
             sched.close()

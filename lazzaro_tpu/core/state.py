@@ -1325,6 +1325,359 @@ def search_fused_quant_read(state: ArenaState, q8a: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Tiered memory (ISSUE 8): HBM hot set + host-resident cold tier.
+#
+# Residency is a per-row device column (``cold`` [cap+1] bool, owned by
+# ``tier.TierManager``): a demoted row keeps its metadata columns (alive,
+# tenant, salience — decay sweeps and masks keep working) AND its int8
+# shadow codes, but surrenders its full-precision embedding to the host
+# ``ColdStore`` (the arena row is zeroed by the donated ``tier_demote``
+# scatter; the paged-arena follow-up reclaims the physical bytes). The int8
+# shadow therefore stays the FULL-CORPUS scan structure — per cold row the
+# chip holds d bytes of codes instead of d codes + 2d bytes of bf16 master,
+# the TF-Engram/EdgeRAG shape.
+#
+# Serving: ``search_fused_tiered`` is the quantized fused chat-turn program
+# with a tier-aware rescore — the int8 coarse scan covers the whole corpus,
+# HOT survivors rescore exactly from the master in-kernel, COLD survivors
+# keep their coarse score and raise a per-query cold flag (their exact rows
+# live host-side). Hot-only turns therefore stay ONE dispatch + ONE packed
+# readback with exact scores and in-kernel boosts; a turn whose candidate
+# set touches cold rows defers its boosts (same suppression slot as the
+# gate fast path) and pays ONE bounded second dispatch
+# (``tier_cold_finish``): exact rescore of the host-gathered cold vectors,
+# final re-rank over the SAME k+slack candidate set, and the deferred
+# gate/CSR/boost tail — never a full-arena fault-in.
+# ---------------------------------------------------------------------------
+
+
+def _tier_demote(state: ArenaState, rows: jax.Array) -> ArenaState:
+    """Surrender the full-precision embeddings of ``rows`` (the host cold
+    store holds the exact bytes; metadata columns and the int8 shadow stay).
+    Sentinel-padded rows zero the scratch row, which is never scored."""
+    zeros = jnp.zeros((rows.shape[0], state.emb.shape[1]), state.emb.dtype)
+    return state.replace(emb=state.emb.at[rows].set(zeros))
+
+
+tier_demote, tier_demote_copy = _donated_pair(_tier_demote)
+
+
+def _tier_promote(state: ArenaState, rows: jax.Array,
+                  vecs: jax.Array) -> ArenaState:
+    """Restore promoted rows' exact embeddings (``vecs`` carries the cold
+    store's bytes in the arena dtype — the round trip is bit-exact, so the
+    int8 shadow codes stay valid without a requantize)."""
+    return state.replace(emb=state.emb.at[rows].set(
+        vecs.astype(state.emb.dtype)))
+
+
+tier_promote, tier_promote_copy = _donated_pair(_tier_promote)
+
+
+def _tiered_two_tier(state: ArenaState, q8a: jax.Array, scale_a: jax.Array,
+                     cold: jax.Array, q_c: jax.Array, tenant_c: jax.Array,
+                     k: int, slack: int):
+    """Tier-aware two-stage core: int8 coarse scan over the full-corpus
+    shadow (both retrieval tiers, same masks as ``_quant_two_tier``), then
+    a residency-split rescore — hot survivors exact from the master, cold
+    survivors keep the coarse score (their exact rows are host-resident).
+    Returns the candidates K+SLACK WIDE sorted by the blended score, so a
+    caller whose query touched cold rows can finish (exact cold rescore +
+    final re-rank) over the SAME candidate set without re-running the
+    scan, plus the per-query cold flag. Super rows are pinned hot by the
+    tiering policy, so the gate verdict is always exact."""
+    n = state.emb.shape[0]
+    k_fetch = min(k + slack, n)
+    g_fetch = min(1 + slack, n)
+    qn = normalize(q_c)                                   # [C, d] f32
+    from lazzaro_tpu.ops.quant import quantize_rows
+
+    qq, qs = quantize_rows(qn)
+    dots = jax.lax.dot_general(
+        qq, q8a, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                 # [C, rows] i32
+    coarse = (dots.astype(jnp.float32)
+              * qs[:, None] * scale_a[None, :])
+    alive_t = state.alive[None, :] & (
+        state.tenant_id[None, :] == tenant_c[:, None])
+    sup = state.is_super[None, :]
+    cg_s, cg_r = jax.lax.top_k(
+        jnp.where(alive_t & sup, coarse, NEG_INF), g_fetch)
+    ca_s, ca_r = jax.lax.top_k(
+        jnp.where(alive_t & ~sup, coarse, NEG_INF), k_fetch)
+    # consumer-split hazard, same as _quant_two_tier
+    cg_s, cg_r, ca_s, ca_r = jax.lax.optimization_barrier(
+        (cg_s, cg_r, ca_s, ca_r))
+    qd = qn.astype(state.emb.dtype)
+
+    def rescore(rows_c, coarse_s):
+        g = state.emb[rows_c]                             # [C, kf, d]
+        ex = jnp.einsum("cd,ckd->ck", qd, g,
+                        preferred_element_type=jnp.float32)
+        return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
+
+    ann_ex = rescore(ca_r, ca_s)
+    live = ca_s > NEG_INF / 2
+    is_cold = cold[ca_r] & live
+    # cold candidates carry their COARSE score into the ranking (their
+    # exact row is host-side); hot candidates are already exact
+    blend = jnp.where(is_cold, ca_s, ann_ex)
+    ann_s, sel = jax.lax.top_k(blend, k_fetch)            # full sort
+    ann_r = jnp.take_along_axis(ca_r, sel, axis=1)
+    cold_any = jnp.take_along_axis(is_cold, sel, axis=1).any(axis=-1)
+    gate_ex = rescore(cg_r, cg_s)
+    g_s, g_sel = jax.lax.top_k(gate_ex, 1)
+    g_r = jnp.take_along_axis(cg_r, g_sel, axis=1)
+    return g_s, g_r, ann_s, ann_r, cold_any
+
+
+def _search_fused_tiered_scan(state: ArenaState, q8a: jax.Array,
+                              scale_a: jax.Array, cold: jax.Array,
+                              csr_indptr: jax.Array, csr_nbr: jax.Array,
+                              q: jax.Array, q_valid: jax.Array,
+                              tenant: jax.Array, gate_on: jax.Array,
+                              boost_on: jax.Array, super_gate: jax.Array,
+                              k: int, slack: int, cap_take: int,
+                              max_nbr: int, k_q=None, cap_q=None):
+    """Tiered per-chunk compute phase: the tier-aware two-stage core, then
+    the shared gate/CSR/boost tail with cold-hit queries' boosts DEFERRED
+    (suppressed exactly like the gate fast path — the host applies them in
+    the bounded ``tier_cold_finish`` dispatch after the exact re-rank, so
+    boost rows always follow the FINAL ranking). ``k_q``/``cap_q`` make it
+    ragged; the per-query boundary masks at k_i + slack so the host keeps
+    each query's full candidate window for the finish."""
+    ragged = k_q is not None
+
+    def chunk(q_c, valid_c, tenant_c, gate_c, boost_c, *rag):
+        g_s, g_r, ann_s, ann_r, cold_any = _tiered_two_tier(
+            state, q8a, scale_a, cold, q_c, tenant_c, k, slack)
+        gate_s, gate_r = g_s[:, 0], g_r[:, 0]
+        cap_c = None
+        if ragged:
+            k_c, cap_c = rag
+            kf = jnp.minimum(k_c + slack, ann_s.shape[1])
+            ann_s, ann_r = _ragged_topk_mask(ann_s, ann_r, kf,
+                                             state.capacity)
+        fast, acc_rows, nbr_rows = _gate_and_boost_rows(
+            state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
+            valid_c, tenant_c, gate_c, boost_c & ~cold_any, super_gate,
+            cap_take, max_nbr, cap_c=cap_c)
+        return gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows
+
+    arrays = (q, q_valid, tenant, gate_on, boost_on)
+    if ragged:
+        arrays = arrays + (k_q, cap_q)
+    return chunked_map_multi(chunk, arrays)
+
+
+def _search_fused_tiered(
+    state: ArenaState,
+    q8a: jax.Array,          # [cap+1, d] i8 FULL-corpus shadow codes
+    scale_a: jax.Array,      # [cap+1] f32
+    cold: jax.Array,         # [cap+1] bool residency column (True = cold)
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """``search_fused_quant`` with the residency column threaded through:
+    ONE donated dispatch + ONE packed readback whose candidate block is
+    k+slack wide. Hot-only queries boost in-kernel; cold-hit queries come
+    back unboosted with their candidate window for the finish dispatch."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
+        _search_fused_tiered_scan(state, q8a, scale_a, cold, csr_indptr,
+                                  csr_nbr, q, q_valid, tenant, gate_on,
+                                  boost_on, super_gate, k, slack, cap_take,
+                                  max_nbr)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  acc=n_acc, nbr=n_nbr)
+
+
+search_fused_tiered, search_fused_tiered_copy = _donated_pair(
+    _search_fused_tiered, static_argnames=("k", "slack", "cap_take",
+                                           "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
+                                             "max_nbr"))
+def search_fused_tiered_read(state: ArenaState, q8a: jax.Array,
+                             scale_a: jax.Array, cold: jax.Array,
+                             csr_indptr: jax.Array, csr_nbr: jax.Array,
+                             q: jax.Array, q_valid: jax.Array,
+                             tenant: jax.Array, gate_on: jax.Array,
+                             super_gate: jax.Array, k: int, slack: int,
+                             cap_take: int, max_nbr: int) -> jax.Array:
+    """Read-only tiered twin (pure ``search_memories`` fleets)."""
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_tiered_scan(
+        state, q8a, scale_a, cold, csr_indptr, csr_nbr, q, q_valid, tenant,
+        gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+def _search_fused_tiered_ragged(
+    state: ArenaState,
+    q8a: jax.Array,
+    scale_a: jax.Array,
+    cold: jax.Array,
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    k_q: jax.Array,
+    cap_q: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """Tiered serving with the (k, cap) sidecar: each query's candidate
+    window masks at its own k_i + slack boundary."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
+        _search_fused_tiered_scan(state, q8a, scale_a, cold, csr_indptr,
+                                  csr_nbr, q, q_valid, tenant, gate_on,
+                                  boost_on, super_gate, k, slack, cap_take,
+                                  max_nbr, k_q=k_q, cap_q=cap_q)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  acc=n_acc, nbr=n_nbr)
+
+
+search_fused_tiered_ragged, search_fused_tiered_ragged_copy = _donated_pair(
+    _search_fused_tiered_ragged,
+    static_argnames=("k", "slack", "cap_take", "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
+                                             "max_nbr"))
+def search_fused_tiered_ragged_read(state: ArenaState, q8a: jax.Array,
+                                    scale_a: jax.Array, cold: jax.Array,
+                                    csr_indptr: jax.Array,
+                                    csr_nbr: jax.Array, q: jax.Array,
+                                    q_valid: jax.Array, tenant: jax.Array,
+                                    gate_on: jax.Array, k_q: jax.Array,
+                                    super_gate: jax.Array, k: int,
+                                    slack: int, cap_take: int,
+                                    max_nbr: int) -> jax.Array:
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    cap_q = jnp.zeros(q_valid.shape, jnp.int32)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_tiered_scan(
+        state, q8a, scale_a, cold, csr_indptr, csr_nbr, q, q_valid, tenant,
+        gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr,
+        k_q=k_q, cap_q=cap_q)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+def _cold_rerank(q: jax.Array, cand_rows: jax.Array, cand_s: jax.Array,
+                 cold_m: jax.Array, cold_vecs: jax.Array, k: int,
+                 sentinel: int):
+    """Exact re-rank of a tiered candidate window: cold positions rescore
+    against the host-gathered exact vectors (same einsum shape as the
+    in-kernel hot rescore, so scores are bit-identical to an all-hot
+    serve), hot positions keep their already-exact scores; final top-k.
+    ``cold_vecs`` carries zeros at hot positions — their lanes are
+    discarded by the ``where``."""
+    qd = normalize(q).astype(cold_vecs.dtype)
+    ex = jnp.einsum("cd,ckd->ck", qd, cold_vecs,
+                    preferred_element_type=jnp.float32)
+    live = cand_s > NEG_INF / 2
+    scores = jnp.where(cold_m & live, ex,
+                       jnp.where(live, cand_s, NEG_INF))
+    ann_s, sel = jax.lax.top_k(scores, k)
+    rows_safe = jnp.where(live, cand_rows, sentinel)
+    ann_r = jnp.take_along_axis(rows_safe, sel, axis=1)
+    ann_r = jnp.where(ann_s > NEG_INF / 2, ann_r, sentinel)
+    return jax.lax.optimization_barrier((ann_s, ann_r))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "sentinel"))
+def tier_cold_rescore(q: jax.Array, cand_rows: jax.Array,
+                      cand_s: jax.Array, cold_m: jax.Array,
+                      cold_vecs: jax.Array, gate_s: jax.Array,
+                      gate_r: jax.Array, fast: jax.Array, k: int,
+                      sentinel: int) -> jax.Array:
+    """Read-only cold finish: exact re-rank of the candidate windows, no
+    state mutation (pure ``search_memories`` fleets, and the pod path's
+    result finish). Gate results pass through from the first dispatch —
+    super rows are pinned hot, so they were exact already."""
+    ann_s, ann_r = _cold_rerank(q, cand_rows, cand_s, cold_m, cold_vecs, k,
+                                int(sentinel))
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+
+
+def _tier_cold_finish(
+    state: ArenaState,
+    csr_indptr: jax.Array,   # FLAT global CSR (single-chip layout)
+    csr_nbr: jax.Array,
+    q: jax.Array,            # [C2, d] the cold-hit queries
+    tenant: jax.Array,       # [C2] i32
+    cand_rows: jax.Array,    # [C2, KF] candidate window from dispatch 1
+    cand_s: jax.Array,       # [C2, KF] blended scores (exact where hot)
+    cold_m: jax.Array,       # [C2, KF] bool cold positions
+    cold_vecs: jax.Array,    # [C2, KF, d] host-gathered exact rows
+    gate_s: jax.Array,       # [C2] gate passthrough from dispatch 1
+    gate_r: jax.Array,       # [C2] i32
+    fast: jax.Array,         # [C2] bool device gate verdicts
+    boost_on: jax.Array,     # [C2] bool
+    cap_q: jax.Array,        # [C2] i32 per-query retrieval cap
+    now: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """The bounded second dispatch of a cold-hit turn: exact rescore of
+    the host-gathered cold rows, final re-rank over the SAME k+slack
+    candidate window dispatch 1 scanned, then the deferred gate/CSR/boost
+    tail — ``_csr_neighbor_rows`` + ``_boost_scatter``, the same code the
+    all-hot kernels run, so boost semantics are identical, just applied
+    after the final ranking. O(C2 · (k+slack) · d): never a full-arena
+    scan, never a fault-in."""
+    cap = state.capacity
+    ann_s, ann_r = _cold_rerank(q, cand_rows, cand_s, cold_m, cold_vecs, k,
+                                cap)
+    take = ((ann_s[:, :cap_take] > NEG_INF / 2)
+            & boost_on[:, None] & ~fast[:, None]
+            & (jnp.arange(cap_take)[None, :] < cap_q[:, None]))
+    acc_rows = jnp.where(take, ann_r[:, :cap_take], cap)
+    nbr_rows = _csr_neighbor_rows(state, csr_indptr, csr_nbr, acc_rows,
+                                  tenant, max_nbr)
+    n_acc, n_nbr = _boost_row_counts(cap, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  acc=n_acc, nbr=n_nbr)
+
+
+tier_cold_finish, tier_cold_finish_copy = _donated_pair(
+    _tier_cold_finish, static_argnames=("k", "cap_take", "max_nbr"))
+
+
+# ---------------------------------------------------------------------------
 # Fused IVF serving (ISSUE 4): the same single-dispatch chat-turn program,
 # but the coarse stage is the CENTROID prefilter — the query batch scores
 # C ≈ √N centroids, visits the top-nprobe clusters, gathers ONLY those
@@ -1876,12 +2229,16 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     from lazzaro_tpu.ops.topk import sharded_topk_merge
     from lazzaro_tpu.utils.compat import shard_map
 
-    if mode not in ("exact", "quant", "ivf", "ivf_quant"):
+    if mode not in ("exact", "quant", "ivf", "ivf_quant", "tiered"):
         raise ValueError(f"unknown fused-sharded mode {mode!r}")
     if cap_take > k:
         raise ValueError("cap_take must not exceed k")
     n_shards = mesh.shape[axis]
     chunk = IVF_SERVE_CHUNK if mode.startswith("ivf") else QUERY_CHUNK
+    # Tiered mode (ISSUE 8): the merged candidate block stays k+slack wide
+    # so the host can finish cold-hit queries (exact rescore of host-
+    # gathered rows + final re-rank) over the same window.
+    k_merge = k + slack if mode == "tiered" else k
 
     def _scan_merge(arena, tables, q, tenant, k_q=None, nprobe_q=None):
         """Shard-local two-tier candidates → globalize → ONE all_gather +
@@ -1896,6 +2253,8 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
         k_l = max(1, min(k, local_n))
         if mode == "quant":
             q8_l, scale_l = tables
+        elif mode == "tiered":
+            q8_l, scale_l, cold_l = tables
         elif mode == "ivf":
             cent, mem2, ext2 = tables
             mem_l, ext_l, shadow_l = mem2[0], ext2[0], None
@@ -1905,31 +2264,41 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
 
         def core(q_c, tenant_c, *rag):
             nprobe_c = rag[0] if rag else None
+            zeros = jnp.zeros((q_c.shape[0],), jnp.int32)
+            off = jnp.zeros((q_c.shape[0],), bool)
             if mode == "exact":
                 g_s, g_r, a_s, a_r = _exact_two_tier(arena, q_c, tenant_c,
                                                      1, k_l)
-                return g_s, g_r, a_s, a_r, jnp.zeros(
-                    (q_c.shape[0],), jnp.int32)
+                return g_s, g_r, a_s, a_r, zeros, off
             if mode == "quant":
                 g_s, g_r, a_s, a_r = _quant_two_tier(
                     arena, q8_l, scale_l, q_c, tenant_c, k_l, slack)
-                return g_s, g_r, a_s, a_r, jnp.zeros(
-                    (q_c.shape[0],), jnp.int32)
+                return g_s, g_r, a_s, a_r, zeros, off
+            if mode == "tiered":
+                g_s, g_r, a_s, a_r, cold_c = _tiered_two_tier(
+                    arena, q8_l, scale_l, cold_l, q_c, tenant_c, k_l,
+                    slack)
+                return g_s, g_r, a_s, a_r, zeros, cold_c
             g_s, g_r, a_s, a_r, n_dup = _ivf_two_tier(
                 arena, shadow_l, cent, mem_l, ext_l, q_c, tenant_c, k_l,
                 nprobe, slack, nprobe_c=nprobe_c)
-            return g_s[:, None], g_r[:, None], a_s, a_r, n_dup
+            return g_s[:, None], g_r[:, None], a_s, a_r, n_dup, off
 
         arrays = (q, tenant)
         if nprobe_q is not None and mode.startswith("ivf"):
             arrays = arrays + (nprobe_q,)
-        g_s, g_r, a_s, a_r, dup_l = chunked_map_multi(core, arrays,
-                                                      chunk=chunk)
+        g_s, g_r, a_s, a_r, dup_l, cold_l_q = chunked_map_multi(
+            core, arrays, chunk=chunk)
         n_dup = jax.lax.psum(dup_l, axis)
+        # a query is a cold hit if ANY shard's candidate window touched a
+        # cold row — the psum rides the same dispatch
+        cold_any = jax.lax.psum(cold_l_q.astype(jnp.int32), axis) > 0
         sent = n_shards * local_n - 1          # the global sentinel row
+        k_q_eff = k_q if (k_q is None or mode != "tiered") else k_q + slack
+        km = min(k_merge, n_shards * a_s.shape[1])
         ann_s, ann_r = sharded_topk_merge(
             axis, a_s, _globalize_rows(a_r, a_s, shard, local_n, n_shards),
-            k, k_q=k_q, sentinel=sent)
+            km, k_q=k_q_eff, sentinel=sent)
         g_ms, g_mr = sharded_topk_merge(
             axis, g_s, _globalize_rows(g_r, g_s, shard, local_n, n_shards),
             1)
@@ -1937,7 +2306,7 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
         # the merged top-k feeds both the packed readback and (in the
         # serve twins) the boost gather tail.
         return jax.lax.optimization_barrier(
-            (g_ms[:, 0], g_mr[:, 0], ann_s, ann_r, n_dup))
+            (g_ms[:, 0], g_mr[:, 0], ann_s, ann_r, n_dup, cold_any))
 
     def _boost_tail(arena, indptr_l, nbr_l, ann_s, ann_r, fast, q_valid,
                     tenant, boost_on, now, acc_boost, nbr_boost,
@@ -1995,20 +2364,20 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     def _serve_local(arena, tables, indptr2, nbr2, q, q_valid, tenant,
                      gate_on, boost_on, now, super_gate, acc_boost,
                      nbr_boost):
-        gate_s, gate_r, ann_s, ann_r, n_dup = _scan_merge(arena, tables, q,
-                                                          tenant)
+        gate_s, gate_r, ann_s, ann_r, n_dup, cold_any = _scan_merge(
+            arena, tables, q, tenant)
         fast = gate_on & (gate_s > super_gate)
         arena, n_acc, n_nbr = _boost_tail(
             arena, indptr2[0], nbr2[0], ann_s, ann_r, fast, q_valid,
-            tenant, boost_on, now, acc_boost, nbr_boost)
+            tenant, boost_on & ~cold_any, now, acc_boost, nbr_boost)
         packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
         return arena, packed
 
     def _read_local(arena, tables, indptr2, nbr2, q, q_valid, tenant,
                     gate_on, super_gate):
-        gate_s, gate_r, ann_s, ann_r, n_dup = _scan_merge(arena, tables, q,
-                                                          tenant)
+        gate_s, gate_r, ann_s, ann_r, n_dup, _cold = _scan_merge(
+            arena, tables, q, tenant)
         fast = gate_on & (gate_s > super_gate)
         return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
                                dup=n_dup)
@@ -2017,19 +2386,20 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
                             tenant, gate_on, boost_on, k_q, cap_q,
                             nprobe_q, now, super_gate, acc_boost,
                             nbr_boost):
-        gate_s, gate_r, ann_s, ann_r, n_dup = _scan_merge(
+        gate_s, gate_r, ann_s, ann_r, n_dup, cold_any = _scan_merge(
             arena, tables, q, tenant, k_q=k_q, nprobe_q=nprobe_q)
         fast = gate_on & (gate_s > super_gate)
         arena, n_acc, n_nbr = _boost_tail(
             arena, indptr2[0], nbr2[0], ann_s, ann_r, fast, q_valid,
-            tenant, boost_on, now, acc_boost, nbr_boost, cap_q=cap_q)
+            tenant, boost_on & ~cold_any, now, acc_boost, nbr_boost,
+            cap_q=cap_q)
         packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
         return arena, packed
 
     def _read_local_ragged(arena, tables, indptr2, nbr2, q, q_valid,
                            tenant, gate_on, k_q, nprobe_q, super_gate):
-        gate_s, gate_r, ann_s, ann_r, n_dup = _scan_merge(
+        gate_s, gate_r, ann_s, ann_r, n_dup, _cold = _scan_merge(
             arena, tables, q, tenant, k_q=k_q, nprobe_q=nprobe_q)
         fast = gate_on & (gate_s > super_gate)
         return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
@@ -2043,6 +2413,7 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     tables_specs = {
         "exact": (),
         "quant": (P(axis, None), P(axis)),
+        "tiered": (P(axis, None), P(axis), P(axis)),
         "ivf": (P(None, None), P(axis, None, None), P(axis, None)),
         "ivf_quant": (P(axis, None), P(axis), P(None, None),
                       P(axis, None, None), P(axis, None)),

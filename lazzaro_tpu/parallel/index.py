@@ -157,6 +157,14 @@ class ShardedMemoryIndex:
         self._ivf_fresh: List[int] = []
         self._ivf_tabs_cache = None
 
+        # Tiered memory (ISSUE 8): attach_tiering hangs a TierManager here
+        # (per-shard host cold stores — one per mesh partition — plus the
+        # row-sharded residency column). ``_emb_gen`` guards the pump's
+        # gather→scatter window against racing embedding writes.
+        self.tiering = None
+        self._emb_gen = 0
+        self._csr_flat_cache = None
+
         self._k = k
         self._search = make_sharded_topk(mesh, axis, k=k)
         # Ragged pod serving (ISSUE 7): per-query k/cap/nprobe sidecars,
@@ -253,6 +261,36 @@ class ShardedMemoryIndex:
         self.telemetry.bump("serve.dispatches", labels={"mode": "pod"})
         return fn(*args, **kwargs)
 
+    # ------------------------------------------------------- tiered memory
+    def attach_tiering(self, hot_budget_rows: int, **kw):
+        """Attach a :class:`tier.TierManager` with one host ColdStore per
+        mesh partition (each chip's demoted rows bucket to its own store).
+        Serving switches to the distributed tiered program while any row
+        is cold; cold-hit turns finish with the shared bounded rescore
+        dispatch (plain jnp under jit — GSPMD partitions it against the
+        row-sharded arena)."""
+        from lazzaro_tpu.tier import TierManager
+
+        self.tiering = TierManager(self, hot_budget_rows, **kw)
+        return self.tiering
+
+    def _flat_csr_for(self):
+        """Replicated FLAT CSR over the host edge map for the tiered
+        cold-finish kernel (the per-shard split ``_csr_sharded`` builds is
+        the wrong layout for the GSPMD-partitioned finish)."""
+        import jax.numpy as jnp
+
+        cache = self._csr_flat_cache
+        n = self.capacity + 1
+        if cache is not None and cache[0] == len(self.edges) \
+                and cache[1] == n:
+            return cache[2], cache[3]
+        indptr, nbr = build_host_csr(list(self.edges.keys()),
+                                     self.id_to_row, n)
+        dev = (jnp.asarray(indptr), jnp.asarray(nbr))
+        self._csr_flat_cache = (len(self.edges), n, dev[0], dev[1])
+        return dev
+
     # ------------------------------------------------------------------- api
     def add(self, ids: Sequence[str], embeddings: np.ndarray, tenant: str,
             saliences: Optional[Sequence[float]] = None,
@@ -323,6 +361,9 @@ class ShardedMemoryIndex:
                 if not routed[r] and r not in self._ivf_fresh:
                     self._ivf_fresh.append(r)
             self._ivf_tabs_cache = None
+        self._emb_gen += 1
+        if self.tiering is not None:       # a re-added cold row is hot again
+            self.tiering.on_rows_written(rows)
         return rows
 
     def delete(self, ids: Sequence[str]) -> None:
@@ -348,6 +389,8 @@ class ShardedMemoryIndex:
                     self._ivf_fresh.remove(r)
         if self._ivf is not None:
             self._ivf_tabs_cache = None
+        if self.tiering is not None:       # freed cold rows leave the store
+            self.tiering.on_rows_deleted(rows)
         padded = S.pad_rows(np.asarray(rows, np.int32), self.capacity)
         self._apply_arena(S.arena_delete, S.arena_delete_copy,
                           jnp.asarray(padded))
@@ -597,9 +640,18 @@ class ShardedMemoryIndex:
             return self._serve_classic(reqs, results, valid, qp, tids,
                                        k_bucket)
 
-        ivf_tabs = self._ivf_tables(k_bucket)
+        tm = self.tiering
+        tiered = tm is not None and tm.cold_count > 0
+        ivf_tabs = None if tiered else self._ivf_tables(k_bucket)
         use_quant = self.int8_serving
-        if ivf_tabs is not None:
+        if tiered:
+            # full-corpus int8 coarse scan + tier-aware rescore: the only
+            # structure that still covers demoted rows (ISSUE 8)
+            nprobe = 0
+            mode = "tiered"
+            ivf_tabs = None
+            tables = (*self._int8_shadow_for(), tm.cold_mask_dev())
+        elif ivf_tabs is not None:
             cent, mem_sh, ext_sh, nprobe = ivf_tabs
             mode = "ivf_quant" if use_quant else "ivf"
             tables = ((*self._int8_shadow_for(), cent, mem_sh, ext_sh)
@@ -659,6 +711,16 @@ class ShardedMemoryIndex:
             host = np.asarray(packed)          # the ONE readback
         tel.record("serve.dispatch_ms", (time.perf_counter() - t0) * 1e3,
                    labels={"mode": f"pod_{mode}"})
+        if tiered:
+            from lazzaro_tpu.tier.serve import tiered_decode_and_finish
+            with tel.span("serve.decode_ms"):
+                return tiered_decode_and_finish(
+                    self, tm, reqs, results, valid, boost_on, q, tids,
+                    host, k_bucket=k_bucket, cap_take=cap_s,
+                    max_nbr=self.max_nbr, acc_boost=self.acc_boost,
+                    nbr_boost=self.nbr_boost,
+                    now_rel=time.time() - self.epoch, ragged=ragged,
+                    cap_arr=(cap_arr if ragged else None), tel=tel)
         with tel.span("serve.decode_ms"):
             gate_s, gate_r, ann_s, ann_r, fast, counters = unpack_retrieval(
                 host[:nq], k_bucket)

@@ -89,6 +89,10 @@ class RetrievalResult:
     gate_score: float = float("-inf")
     fast: bool = False          # device gate verdict (gate_enabled & > gate)
     boosted: bool = False       # device applied this query's boosts
+    # Tiered memory (ISSUE 8): how many of this query's final top-k rows
+    # were served from the host cold tier (0 on an all-hot turn — the
+    # turn then cost exactly ONE dispatch).
+    cold_hits: int = 0
 
 
 Executor = Callable[[List[RetrievalRequest]], List[RetrievalResult]]

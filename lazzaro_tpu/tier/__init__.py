@@ -1,0 +1,26 @@
+"""Tiered memory (ISSUE 8): HBM hot set + host-resident cold tier.
+
+The arena today is HBM-resident end to end, so the corpus a chip can
+serve is hard-capped by HBM (~1M×768 bf16 on the bench rig). This package
+is the escape TF-Engram and EdgeRAG both describe: keep a compact int8
+shadow for the FULL corpus in fast memory (the fused coarse scan still
+covers everything in one dispatch), demote cold full-precision rows to
+host RAM (optionally memory-mapped to disk), and promote on access — with
+the salience-decay machinery supplying exactly the hotness signal the
+policy needs.
+
+- :class:`ColdStore` — pinned host numpy (or ``np.memmap``) slab holding
+  demoted rows' exact embeddings + their int8 codes/scales, keyed by
+  arena row; per-shard buckets under a mesh.
+- :class:`TierManager` — residency bookkeeping (the per-row ``cold``
+  device column + host mirror), demote/promote mechanics (donated
+  ``tier_demote`` / ``tier_promote`` scatters through the index's
+  ownership gate), watermark + hysteresis policy, telemetry gauges.
+- :class:`TierPump` — the async demotion/promotion worker: double-
+  buffered chunks that overlap serving dispatches.
+"""
+
+from lazzaro_tpu.tier.cold_store import ColdStore
+from lazzaro_tpu.tier.pump import TierManager, TierPump
+
+__all__ = ["ColdStore", "TierManager", "TierPump"]

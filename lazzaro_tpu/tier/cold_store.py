@@ -1,0 +1,184 @@
+"""Host-resident cold tier: exact rows demoted out of HBM.
+
+A ``ColdStore`` is a growable host slab of full-precision embedding rows
+keyed by arena row index, plus each row's int8 shadow codes and scale.
+Three invariants make the tier transparent to serving:
+
+- **Bit-exact round trips.** Rows are stored in the ARENA dtype (bf16
+  kept as a uint16 bit view — the npy/memmap formats have no bf16
+  descriptor), so demote → promote restores the identical bytes and the
+  int8 shadow codes quantized before demotion stay valid forever.
+- **Codes travel with the row.** The serving shadow is rebuilt lazily
+  from the master arena (``quantize_rows(emb)``), and a demoted row's
+  master is zeroed — the store therefore keeps the row's codes+scale so
+  the rebuild can patch them back (``snapshot_codes``), keeping the
+  coarse scan full-corpus.
+- **Slab storage, not per-row objects.** One [slots, d] array per field,
+  grown by doubling; ``path=`` switches the vector slab to ``np.memmap``
+  (the SSD tier) with the same API. A million cold rows is three arrays
+  and one dict, not a million Python objects.
+
+Thread safety: one internal lock around slot allocation and the
+row→slot map; gathers copy out under it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import ml_dtypes
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:           # pragma: no cover - image always has ml_dtypes
+    ml_dtypes = None
+    _BF16 = None
+
+
+def _wire_dtype(dtype) -> Tuple[np.dtype, bool]:
+    """(storage dtype, is_bf16): bf16 is stored as a uint16 bit view."""
+    if _BF16 is not None and np.dtype(dtype) == _BF16:
+        return np.dtype(np.uint16), True
+    return np.dtype(dtype), False
+
+
+class ColdStore:
+    """Growable host slab of demoted rows (exact vecs + int8 codes)."""
+
+    def __init__(self, dim: int, dtype=np.float32,
+                 path: Optional[str] = None, initial_slots: int = 1024):
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype) if _BF16 is None or \
+            np.dtype(dtype) != _BF16 else _BF16
+        self._wire, self._bf16 = _wire_dtype(dtype)
+        self.path = path
+        self._lock = threading.Lock()
+        self._slots = max(16, int(initial_slots))
+        self._vecs = self._alloc_vecs(self._slots)
+        self._codes = np.zeros((self._slots, self.dim), np.int8)
+        self._scales = np.zeros((self._slots,), np.float32)
+        self.row_to_slot: Dict[int, int] = {}
+        self._free: List[int] = list(range(self._slots - 1, -1, -1))
+
+    # ------------------------------------------------------------- storage
+    def _alloc_vecs(self, slots: int) -> np.ndarray:
+        if self.path:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            return np.memmap(self.path, dtype=self._wire, mode="w+",
+                             shape=(slots, self.dim))
+        return np.zeros((slots, self.dim), self._wire)
+
+    def _grow(self, need: int) -> None:
+        new_slots = self._slots
+        while new_slots - len(self.row_to_slot) < need:
+            new_slots *= 2
+        if new_slots == self._slots:
+            return
+        old = np.asarray(self._vecs)
+        if self.path:
+            # stage into a fresh file, then swap — a crash mid-grow leaves
+            # the old mapping readable
+            tmp = self.path + ".grow"
+            nv = np.memmap(tmp, dtype=self._wire, mode="w+",
+                           shape=(new_slots, self.dim))
+            nv[:self._slots] = old
+            nv.flush()
+            del self._vecs
+            os.replace(tmp, self.path)
+            self._vecs = np.memmap(self.path, dtype=self._wire, mode="r+",
+                                   shape=(new_slots, self.dim))
+        else:
+            nv = np.zeros((new_slots, self.dim), self._wire)
+            nv[:self._slots] = old
+            self._vecs = nv
+        nc = np.zeros((new_slots, self.dim), np.int8)
+        nc[:self._slots] = self._codes
+        self._codes = nc
+        ns = np.zeros((new_slots,), np.float32)
+        ns[:self._slots] = self._scales
+        self._scales = ns
+        self._free.extend(range(new_slots - 1, self._slots - 1, -1))
+        self._slots = new_slots
+
+    # ----------------------------------------------------------------- api
+    def put(self, rows: Sequence[int], vecs: np.ndarray,
+            codes: np.ndarray, scales: np.ndarray) -> None:
+        """Store (or overwrite) demoted rows. ``vecs`` must already be in
+        the arena dtype — the bytes are kept verbatim."""
+        v = np.asarray(vecs)
+        if self._bf16:
+            v = v.view(np.uint16) if v.dtype == _BF16 else \
+                np.asarray(v, _BF16).view(np.uint16)
+        else:
+            v = np.asarray(v, self._wire)
+        with self._lock:
+            fresh = sum(1 for r in rows if int(r) not in self.row_to_slot)
+            if fresh > len(self._free):
+                self._grow(fresh)
+            for i, r in enumerate(rows):
+                r = int(r)
+                slot = self.row_to_slot.get(r)
+                if slot is None:
+                    slot = self._free.pop()
+                    self.row_to_slot[r] = slot
+                self._vecs[slot] = v[i]
+                self._codes[slot] = codes[i]
+                self._scales[slot] = float(scales[i])
+
+    def gather(self, rows: Sequence[int]) -> np.ndarray:
+        """Exact vectors for ``rows`` in the arena dtype; rows not in the
+        store come back as zeros (the caller's cold mask gates them)."""
+        out = np.zeros((len(rows), self.dim), self._wire)
+        with self._lock:
+            for i, r in enumerate(rows):
+                slot = self.row_to_slot.get(int(r))
+                if slot is not None:
+                    out[i] = self._vecs[slot]
+        return out.view(_BF16) if self._bf16 else out
+
+    def drop(self, rows: Sequence[int]) -> None:
+        with self._lock:
+            for r in rows:
+                slot = self.row_to_slot.pop(int(r), None)
+                if slot is not None:
+                    self._free.append(slot)
+
+    def snapshot_codes(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(rows, codes, scales) of every stored row — the shadow-rebuild
+        patch (the master arena holds zeros for these rows)."""
+        with self._lock:
+            rows = np.fromiter(self.row_to_slot.keys(), np.int64,
+                               len(self.row_to_slot))
+            slots = np.fromiter(self.row_to_slot.values(), np.int64,
+                                len(self.row_to_slot))
+            return rows, self._codes[slots].copy(), self._scales[slots].copy()
+
+    def snapshot_all(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                    np.ndarray]:
+        """(rows, vecs_wire, codes, scales) for checkpointing — vectors in
+        the wire dtype (bf16 as uint16 bits)."""
+        with self._lock:
+            rows = np.fromiter(self.row_to_slot.keys(), np.int64,
+                               len(self.row_to_slot))
+            slots = np.fromiter(self.row_to_slot.values(), np.int64,
+                                len(self.row_to_slot))
+            return (rows, np.asarray(self._vecs)[slots].copy(),
+                    self._codes[slots].copy(), self._scales[slots].copy())
+
+    def __contains__(self, row: int) -> bool:
+        return int(row) in self.row_to_slot
+
+    def __len__(self) -> int:
+        return len(self.row_to_slot)
+
+    @property
+    def nbytes(self) -> int:
+        return (np.asarray(self._vecs).nbytes + self._codes.nbytes
+                + self._scales.nbytes)
+
+    def rows(self) -> List[int]:
+        with self._lock:
+            return list(self.row_to_slot.keys())

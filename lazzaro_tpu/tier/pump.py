@@ -1,0 +1,528 @@
+"""Residency management + the async demotion/promotion pump.
+
+``TierManager`` owns the tier state of one index: the per-row residency
+column (``cold_np`` host mirror + its device upload, row-sharded under a
+mesh), the host :class:`ColdStore` buckets (one per mesh partition), the
+watermark/hysteresis policy, and the telemetry gauges. ``TierPump`` is
+the background worker that runs the manager's ``run_once`` on an
+interval so demotions/promotions overlap serving dispatches.
+
+Policy (driven by the signals the decay machinery already maintains):
+
+- **Demotion** fires when the hot row count crosses
+  ``high_watermark · hot_budget_rows`` and demotes coldest-first down to
+  ``low_watermark · hot_budget_rows`` — the gap between the watermarks is
+  the hysteresis band that stops the pump from oscillating at the
+  boundary. Coldness is the salience/recency half of the importance
+  score (``w_sal · salience + w_rec / (1 + idle_days)``), read in ONE
+  bulk readback per pass. Super rows are pinned hot (the fused gate's
+  top-1 verdict must stay exact), rows touched within ``min_idle_s``
+  are skipped, and a freshly promoted row is immune for
+  ``hysteresis_s`` seconds so an access burst can't thrash it.
+- **Promotion** is access-driven: the serving path reports cold rows
+  that surfaced in final top-k results (``note_cold_hits``); a row
+  reaching ``promote_hits`` distinct hits queues for promotion, applied
+  by the next pump pass (never inline in a serve — promotion must not
+  add a dispatch to a chat turn).
+
+Mechanics: demotion moves rows in double-buffered chunks — the gather of
+chunk i+1 is dispatched (async) before chunk i's host materialization
+blocks, so device work overlaps the host copy — and each chunk's
+zero-scatter goes through the index's donation gate (``tier_demote`` /
+``*_copy``). A generation counter guards the gather→scatter window:
+if any embedding write lands in between, the chunk aborts and retries
+on the next pass instead of clobbering fresh data.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger("lazzaro_tpu.tier")
+
+
+class TierManager:
+    """Residency state + demote/promote mechanics for one index."""
+
+    def __init__(self, index, hot_budget_rows: int, *,
+                 high_watermark: float = 0.9, low_watermark: float = 0.75,
+                 chunk_rows: int = 4096, min_idle_s: float = 0.0,
+                 promote_hits: int = 1, hysteresis_s: float = 30.0,
+                 cold_dir: Optional[str] = None,
+                 w_salience: float = 0.5, w_recency: float = 0.2):
+        from lazzaro_tpu.tier.cold_store import ColdStore
+
+        self.index = index
+        self.hot_budget_rows = max(1, int(hot_budget_rows))
+        self.high_watermark = float(high_watermark)
+        self.low_watermark = float(low_watermark)
+        if not 0.0 < self.low_watermark <= self.high_watermark:
+            raise ValueError("need 0 < low_watermark <= high_watermark")
+        self.chunk_rows = max(1, int(chunk_rows))
+        # Per-PASS demotion bound for the background pump: None drains the
+        # whole watermark gap in one run_once (bulk/offline callers); a
+        # bound spreads the drain across passes so each one steals only a
+        # chunk's worth of device time from concurrent serving.
+        self.max_demote_per_pass: Optional[int] = None
+        self.min_idle_s = float(min_idle_s)
+        self.promote_hits = max(1, int(promote_hits))
+        self.hysteresis_s = float(hysteresis_s)
+        self.w_salience = float(w_salience)
+        self.w_recency = float(w_recency)
+        self.cold_dir = cold_dir
+
+        n = index.state.emb.shape[0]
+        self._n_parts = int(getattr(index, "_n_parts",
+                                    getattr(index, "n_parts", 1)) or 1)
+        self.stores: List[ColdStore] = [
+            ColdStore(index.dim, dtype=index.state.emb.dtype,
+                      path=(None if cold_dir is None else
+                            f"{cold_dir}/cold_shard{p}.bin"))
+            for p in range(self._n_parts)]
+        self.cold_np = np.zeros((n,), bool)
+        self._cold_dev = None              # built lazily / on change
+        self._lock = threading.RLock()
+        # LEAF lock for the device-mask cache alone: the serving boost
+        # path reads the mask while holding the index's _state_lock, and
+        # the pump takes (manager lock → state lock) — guarding the mask
+        # with the manager lock would close a deadlock cycle.
+        self._mask_lock = threading.Lock()
+        self._hits: Dict[int, int] = {}
+        self._promote_queue: set = set()
+        self._no_demote_until: Dict[int, float] = {}
+        # serving counters (tier.cold_hit_rate)
+        self.turns = 0
+        self.cold_turns = 0
+        self.demoted_total = 0
+        self.promoted_total = 0
+
+    # ------------------------------------------------------------ residency
+    @property
+    def cold_count(self) -> int:
+        return sum(len(s) for s in self.stores)
+
+    @property
+    def hot_rows(self) -> int:
+        return max(0, len(self.index.row_to_id) - self.cold_count)
+
+    @property
+    def telemetry(self):
+        return self.index.telemetry
+
+    def is_cold_rows(self, rows: np.ndarray) -> np.ndarray:
+        r = np.clip(np.asarray(rows, np.int64), 0, len(self.cold_np) - 1)
+        return self.cold_np[r]
+
+    def cold_mask_dev(self):
+        """The residency column as device data (row-sharded under a mesh),
+        re-uploaded only after a residency change. Guarded by the LEAF
+        mask lock only — safe to call while holding the index state lock
+        (the serving boost path does)."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._mask_lock:
+            if self._cold_dev is not None:
+                return self._cold_dev
+            dev = jnp.asarray(self.cold_np.copy())
+            sh = (getattr(self.index, "_row_sharding", None)
+                  or getattr(self.index, "_row_sh", None))
+            if sh is not None and getattr(self.index, "mesh",
+                                          None) is not None:
+                dev = jax.device_put(dev, sh)
+            self._cold_dev = dev
+            return dev
+
+    def _invalidate_mask(self) -> None:
+        with self._mask_lock:
+            self._cold_dev = None
+
+    def _part_of(self, row: int) -> int:
+        part_rows = -(-len(self.cold_np) // self._n_parts)
+        return min(int(row) // part_rows, self._n_parts - 1)
+
+    def _find_store(self, row: int):
+        s = self.stores[self._part_of(row)]
+        if row in s:
+            return s
+        for other in self.stores:          # bucket may predate a grow
+            if row in other:
+                return other
+        return None
+
+    def gather_cold(self, rows: Sequence[int]) -> np.ndarray:
+        """Exact vectors (arena dtype) for a mixed list of cold rows."""
+        out = None
+        for i, r in enumerate(rows):
+            s = self._find_store(int(r))
+            v = (s.gather([int(r)])[0] if s is not None else None)
+            if out is None:
+                dt = self.stores[0].dtype
+                out = np.zeros((len(rows), self.index.dim), dt)
+            if v is not None:
+                out[i] = v
+        if out is None:
+            dt = self.stores[0].dtype
+            out = np.zeros((0, self.index.dim), dt)
+        return out
+
+    def snapshot_codes(self):
+        """(rows, codes, scales) across every shard store — the shadow-
+        rebuild patch."""
+        parts = [s.snapshot_codes() for s in self.stores if len(s)]
+        if not parts:
+            return (np.zeros((0,), np.int64),
+                    np.zeros((0, self.index.dim), np.int8),
+                    np.zeros((0,), np.float32))
+        return (np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]),
+                np.concatenate([p[2] for p in parts]))
+
+    # ------------------------------------------------------------ mechanics
+    def demote_rows(self, rows: Sequence[int], now: Optional[float] = None
+                    ) -> int:
+        """Move ``rows`` to the cold tier in double-buffered chunks;
+        returns how many actually moved (super rows, already-cold rows and
+        chunks that lost the write race are skipped)."""
+        import jax.numpy as jnp
+
+        from lazzaro_tpu.core import state as S
+        from lazzaro_tpu.ops.quant import quantize_rows
+
+        idx = self.index
+        supers = getattr(idx, "_super_rows", set())
+        with self._lock:
+            todo = [int(r) for r in rows
+                    if not self.cold_np[r] and r not in supers
+                    and r in idx.row_to_id]
+        if not todo:
+            return 0
+        chunks = [todo[i:i + self.chunk_rows]
+                  for i in range(0, len(todo), self.chunk_rows)]
+
+        def issue(chunk):
+            st = idx.state
+            rows_dev = jnp.asarray(np.asarray(chunk, np.int32))
+            gen = getattr(idx, "_emb_gen", 0)
+            vec_dev = st.emb[rows_dev]
+            q_dev, s_dev = quantize_rows(vec_dev)
+            return chunk, gen, vec_dev, q_dev, s_dev
+
+        moved = 0
+        pending = issue(chunks[0])
+        for ci in range(len(chunks)):
+            chunk, gen, vec_dev, q_dev, s_dev = pending
+            if ci + 1 < len(chunks):
+                pending = issue(chunks[ci + 1])   # overlap the next gather
+            t0 = time.perf_counter()
+            vecs = np.asarray(vec_dev)            # blocks on the transfer
+            codes = np.asarray(q_dev)
+            scales = np.asarray(s_dev)
+            with self._lock, idx._state_lock:
+                if getattr(idx, "_emb_gen", 0) != gen:
+                    # an embedding write landed mid-flight: the gathered
+                    # bytes may be stale — retry this chunk next pass
+                    logger.debug("tier: demote chunk aborted (write race)")
+                    continue
+                by_store: Dict[int, List[int]] = {}
+                for i, r in enumerate(chunk):
+                    by_store.setdefault(self._part_of(r), []).append(i)
+                for p, idxs in by_store.items():
+                    rs = [chunk[i] for i in idxs]
+                    self.stores[p].put(rs, vecs[idxs], codes[idxs],
+                                       scales[idxs])
+                padded = S.pad_rows(np.asarray(chunk, np.int32),
+                                    idx.state.capacity)
+                idx._apply_arena(S.tier_demote, S.tier_demote_copy,
+                                 jnp.asarray(padded))
+                self.cold_np[chunk] = True
+                self._invalidate_mask()
+                moved += len(chunk)
+            ms = (time.perf_counter() - t0) * 1e3
+            self.telemetry.record("tier.pump_chunk_ms", ms,
+                                  labels={"dir": "demote"})
+            self.telemetry.gauge("tier.pump_chunk_ms", ms)
+        self.demoted_total += moved
+        self.update_gauges()
+        return moved
+
+    def promote_rows(self, rows: Sequence[int], now: Optional[float] = None
+                     ) -> int:
+        """Move cold ``rows`` back to the hot tier (exact bytes restored;
+        shadow codes were never invalidated). Returns how many moved."""
+        import jax.numpy as jnp
+
+        from lazzaro_tpu.core import state as S
+
+        idx = self.index
+        now = time.time() if now is None else now
+        moved = 0
+        with self._lock:
+            todo = [int(r) for r in rows if self.cold_np[r]]
+            if not todo:
+                return 0
+            for i in range(0, len(todo), self.chunk_rows):
+                chunk = todo[i:i + self.chunk_rows]
+                t0 = time.perf_counter()
+                gen = getattr(idx, "_emb_gen", 0)
+                vecs = self.gather_cold(chunk)
+                padded = S.pad_rows(np.asarray(chunk, np.int32),
+                                    idx.state.capacity)
+                vp = np.zeros((len(padded), idx.dim), vecs.dtype)
+                vp[:len(chunk)] = vecs
+                with idx._state_lock:
+                    if getattr(idx, "_emb_gen", 0) != gen:
+                        # a concurrent embedding write may have re-homed
+                        # one of these rows — retry next pass
+                        continue
+                    idx._apply_arena(S.tier_promote, S.tier_promote_copy,
+                                     jnp.asarray(padded), jnp.asarray(vp))
+                    for r in chunk:
+                        s = self._find_store(r)
+                        if s is not None:
+                            s.drop([r])
+                    self.cold_np[chunk] = False
+                    self._invalidate_mask()
+                for r in chunk:
+                    self._no_demote_until[r] = now + self.hysteresis_s
+                    self._hits.pop(r, None)
+                    self._promote_queue.discard(r)
+                moved += len(chunk)
+                ms = (time.perf_counter() - t0) * 1e3
+                self.telemetry.record("tier.pump_chunk_ms", ms,
+                                      labels={"dir": "promote"})
+                self.telemetry.gauge("tier.pump_chunk_ms", ms)
+        self.promoted_total += moved
+        self.update_gauges()
+        return moved
+
+    # --------------------------------------------------------------- hooks
+    def on_rows_written(self, rows: Sequence[int]) -> None:
+        """An embedding write landed on these rows (re-add / restore):
+        their master is fresh again, so any cold residue is dropped."""
+        with self._lock:
+            dirty = [int(r) for r in rows
+                     if r < len(self.cold_np) and self.cold_np[r]]
+            if not dirty:
+                return
+            for r in dirty:
+                s = self._find_store(r)
+                if s is not None:
+                    s.drop([r])
+                self._hits.pop(r, None)
+                self._promote_queue.discard(r)
+            self.cold_np[dirty] = False
+            self._invalidate_mask()
+        self.update_gauges()
+
+    on_rows_deleted = on_rows_written
+
+    def on_grow(self, new_n: int) -> None:
+        with self._lock:
+            if new_n <= len(self.cold_np):
+                return
+            grown = np.zeros((new_n,), bool)
+            grown[:len(self.cold_np)] = self.cold_np
+            self.cold_np = grown
+            self._invalidate_mask()
+
+    # ------------------------------------------------------------- serving
+    def note_turns(self, n_turns: int, n_cold_turns: int) -> None:
+        with self._lock:
+            self.turns += int(n_turns)
+            self.cold_turns += int(n_cold_turns)
+        self.update_gauges()
+
+    def note_cold_hits(self, rows: Sequence[int]) -> None:
+        """Cold rows that surfaced in final top-k results: bump their hit
+        counters; rows reaching ``promote_hits`` queue for the pump."""
+        with self._lock:
+            for r in rows:
+                r = int(r)
+                if not (r < len(self.cold_np) and self.cold_np[r]):
+                    continue
+                self._hits[r] = self._hits.get(r, 0) + 1
+                if self._hits[r] >= self.promote_hits:
+                    self._promote_queue.add(r)
+
+    # -------------------------------------------------------------- policy
+    def select_demotion_candidates(self, n: int,
+                                   now: Optional[float] = None
+                                   ) -> List[int]:
+        """The ``n`` coldest demotable rows by the salience/recency score
+        (ONE bulk readback), excluding cold rows, super rows, hysteresis-
+        protected rows and rows idle less than ``min_idle_s``."""
+        from lazzaro_tpu.utils.batching import fetch_packed
+
+        idx = self.index
+        now = time.time() if now is None else now
+        now_rel = now - idx.epoch
+        st = idx.state
+        sal, la = fetch_packed(st.salience, st.last_accessed)
+        n_rows = len(sal)
+        alive = np.zeros((n_rows,), bool)
+        live_rows = np.fromiter(idx.row_to_id.keys(), np.int64,
+                                len(idx.row_to_id))
+        alive[live_rows[live_rows < n_rows]] = True
+        ok = alive & ~self.cold_np[:n_rows]
+        supers = getattr(idx, "_super_rows", set())
+        if supers:
+            sup = np.fromiter(supers, np.int64, len(supers))
+            ok[sup[sup < n_rows]] = False
+        idle = np.maximum(now_rel - la, 0.0)
+        if self.min_idle_s > 0:
+            ok &= idle >= self.min_idle_s
+        with self._lock:
+            if self._no_demote_until:
+                dead = [r for r, t in self._no_demote_until.items()
+                        if t <= now]
+                for r in dead:
+                    del self._no_demote_until[r]
+                for r in self._no_demote_until:
+                    if r < n_rows:
+                        ok[r] = False
+        score = (self.w_salience * sal
+                 + self.w_recency / (1.0 + idle / 86400.0))
+        score = np.where(ok, score, np.inf)
+        n = min(n, int(ok.sum()))
+        if n <= 0:
+            return []
+        cand = np.argpartition(score, n - 1)[:n]
+        return [int(r) for r in cand if np.isfinite(score[r])]
+
+    def run_once(self, now: Optional[float] = None) -> Dict[str, int]:
+        """One pump pass: apply queued promotions, then watermark-driven
+        demotion. Returns {"promoted": n, "demoted": n}."""
+        now = time.time() if now is None else now
+        with self._lock:
+            promote = sorted(self._promote_queue)
+        promoted = self.promote_rows(promote, now=now) if promote else 0
+        demoted = 0
+        hot = self.hot_rows
+        if hot > self.high_watermark * self.hot_budget_rows:
+            target = int(self.low_watermark * self.hot_budget_rows)
+            need = hot - target
+            if self.max_demote_per_pass:
+                need = min(need, self.max_demote_per_pass)
+            cand = self.select_demotion_candidates(need, now=now)
+            if cand:
+                demoted = self.demote_rows(cand, now=now)
+        self.update_gauges()
+        return {"promoted": promoted, "demoted": demoted}
+
+    # ----------------------------------------------------------- telemetry
+    def update_gauges(self) -> None:
+        tel = self.telemetry
+        tel.gauge("tier.hot_rows", self.hot_rows)
+        tel.gauge("tier.cold_rows", self.cold_count)
+        tel.gauge("tier.cold_hit_rate",
+                  (self.cold_turns / self.turns) if self.turns else 0.0)
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "hot_budget_rows": self.hot_budget_rows,
+            "hot_rows": self.hot_rows,
+            "cold_rows": self.cold_count,
+            "cold_hit_rate": ((self.cold_turns / self.turns)
+                              if self.turns else 0.0),
+            "turns": self.turns,
+            "cold_turns": self.cold_turns,
+            "demoted_total": self.demoted_total,
+            "promoted_total": self.promoted_total,
+            "cold_store_bytes": sum(s.nbytes for s in self.stores),
+            "watermarks": [self.low_watermark, self.high_watermark],
+        }
+
+    # ---------------------------------------------------------- checkpoint
+    def export_arrays(self) -> Dict[str, np.ndarray]:
+        """Tier state as flat arrays for the binary checkpoint: residency
+        column + the cold store payload (vectors in the wire dtype)."""
+        parts = [s.snapshot_all() for s in self.stores if len(s)]
+        if parts:
+            rows = np.concatenate([p[0] for p in parts])
+            vecs = np.concatenate([p[1] for p in parts])
+            codes = np.concatenate([p[2] for p in parts])
+            scales = np.concatenate([p[3] for p in parts])
+        else:
+            dim = self.index.dim
+            rows = np.zeros((0,), np.int64)
+            vecs = np.zeros((0, dim), self.stores[0]._wire)
+            codes = np.zeros((0, dim), np.int8)
+            scales = np.zeros((0,), np.float32)
+        return {"tier_cold_mask": self.cold_np,
+                "tier_cold_rows": rows, "tier_cold_vecs": vecs,
+                "tier_cold_codes": codes, "tier_cold_scales": scales}
+
+    def import_arrays(self, data) -> None:
+        """Restore residency + cold store contents from checkpoint arrays
+        (the arena columns were already restored — cold rows hold zeroed
+        embeddings there, exactly as saved)."""
+        mask = np.asarray(data["tier_cold_mask"]).astype(bool)
+        with self._lock:
+            n = len(self.cold_np)
+            self.cold_np[:] = False
+            self.cold_np[:min(n, len(mask))] = mask[:n]
+            rows = np.asarray(data["tier_cold_rows"], np.int64)
+            vecs = np.asarray(data["tier_cold_vecs"])
+            codes = np.asarray(data["tier_cold_codes"])
+            scales = np.asarray(data["tier_cold_scales"])
+            store = self.stores[0]
+            if store._bf16 and vecs.dtype == store._wire:
+                vecs = vecs.view(store.dtype)  # uint16 bits → bf16, no cast
+            for i in range(0, len(rows), self.chunk_rows):
+                sl = slice(i, i + self.chunk_rows)
+                by_store: Dict[int, List[int]] = {}
+                for j, r in enumerate(rows[sl]):
+                    by_store.setdefault(self._part_of(int(r)), []).append(j)
+                base = i
+                for p, idxs in by_store.items():
+                    rs = [int(rows[base + j]) for j in idxs]
+                    self.stores[p].put(rs, vecs[sl][idxs], codes[sl][idxs],
+                                       scales[sl][idxs])
+            self._invalidate_mask()
+        self.update_gauges()
+
+
+class TierPump:
+    """Async wrapper: run ``manager.run_once()`` every ``interval_s`` on a
+    daemon thread so tier traffic overlaps serving dispatches."""
+
+    def __init__(self, manager: TierManager, interval_s: float = 1.0,
+                 name: str = "lz-tier-pump"):
+        self.manager = manager
+        self.interval_s = max(0.01, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+
+    def start(self) -> "TierPump":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=self._name)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.manager.run_once()
+            except Exception:               # noqa: BLE001 — pump must survive
+                logger.exception("tier pump pass failed")
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()

@@ -45,6 +45,16 @@ the paths passed as arguments) and exits nonzero if:
     snuck back in; ragged kernels are keyed per (mode × geometry)
     only); pre-ragged artifacts (``pr2_``…``pr6_`` prefixes) are
     grandfathered,
+  - (ISSUE 8) a TIERED artifact (any dict with ``"tiered": true``) does
+    not record ``cold_hit_rate`` and ``hot_fraction``, or lacks a
+    ``recall_at_10``/``recall_floor`` pair (the generic recall gate then
+    enforces the floor — tiering must never silently trade recall for
+    capacity), or records a missing/over-budget
+    ``cold_hit_dispatches_per_turn`` (> 2: a cold hit is allowed the ONE
+    bounded finish dispatch on top of the coarse scan, never a cascade;
+    the hot-only probe's ``dispatches_per_turn`` stays pinned to 1 by
+    the generic dispatch gate). Earlier artifacts never carry the flag,
+    so they are grandfathered by construction,
 
 so any of these regressions turns red in CI instead of shipping.
 
@@ -76,7 +86,8 @@ _TELEMETRY_KEYS = ("pad_waste_fraction", "queue_wait_ms_p50",
                    "queue_wait_ms_p95", "peak_hbm_bytes")
 
 
-def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds):
+def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
+          tiereds):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -90,17 +101,19 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds):
                                obj.get("telemetry")))
         if obj.get("ragged") is True:
             raggeds.append((path, obj))
+        if obj.get("tiered") is True:
+            tiereds.append((path, obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k == "dispatches_per_turn":
                 hits.append((here, v))
             else:
                 _walk(v, here, hits, recalls, speedups, meshes, tel_blocks,
-                      raggeds)
+                      raggeds, tiereds)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
             _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
-                  tel_blocks, raggeds)
+                  tel_blocks, raggeds, tiereds)
 
 
 def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
@@ -165,6 +178,28 @@ def _check_ragged(loc, obj, bad):
                          f"specialization snuck back in)"))
 
 
+def _check_tiered(loc, obj, bad):
+    """The ISSUE 8 tiered-memory gate on one ``"tiered": true`` dict."""
+    for key in ("cold_hit_rate", "hot_fraction"):
+        if key not in obj:
+            bad.append((loc, f"tiered artifact must record '{key}'"))
+    if "recall_at_10" not in obj or "recall_floor" not in obj:
+        bad.append((loc, "tiered artifact must record a recall_at_10/"
+                         "recall_floor pair"))
+    if "dispatches_per_turn" not in obj:
+        bad.append((loc, "tiered artifact must record the hot-only "
+                         "probe's measured dispatches_per_turn"))
+    cold_d = obj.get("cold_hit_dispatches_per_turn")
+    try:
+        ok = float(cold_d) <= 2.0
+    except (TypeError, ValueError):
+        ok = False
+    if not ok:
+        bad.append((loc, f"cold_hit_dispatches_per_turn == {cold_d!r} "
+                         f"(must record a measured value <= 2 — coarse "
+                         f"scan + ONE bounded finish)"))
+
+
 def main(argv):
     if argv:
         paths = argv
@@ -178,6 +213,7 @@ def main(argv):
     checked_mesh = 0
     checked_telemetry = 0
     checked_ragged = 0
+    checked_tiered = 0
     bad = []
     for p in paths:
         try:
@@ -186,10 +222,10 @@ def main(argv):
         except (OSError, ValueError) as e:
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
-        hits, recalls, speedups, meshes, tel_blocks, raggeds = \
-            [], [], [], [], [], []
+        hits, recalls, speedups, meshes, tel_blocks, raggeds, tiereds = \
+            [], [], [], [], [], [], []
         _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
-              tel_blocks, raggeds)
+              tel_blocks, raggeds, tiereds)
         grandfathered = os.path.basename(p).startswith(
             _PRE_TELEMETRY_PREFIXES)
         for loc, measured_fused, block in tel_blocks:
@@ -199,6 +235,9 @@ def main(argv):
             for loc, obj in raggeds:
                 checked_ragged += 1
                 _check_ragged(loc, obj, bad)
+        for loc, obj in tiereds:
+            checked_tiered += 1
+            _check_tiered(loc, obj, bad)
         for loc, v in hits:
             checked += 1
             if v != 1:
@@ -232,8 +271,9 @@ def main(argv):
     print(f"[check] {checked} dispatches_per_turn value(s), "
           f"{checked_recall} recall pair(s), {checked_speedup} speedup "
           f"pair(s), {checked_mesh} sharded artifact(s), "
-          f"{checked_telemetry} telemetry block(s), and "
-          f"{checked_ragged} ragged gate(s) across "
+          f"{checked_telemetry} telemetry block(s), "
+          f"{checked_ragged} ragged gate(s), and "
+          f"{checked_tiered} tiered gate(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 

@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""CI gate: every compiled serving geometry must fit the per-chip HBM budget.
+
+Each fusion round grows the live set of the one big dispatch (arena +
+shadow + IVF tables + edge arena + packed readback), and before this gate
+the only OOM signal was a runtime crash at a new (size × mode × mesh)
+combination. "Memory Safe Computations with XLA" (PAPERS.md) argues the
+fix is compile-time enforcement — and PR 6 already records the measured
+half: ``MemoryIndex._maybe_record_hbm`` AOT-lowers every fused serving
+geometry's read twin once and lands its ``memory_analysis()`` peak in the
+``kernel.peak_hbm_bytes{mode,k,rows,mesh}`` gauge, which every bench
+artifact embeds in its telemetry block. This script (ROADMAP item 8 seed,
+ISSUE 8 satellite) walks the checked-in artifacts and
+
+- FAILS (exit 1) when any recorded kernel's peak exceeds the budget
+  (``--budget-gb``, default 16 — a v5e chip), so a geometry that will OOM
+  in production turns red in CI instead;
+- RECORDS the headroom back into each artifact (an ``hbm_budget`` block:
+  max peak, worst kernel, headroom bytes and fraction), so the next
+  size-doubling PR knows how much room the current programs leave.
+  ``--no-write`` skips the write-back (plain verification mode).
+
+Usage:
+    python scripts/check_hbm_budget.py [--budget-gb G] [--no-write] \
+        [artifact.json ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+GAUGE_PREFIX = "kernel.peak_hbm_bytes"
+
+
+def _collect(obj, found):
+    """Every ``kernel.peak_hbm_bytes{...}`` gauge anywhere in the artifact
+    (telemetry blocks, registry snapshots, metrics_summary embeds)."""
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if isinstance(k, str) and k.startswith(GAUGE_PREFIX) \
+                    and isinstance(v, (int, float)):
+                found[k] = max(float(v), found.get(k, 0.0))
+            else:
+                _collect(v, found)
+    elif isinstance(obj, list):
+        for v in obj:
+            _collect(v, found)
+
+
+def check_artifact(path: str, budget_bytes: float, write: bool):
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"[hbm] skipping unreadable {path}: {e}", file=sys.stderr)
+        return 0, []
+    found: dict = {}
+    _collect(data, found)
+    if not found:
+        return 0, []
+    worst_key = max(found, key=found.get)
+    worst = found[worst_key]
+    over = [(k, v) for k, v in sorted(found.items()) if v > budget_bytes]
+    if write:
+        data["hbm_budget"] = {
+            "budget_bytes": budget_bytes,
+            "kernels_checked": len(found),
+            "max_peak_bytes": worst,
+            "worst_kernel": worst_key,
+            "headroom_bytes": budget_bytes - worst,
+            "headroom_fraction": round(1.0 - worst / budget_bytes, 4),
+            "ok": not over,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1)
+        os.replace(tmp, path)
+    return len(found), [(path, k, v) for k, v in over]
+
+
+def main(argv):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", help="artifact JSONs "
+                    "(default: bench_artifacts/*.json)")
+    ap.add_argument("--budget-gb", type=float, default=16.0,
+                    help="per-chip HBM budget in GiB (default 16)")
+    ap.add_argument("--no-write", action="store_true",
+                    help="verify only; do not record headroom back")
+    args = ap.parse_args(argv)
+    if args.paths:
+        paths = args.paths
+    else:
+        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "bench_artifacts")
+        paths = sorted(glob.glob(os.path.join(root, "*.json")))
+    budget = args.budget_gb * (1 << 30)
+    checked = 0
+    breaches = []
+    with_gauges = 0
+    for p in paths:
+        n, over = check_artifact(p, budget, write=not args.no_write)
+        checked += n
+        if n:
+            with_gauges += 1
+        breaches.extend(over)
+    for path, key, val in breaches:
+        print(f"HBM-BUDGET-EXCEEDED: {os.path.basename(path)}: {key} = "
+              f"{val / (1 << 30):.2f} GiB > {args.budget_gb} GiB")
+    print(f"[hbm] {checked} kernel gauge(s) across {with_gauges}/"
+          f"{len(paths)} artifact(s) checked against "
+          f"{args.budget_gb} GiB; {len(breaches)} breach(es)")
+    return 1 if breaches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -31,6 +31,8 @@ SCOPE = (
     "lazzaro_tpu/serve/*.py",
     "lazzaro_tpu/parallel/*.py",
     "lazzaro_tpu/ops/*.py",
+    "lazzaro_tpu/tier/*.py",
+    "lazzaro_tpu/models/*.py",
     "lazzaro_tpu/utils/batching.py",
     "lazzaro_tpu/utils/telemetry.py",
     "lazzaro_tpu/utils/compat.py",

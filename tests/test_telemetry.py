@@ -378,6 +378,54 @@ def test_metrics_endpoint_matches_summary():
         assert summary["telemetry"]["counters"]["device.boost_rows"] >= 1
 
 
+def test_tier_gauges_on_every_surface():
+    """ISSUE 8 satellite: the tier gauges (tier.hot_rows / tier.cold_rows
+    / tier.cold_hit_rate / tier.pump_chunk_ms) land in the registry and
+    surface through metrics_summary(), the Prometheus ``/metrics`` text
+    AND the JSON ``/api/metrics`` — the endpoint-parity contract extended
+    to the tiered-memory subsystem."""
+    from lazzaro_tpu.dashboard.api import make_server
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ms = _system(tmp)
+        ms.config.tier_hot_budget_rows = 8
+        tmgr = ms.index.enable_tiering(8, hysteresis_s=0.0)
+        _ingest(ms, convs=3)
+        rows = [r for r in ms.index.row_to_id][:6]
+        tmgr.demote_rows(rows)
+        ms.chat("conv 1")                 # serving feeds cold_hit_rate
+        summary = ms.metrics_summary()
+        assert summary["tier"]["cold_rows"] == tmgr.cold_count > 0
+        assert summary["tier"]["hot_rows"] == tmgr.hot_rows
+        gauges = summary["telemetry"]["gauges"]
+        for name in ("tier.hot_rows", "tier.cold_rows",
+                     "tier.cold_hit_rate", "tier.pump_chunk_ms"):
+            assert name in gauges, name
+        assert gauges["tier.cold_rows"] == tmgr.cold_count
+
+        server = make_server(ms, "127.0.0.1", 0)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/metrics") as r:
+                api = json.loads(r.read())
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as r:
+                text = r.read().decode()
+        finally:
+            server.shutdown()
+            t.join(timeout=10)
+            ms.close()
+        assert api["tier"]["cold_rows"] == summary["tier"]["cold_rows"]
+        assert api["telemetry"]["gauges"]["tier.cold_rows"] == \
+            gauges["tier.cold_rows"]
+        assert f"lazzaro_tier_cold_rows {float(tmgr.cold_count)}" in text
+        assert "lazzaro_tier_hot_rows" in text
+        assert "lazzaro_tier_cold_hit_rate" in text
+
+
 def test_metrics_summary_shape():
     with tempfile.TemporaryDirectory() as tmp:
         ms = _ingest(_system(tmp))
