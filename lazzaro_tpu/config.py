@@ -81,6 +81,15 @@ class MemoryConfig:
     # dispatch+readback for runs INSIDE the same donated dispatch, making
     # ingest ONE round trip end-to-end. Only effective with ingest_fused.
     ingest_dedup_fused: bool = True
+    # Pod-scale fused ingest (ISSUE 9): under a mesh, run the whole
+    # dedup-fused ingest program as ONE distributed shard_map dispatch
+    # (state.make_ingest_fused_sharded) — shard-local dedup/link scans,
+    # one all_gather candidate merge, owner-chip-local node/edge/shadow
+    # scatters — so write throughput scales with the mesh like read
+    # throughput has since PR 5. Off = let GSPMD partition the plain jit
+    # kernel (correct, but re-replicates candidate tensors chip-to-chip
+    # every batch; debug/fallback). No effect without a mesh.
+    ingest_sharded: bool = True
 
     # --- serving path (lazzaro_tpu/serve) ----------------------------------
     # Fused single-dispatch retrieval (core/state.py search_fused): the

@@ -91,6 +91,29 @@ def split_csr(indptr: np.ndarray, nbr: np.ndarray, n_shards: int
     return indptr_sh, nbr_sh
 
 
+def link_pool_size(worst: int, hint: float) -> int:
+    """Edge-slot pool sizing for the compacting fused ingest (ROADMAP
+    ceiling #2), shared by the single-chip and pod indexes:
+    ``ceil(hint · worst)`` real slots instead of the worst case — a huge
+    mostly-rejected batch no longer transiently drains the free list —
+    floored at one slot so the overflow machinery (not an empty gather)
+    handles a zero hint."""
+    h = float(hint)
+    if h >= 1.0 or worst <= 0:
+        return worst
+    return min(worst, max(1, int(np.ceil(max(0.0, h) * worst))))
+
+
+def link_pool_dev(pool: Sequence[int], padded_len: int, ecap: int):
+    """Device view of the link-slot pool for the compacting fused ingest:
+    real slots first, sentinel (``ecap``) padding up to the jit-bucketed
+    length, and one trailing sentinel entry the kernel routes every
+    rejected candidate through."""
+    arr = np.full((padded_len + 1,), ecap, np.int32)
+    arr[:len(pool)] = pool
+    return jnp.asarray(arr)
+
+
 class MemoryIndex:
     """Single-chip by default; pass ``mesh`` to row-shard every arena column
     over a mesh axis — the scaling-book recipe: annotate the shardings, let
@@ -109,7 +132,8 @@ class MemoryIndex:
                  telemetry=None, telemetry_hbm: bool = False,
                  serve_ragged: bool = True, serve_k_max: int = 128,
                  serve_pad_granularity: int = 8,
-                 serve_kernel_cache_max: int = 8):
+                 serve_kernel_cache_max: int = 8,
+                 ingest_sharded: bool = True):
         self.dim = dim
         self.dtype = dtype
         # Serving telemetry (ISSUE 6): spans + device counters land in this
@@ -197,6 +221,20 @@ class MemoryIndex:
         self.mesh = mesh
         self.shard_axis = shard_axis
         self._n_parts = int(mesh.shape[shard_axis]) if mesh is not None else 1
+        # Pod-scale fused ingest (ISSUE 9): under a mesh the whole
+        # ``ingest_dedup_fused`` program runs as ONE distributed shard_map
+        # dispatch (state.make_ingest_fused_sharded) — shard-local dedup/
+        # link scans, one all_gather merge, owner-chip-local scatters —
+        # instead of letting GSPMD partition the plain jit kernel (which
+        # re-replicates the candidate tensors chip-to-chip every batch).
+        # Write throughput then scales with the mesh the way read
+        # throughput has since PR 5.
+        self.ingest_sharded = bool(ingest_sharded) and mesh is not None
+        self._ingest_sharded_cache = LRUKernelCache(serve_kernel_cache_max)
+        # Device dispatches on the ingest path (fused or classic mutation
+        # kernels) — the measured ``dispatches_per_conversation`` counter
+        # bench and the jit-counter tests read.
+        self.ingest_dispatch_count = 0
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             self._row_sharding = NamedSharding(mesh, P(shard_axis))
@@ -414,12 +452,17 @@ class MemoryIndex:
             del cur
             self.edge_state = out
 
-    def _ingest_shadow_arg(self):
+    def _ingest_shadow_arg(self, sharded_ok: bool = False):
         """Int8 shadow to thread through the fused ingest program for
         incremental code maintenance, or None when there is nothing valid
-        to maintain (int8 off, mesh path, shadow dirty/absent, or the
-        arena grew since the shadow was built). Caller holds _state_lock."""
-        if not self.int8_serving or self.mesh is not None or self._int8_dirty:
+        to maintain (int8 off, shadow dirty/absent, or the arena grew
+        since the shadow was built). Under a mesh only the SHARDED ingest
+        program maintains the shadow (``sharded_ok=True`` — the shadow
+        row-shards with the master and the scatter is owner-chip-local);
+        the GSPMD fallback marks it dirty instead. Caller holds
+        _state_lock."""
+        mesh_blocked = self.mesh is not None and not sharded_ok
+        if not self.int8_serving or mesh_blocked or self._int8_dirty:
             return None
         shadow = self._int8_shadow
         if shadow is None or shadow[0].shape[0] != self._state.emb.shape[0]:
@@ -451,8 +494,9 @@ class MemoryIndex:
                     and sys.getrefcount(edges) <= self._SOLE_REFS
                     and self._shadow_sole(shadow))
             fn = S.ingest_fused if sole else S.ingest_fused_copy
-            new_arena, new_edges, new_shadow, link_flat = fn(
-                arena, edges, shadow, *args, **kwargs)
+            new_arena, new_edges, new_shadow, link_flat = \
+                self._ingest_dispatch(fn, arena, edges, shadow, *args,
+                                      **kwargs)
             del arena, edges, shadow
             self.state = new_arena
             self.edge_state = new_edges
@@ -883,38 +927,74 @@ class MemoryIndex:
         return rows, candidates, created
 
     def _link_pool_size(self, worst: int, hint: float) -> int:
-        """Edge-slot pool sizing for the compacting fused ingest (ROADMAP
-        ceiling #2): ``ceil(hint · worst)`` real slots instead of the
-        worst case — a huge mostly-rejected batch no longer transiently
-        drains the free list — floored at one slot so the overflow
-        machinery (not an empty gather) handles a zero hint."""
-        h = float(hint)
-        if h >= 1.0 or worst <= 0:
-            return worst
-        return min(worst, max(1, int(np.ceil(max(0.0, h) * worst))))
+        """See module-level :func:`link_pool_size` (shared with the pod
+        index)."""
+        return link_pool_size(worst, hint)
 
     def _link_pool_dev(self, pool: List[int], padded_len: int, ecap: int):
-        """Device view of the link-slot pool for the compacting fused
-        ingest: real slots first, sentinel (``ecap``) padding up to the
-        jit-bucketed length, and one trailing sentinel entry the kernel
-        routes every rejected candidate through."""
-        arr = np.full((padded_len + 1,), ecap, np.int32)
-        arr[:len(pool)] = pool
-        return jnp.asarray(arr)
+        """See module-level :func:`link_pool_dev` (shared with the pod
+        index)."""
+        return link_pool_dev(pool, padded_len, ecap)
 
-    def _apply_dedup_fused(self, *args, **kwargs):
-        """Dispatch ``S.ingest_dedup_fused`` over BOTH states (plus the
-        maintained int8 shadow) under the ownership gate (mirror of
-        ``_apply_fused``); returns ``(flat, shadow_maintained)``."""
+    def _ingest_dispatch(self, fn, *args, **kwargs):
+        """The device-program entry point every fused ingest goes through
+        — bench and the jit-counter tests wrap it to measure
+        ``dispatches_per_conversation`` (one call == one dispatch, single
+        chip or distributed)."""
+        self.ingest_dispatch_count += 1
+        return fn(*args, **kwargs)
+
+    def _ingest_sharded_kernels(self, k: int, shard_modes: Tuple[int, ...],
+                                with_shadow: bool) -> S.IngestShardedKernels:
+        """Cached distributed fused-ingest programs per (k, shard-mode
+        tuple, shadow-maintained) key — batch geometry is a jit retrace
+        within one program, exactly like the single-chip kernels."""
+        key = (k, shard_modes, with_shadow)
+        kern = self._ingest_sharded_cache.get(key)
+        if kern is None:
+            kern = S.make_ingest_fused_sharded(
+                self.mesh, self.shard_axis, k=k, shard_modes=shard_modes,
+                with_shadow=with_shadow)
+            self._ingest_sharded_cache.put(key, kern)
+            self.telemetry.gauge("kernel.cache_entries",
+                                 len(self._ingest_sharded_cache),
+                                 labels={"surface": "ingest_sharded"})
+        return kern
+
+    def _apply_dedup_fused(self, *args, k, shard_modes):
+        """Dispatch the device-dedup fused ingest over BOTH states (plus
+        the maintained int8 shadow) under the ownership gate (mirror of
+        ``_apply_fused``); returns ``(flat, shadow_maintained)``. Under a
+        mesh with ``ingest_sharded`` the program is the distributed
+        shard_map composition (ONE distributed dispatch; the shadow
+        row-shards with the master, so it stays maintained in-kernel on
+        the pod path too)."""
+        sharded = self.ingest_sharded and self.mesh is not None
         with self._state_lock:
             arena, edges = self._state, self._edge_state
-            shadow = self._ingest_shadow_arg()
+            shadow = self._ingest_shadow_arg(sharded_ok=sharded)
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                     and sys.getrefcount(edges) <= self._SOLE_REFS
                     and self._shadow_sole(shadow))
-            fn = S.ingest_dedup_fused if sole else S.ingest_dedup_fused_copy
-            new_arena, new_edges, new_shadow, flat = fn(
-                arena, edges, shadow, *args, **kwargs)
+            if sharded:
+                kern = self._ingest_sharded_kernels(k, tuple(shard_modes),
+                                                    shadow is not None)
+                fn = kern.ingest if sole else kern.ingest_copy
+                if shadow is not None:
+                    new_arena, new_edges, q8n, sn, flat = \
+                        self._ingest_dispatch(fn, arena, edges, shadow[0],
+                                              shadow[1], *args)
+                    new_shadow = (q8n, sn)
+                else:
+                    new_arena, new_edges, flat = self._ingest_dispatch(
+                        fn, arena, edges, *args)
+                    new_shadow = None
+            else:
+                fn = (S.ingest_dedup_fused if sole
+                      else S.ingest_dedup_fused_copy)
+                new_arena, new_edges, new_shadow, flat = \
+                    self._ingest_dispatch(fn, arena, edges, shadow, *args,
+                                          k=k, shard_modes=shard_modes)
             del arena, edges, shadow
             self.state = new_arena
             self.edge_state = new_edges
@@ -985,26 +1065,31 @@ class MemoryIndex:
                                         ecap)
 
         now_abs = now if now is not None else time.time()
+        dev_args = (
+            jnp.asarray(padded), jnp.asarray(emb),
+            jnp.asarray(pad([float(s) for s in saliences])),
+            jnp.asarray(pad([float(t) - self.epoch
+                             for t in timestamps])),
+            jnp.asarray(pad([S.TYPE_IDS.get(t, 0) for t in types], 0,
+                            np.int32)),
+            jnp.asarray(pad([self.shard_id(sk or "default")
+                             for sk in shard_keys], -1, np.int32)),
+            jnp.asarray(pad([tid] * n, -1, np.int32)),
+            jnp.asarray(pad([False] * n, False, bool)),
+            jnp.asarray(pad(gids, -1, np.int32)),
+            jnp.asarray(chain_slots), link_pool,
+            jnp.int32(len(link_pool_list)),
+            jnp.float32(now_abs - self.epoch), jnp.int32(tid),
+            jnp.float32(dedup_gate), jnp.float32(chain_weight),
+            jnp.float32(link_gate), jnp.float32(link_scale))
+        kind = ("sharded_dedup_fused"
+                if self.ingest_sharded and self.mesh is not None
+                else "dedup_fused")
+        self._maybe_record_ingest_hbm(dev_args, k_eff, shard_modes, b)
         t0 = time.perf_counter()
-        with trace_annotation("lz.ingest.dedup_fused"):
+        with trace_annotation(f"lz.ingest.{kind}"):
             flat, shadow_fresh = self._apply_dedup_fused(
-                jnp.asarray(padded), jnp.asarray(emb),
-                jnp.asarray(pad([float(s) for s in saliences])),
-                jnp.asarray(pad([float(t) - self.epoch
-                                 for t in timestamps])),
-                jnp.asarray(pad([S.TYPE_IDS.get(t, 0) for t in types], 0,
-                                np.int32)),
-                jnp.asarray(pad([self.shard_id(sk or "default")
-                                 for sk in shard_keys], -1, np.int32)),
-                jnp.asarray(pad([tid] * n, -1, np.int32)),
-                jnp.asarray(pad([False] * n, False, bool)),
-                jnp.asarray(pad(gids, -1, np.int32)),
-                jnp.asarray(chain_slots), link_pool,
-                jnp.int32(len(link_pool_list)),
-                jnp.float32(now_abs - self.epoch), jnp.int32(tid),
-                jnp.float32(dedup_gate), jnp.float32(chain_weight),
-                jnp.float32(link_gate), jnp.float32(link_scale),
-                k=k_eff, shard_modes=shard_modes)
+                *dev_args, k=k_eff, shard_modes=shard_modes)
             if not shadow_fresh:
                 self._int8_dirty = True
             self._pq_dirty = True
@@ -1012,12 +1097,12 @@ class MemoryIndex:
             host = fetch_packed(*flat)         # the ONE readback
         self.telemetry.record("ingest.dispatch_ms",
                               (time.perf_counter() - t0) * 1e3,
-                              labels={"kind": "dedup_fused"})
+                              labels={"kind": kind})
         # Device counters riding the same readback: dedup verdicts are the
         # first wide leaf; the link counters trail the per-mode triples.
         ctr = host[3 + 3 * n_modes:]
         self.telemetry.bump("ingest.dispatches",
-                            labels={"kind": "dedup_fused"})
+                            labels={"kind": kind})
         self.telemetry.bump("ingest.dedup_hits",
                             int((host[0][:n, 0] > 0).sum()))
         self.telemetry.bump("ingest.links_accepted", int(ctr[1][0, 0]))
@@ -1134,6 +1219,99 @@ class MemoryIndex:
             self.add_edges(overflowed, pending["tenant"],
                            now=pending["now"])
         return candidates, created, merges, chains
+
+    def _maybe_record_ingest_hbm(self, dev_args, k_eff: int, shard_modes,
+                                 b: int) -> None:
+        """Opt-in peak-HBM gauge for one ingest-kernel geometry (ISSUE 9
+        satellite, write-path twin of the serving ``_maybe_record_hbm``):
+        AOT-lower the NON-donating twin once per (batch-bucket, k, modes,
+        mesh) key and record ``memory_analysis()`` into
+        ``kernel.peak_hbm_bytes{path="ingest",batch,rows,mesh}`` so
+        ``scripts/check_hbm_budget.py`` gates write-path geometries too.
+        One extra compile, zero extra dispatches."""
+        if not self.telemetry_hbm or not self.telemetry.enabled:
+            return    # never consume the once-key while warmup mutes the registry
+        key = ("ingest", b, k_eff, tuple(shard_modes),
+               self.state.emb.shape[0])
+        if key in self._hbm_recorded:
+            return
+        self._hbm_recorded.add(key)
+        try:
+            with self._state_lock:
+                arena, edges = self._state, self._edge_state
+                sharded = self.ingest_sharded and self.mesh is not None
+                shadow = self._ingest_shadow_arg(sharded_ok=sharded)
+                if sharded:
+                    kern = self._ingest_sharded_kernels(
+                        k_eff, tuple(shard_modes), shadow is not None)
+                    sh = shadow if shadow is not None else ()
+                    lowered = kern.ingest_copy.lower(arena, edges, *sh,
+                                                     *dev_args)
+                else:
+                    lowered = S.ingest_dedup_fused_copy.lower(
+                        arena, edges, shadow, *dev_args, k=k_eff,
+                        shard_modes=tuple(shard_modes))
+            peak = peak_bytes(lowered.compile().memory_analysis())
+        except Exception:   # noqa: BLE001 — observability must never block ingest
+            return
+        if peak is not None:
+            self.telemetry.gauge(
+                "kernel.peak_hbm_bytes", peak,
+                labels={"path": "ingest", "batch": str(b),
+                        "rows": str(self.state.emb.shape[0]),
+                        "mesh": (f"{self._n_parts}x{self.shard_axis}"
+                                 if self.mesh is not None else "1")})
+
+    def warmup_ingest(self, geometries=(256,), *, dedup_gate: float = 0.95,
+                      link_k: int = 3, shard_modes=(1, 0),
+                      link_accept_hint: float = 1.0) -> Dict[int, float]:
+        """Pre-compile the fused ingest kernels (ISSUE 9 satellite, the
+        write-path mirror of ``warmup_serving``) so the first live
+        mega-batch doesn't eat a cold multi-second XLA compile.
+        ``geometries`` are fact-batch sizes (rounded to the ``pad_rows``
+        bucket); for each, a synthetic batch of a throwaway tenant is
+        driven through the REAL dispatch path (``ingest_batch_dedup`` +
+        ``commit_ingest_dedup``) and then deleted — the live corpus is
+        unchanged afterwards, but exactly the jit cache entries live
+        traffic will hit (shapes, dtypes, mesh composition included) are
+        populated. Telemetry counters are suppressed while warming; wall
+        time lands in ``kernel.warmup_ms{path="ingest",batch}``. Returns
+        ``{padded_batch: ms}``. Geometries that would force an arena grow
+        are skipped (growth would change the compiled shapes anyway)."""
+        out: Dict[int, float] = {}
+        tel = self.telemetry
+        rng = np.random.default_rng(0)
+        buckets = sorted({len(S.pad_rows(np.zeros((g,), np.int32),
+                                         self.state.capacity))
+                          for g in geometries if g > 0})
+        for g in buckets:
+            if len(self._free_rows) < g:
+                continue                    # would grow: wrong geometry
+            t0 = time.perf_counter()
+            prev = tel.enabled
+            tel.enabled = False
+            try:
+                emb = rng.standard_normal((g, self.dim)).astype(np.float32)
+                pending = self.ingest_batch_dedup(
+                    emb, [0.5] * g, [self.epoch] * g, ["semantic"] * g,
+                    ["~warmup"] * g, tenant="~warmup-ingest",
+                    dedup_gate=float(dedup_gate), link_k=link_k,
+                    shard_modes=tuple(shard_modes),
+                    link_accept_hint=link_accept_hint)
+                ids = []
+                if pending is not None:
+                    dup = pending["dup"]
+                    ids = [None if dup[i] else f"~warm:{g}:{i}"
+                           for i in range(g)]
+                    self.commit_ingest_dedup(pending, ids)
+                self.delete([i for i in ids if i])
+            finally:
+                tel.enabled = prev
+            ms = (time.perf_counter() - t0) * 1e3
+            tel.record("kernel.warmup_ms", ms,
+                       labels={"path": "ingest", "batch": str(g)})
+            out[g] = ms
+        return out
 
     def delete(self, ids: Iterable[str]) -> None:
         ids = list(ids)
@@ -1995,8 +2173,8 @@ class MemoryIndex:
         footprint before a new size/mode combination can OOM in production.
         Opt-in (``telemetry_hbm``) because the AOT lower+compile of the
         read twin is an extra compile (never an extra dispatch)."""
-        if not self.telemetry_hbm:
-            return
+        if not self.telemetry_hbm or not self.telemetry.enabled:
+            return    # never consume the once-key while warmup mutes the registry
         key = (mode, ragged) + tuple(sorted(statics.items()))
         if key in self._hbm_recorded:
             return
@@ -2137,7 +2315,7 @@ class MemoryIndex:
             read_extra = (k_dev, npq_dev, jnp.float32(super_gate))
         else:
             read_extra = (jnp.float32(super_gate),)
-        if self.telemetry_hbm:
+        if self.telemetry_hbm and self.telemetry.enabled:
             hkey = ("sharded", mode, ragged, k_bucket, cap_take, max_nbr)
             if hkey not in self._hbm_recorded:
                 self._hbm_recorded.add(hkey)

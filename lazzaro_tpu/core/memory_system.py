@@ -164,7 +164,8 @@ class MemorySystem:
                                  serve_ragged=cfg.serve_ragged,
                                  serve_k_max=cfg.serve_k_max,
                                  serve_pad_granularity=cfg.serve_pad_granularity,
-                                 serve_kernel_cache_max=cfg.serve_kernel_cache_max)
+                                 serve_kernel_cache_max=cfg.serve_kernel_cache_max,
+                                 ingest_sharded=cfg.ingest_sharded)
 
         # Tiered memory (ISSUE 8): a hot-row budget attaches the residency
         # manager and (with async on) the background demotion/promotion
@@ -1078,12 +1079,20 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
             self._log(f"⏳ Ingest deferred: {len(self._ingest_coalescer)} "
                       "facts buffered by the flush policy")
             return
+        # Per-batch coalesce-wait span (ISSUE 9 satellite): how long the
+        # oldest buffered conversation waited for its mega-batch — the
+        # write-path twin of the serving queue-wait span, so the
+        # ingest_flush_wait_s trade (denser dispatches vs added latency)
+        # is measured, not guessed.
+        coalesce_wait_ms = self._ingest_coalescer.oldest_age_s() * 1e3
         mega_batches = self._ingest_coalescer.drain()
         if len(mega_batches) > 1:
             self._log(f"   (ingest split into {len(mega_batches)} mega-"
                       f"batches of ≤ {self._ingest_coalescer.max_facts} facts)")
         new_nodes: List[Tuple[str, str]] = []
         for facts, _n_convs in mega_batches:
+            self.telemetry.record("ingest.coalesce_wait_ms",
+                                  coalesce_wait_ms)
             new_nodes.extend(self._ingest_facts(facts))
 
         self._finish_consolidation(new_nodes, start_time)
@@ -2776,8 +2785,10 @@ STORAGE:
         # flush any queued cache-hit boosts.
         if getattr(self, "_ingest_coalescer", None) and len(self._ingest_coalescer):
             start = time.time()
+            wait_ms = self._ingest_coalescer.oldest_age_s() * 1e3
             drained: List[Tuple[str, str]] = []
             for facts, _n_convs in self._ingest_coalescer.drain():
+                self.telemetry.record("ingest.coalesce_wait_ms", wait_ms)
                 drained.extend(self._ingest_facts(facts))
             self._finish_consolidation(drained, start)
         if getattr(self, "_pending_boosts", None):

@@ -775,7 +775,101 @@ ingest_fused, ingest_fused_copy = _donated_pair(
 # Fused ingest WITH device-side dedup: the probe that decides merge-vs-insert
 # runs against the pre-add arena INSIDE the same dispatch (ROADMAP item 2),
 # so ingest is one round trip end-to-end.
+#
+# The scan and resolve bodies below are the SHARD-LOCAL CORES of the pod
+# ingest program too (``make_ingest_fused_sharded``): the single-chip kernel
+# and the distributed kernel trace the same functions, so parity is
+# structural — the PR 5 recipe applied to the write path (ISSUE 9).
 # ---------------------------------------------------------------------------
+
+
+def _ingest_scan_core(state: ArenaState, qd: jax.Array, q_shard: jax.Array,
+                      probe_excl: jax.Array, link_excl: jax.Array,
+                      tenant: jax.Array, k: int,
+                      shard_modes: Tuple[int, ...],
+                      chunk: int = QUERY_CHUNK):
+    """The whole-arena ingest scan: dedup-probe top-1 plus the per-mode
+    link top-k over ONE score matrix — the probe and every link mode are
+    just different masks, so the arena streams from HBM once per ingest
+    batch (the pre-refactor kernel paid two full matmuls: probe, then the
+    post-add link scan; the exclusion mask makes the pre-add scan
+    equivalent — the batch's own rows are excluded as candidates either
+    way, and no other row's embedding changes between the two points).
+
+    ``qd`` is each fact's normalized arena-dtype embedding (exactly the
+    bytes the node scatter stores, so scores match a post-add gather of
+    the live rows bit for bit). ``probe_excl`` masks the sentinel scratch
+    row out of the probe — the classic host probe drops the id-less
+    sentinel at decode; in-kernel the mask does (a previous batch's
+    padding can leave the sentinel alive, and a dedup hit on it would
+    silently eat a fact). ``link_excl`` additionally masks the batch's
+    own rows out of the link candidates. Shard-local by construction:
+    single-chip callers pass the whole arena, the sharded program passes
+    each chip's local slice with localized exclusion masks — and, because
+    a chip's slice is n× narrower, an n×-wider ``chunk`` at the SAME
+    [chunk × rows] f32 tile budget (fewer, denser gemms; chunking never
+    changes any per-row output, so parity is unaffected). Returns the
+    flat tuple ``(p_s [B,1], p_r [B,1], s_mode, r_mode, ...)``."""
+    pmask = (state.alive & (state.tenant_id == tenant)
+             & ~state.is_super & ~probe_excl)
+    lmask = pmask & ~link_excl
+
+    def body(q_c, qs_c):
+        scores = nt_dot(q_c, state.emb)               # [C, rows] f32
+        outs = list(jax.lax.top_k(
+            jnp.where(pmask[None, :], scores, NEG_INF), 1))
+        same = None
+        for sm in shard_modes:
+            m = lmask[None, :]
+            if sm != 0:
+                if same is None:
+                    same = qs_c[:, None] == state.shard_id[None, :]
+                m = m & (same if sm == 1 else ~same)
+            outs.extend(jax.lax.top_k(jnp.where(m, scores, NEG_INF), k))
+        return tuple(outs)
+
+    return chunked_map_multi(body, (qd, q_shard), chunk=chunk)
+
+
+def _dedup_resolve(qf: jax.Array, rows: jax.Array, valid: jax.Array,
+                   chain_gid: jax.Array, p_s: jax.Array, p_r: jax.Array,
+                   dedup_gate: jax.Array, cap: int):
+    """Sequential duplicate resolution shared by the single-chip and the
+    sharded fused ingest (replicated compute on the pod — the inputs are
+    the replicated batch plus the MERGED probe top-1): intra-batch gram
+    picks the best match among EARLIER valid facts (sentinel padding rows
+    share one unit vector and must never match anything), the scan blends
+    it with the pre-add probe, chains targets (a dup-of-a-dup merges into
+    the surviving node), and tracks the chain predecessor (last LIVE fact
+    of the same shard group — a dup in the middle bridges its neighbors,
+    exactly like the host path that skips it). Returns ``(target [B] i32,
+    dup [B] bool, chain_src [B] i32)``."""
+    b = rows.shape[0]
+    gram = nt_dot(qf, qf)
+    tril = jnp.where(jnp.tri(b, k=-1, dtype=bool) & valid[None, :],
+                     gram, NEG_INF)
+    g_j = jnp.argmax(tril, axis=1)
+    g_s = tril[jnp.arange(b), g_j]
+
+    def step(carry, i):
+        target, dup, last = carry
+        use_g = g_s[i] > p_s[i]
+        best_s = jnp.where(use_g, g_s[i], p_s[i])
+        best_t = jnp.where(use_g, target[g_j[i]], p_r[i])
+        is_dup = valid[i] & (best_s > dedup_gate)
+        target = target.at[i].set(jnp.where(is_dup, best_t, rows[i]))
+        dup = dup.at[i].set(is_dup)
+        live_i = valid[i] & ~is_dup
+        gid = jnp.maximum(chain_gid[i], 0)
+        prev = jnp.where(chain_gid[i] >= 0, last[gid], -1)
+        src_i = jnp.where(live_i & (prev >= 0), prev, -1)
+        last = last.at[gid].set(jnp.where(live_i, rows[i], last[gid]))
+        return (target, dup, last), src_i
+
+    init = (jnp.full((b,), cap, jnp.int32), jnp.zeros((b,), bool),
+            jnp.full((b,), -1, jnp.int32))
+    (target, dup, _), chain_src = jax.lax.scan(step, init, jnp.arange(b))
+    return target, dup, chain_src
 
 
 def _ingest_dedup_fused(
@@ -818,47 +912,22 @@ def _ingest_dedup_fused(
     qf = normalize(emb)                    # f32 — intra gram parity w/ host
     qd = qf.astype(arena.emb.dtype)        # arena dtype — probe parity
 
-    # Pre-add probe: the same visibility the classic host probe has (its
-    # batch insert also lands after the probe).
-    pmask = arena.alive & (arena.tenant_id == tenant) & ~arena.is_super
+    # ONE whole-arena score matrix feeds BOTH the pre-add dedup probe and
+    # the per-mode link scans (_ingest_scan_core): the probe sees the same
+    # visibility the classic host probe has (its batch insert also lands
+    # after the probe), and the link candidates exclude the batch's own
+    # rows — so the pre-add scan is exactly the post-add-with-exclusion
+    # scan the unfused path runs, at HALF the HBM traffic.
+    probe_excl = jnp.arange(cap + 1) == cap
+    link_excl = (jnp.zeros((cap + 1,), bool).at[rows].set(True)
+                 | probe_excl)
+    flat = _ingest_scan_core(arena, qd, shard_id, probe_excl, link_excl,
+                             tenant, k, shard_modes)
+    p_s, p_r = flat[0][:, 0], flat[1][:, 0]
+    link_flat = flat[2:]
 
-    def probe_chunk(q_c):
-        s = nt_dot(q_c, arena.emb)
-        return jax.lax.top_k(jnp.where(pmask[None, :], s, NEG_INF), 1)
-
-    p_s, p_r = chunked_map(probe_chunk, qd)
-    p_s, p_r = p_s[:, 0], p_r[:, 0]
-
-    # Intra-batch gram: best match among EARLIER valid facts (sentinel
-    # padding rows share one unit vector and must never match anything).
-    gram = nt_dot(qf, qf)
-    tril = jnp.where(jnp.tri(b, k=-1, dtype=bool) & valid[None, :],
-                     gram, NEG_INF)
-    g_j = jnp.argmax(tril, axis=1)
-    g_s = tril[jnp.arange(b), g_j]
-
-    # Sequential resolve (one scan, O(B) scalar steps): dup flag + target
-    # row per fact — an intra hit chains through its target so a dup-of-a-
-    # dup merges into the surviving node — and the chain predecessor (last
-    # LIVE fact of the same shard group).
-    def step(carry, i):
-        target, dup, last = carry
-        use_g = g_s[i] > p_s[i]
-        best_s = jnp.where(use_g, g_s[i], p_s[i])
-        best_t = jnp.where(use_g, target[g_j[i]], p_r[i])
-        is_dup = valid[i] & (best_s > dedup_gate)
-        target = target.at[i].set(jnp.where(is_dup, best_t, rows[i]))
-        dup = dup.at[i].set(is_dup)
-        live_i = valid[i] & ~is_dup
-        gid = jnp.maximum(chain_gid[i], 0)
-        prev = jnp.where(chain_gid[i] >= 0, last[gid], -1)
-        src_i = jnp.where(live_i & (prev >= 0), prev, -1)
-        last = last.at[gid].set(jnp.where(live_i, rows[i], last[gid]))
-        return (target, dup, last), src_i
-
-    init = (jnp.full((b,), cap, jnp.int32), jnp.zeros((b,), bool),
-            jnp.full((b,), -1, jnp.int32))
-    (target, dup, _), chain_src = jax.lax.scan(step, init, jnp.arange(b))
+    target, dup, chain_src = _dedup_resolve(qf, rows, valid, chain_gid,
+                                            p_s, p_r, dedup_gate, cap)
 
     live_new = valid & ~dup
     add_rows = jnp.where(live_new, rows, cap)
@@ -867,8 +936,6 @@ def _ingest_dedup_fused(
     shadow = _shadow_scatter(shadow, add_rows, qd)
     touch_rows = jnp.where(dup, target, cap)
     arena = _arena_merge_touch(arena, touch_rows, salience, now)
-    link_flat = _arena_link_candidates_multi(arena, add_rows, rows, tenant,
-                                             k, shard_modes)
     chain_live = chain_src >= 0
     edges = _edges_add(edges, chain_slots, chain_src, rows,
                        jnp.broadcast_to(chain_w, (b,)),
@@ -886,6 +953,208 @@ def _ingest_dedup_fused(
 ingest_dedup_fused, ingest_dedup_fused_copy = _donated_pair(
     _ingest_dedup_fused, donate=(0, 1, 2),
     static_argnames=("k", "shard_modes"))
+
+
+# ---------------------------------------------------------------------------
+# Pod-scale fused INGEST (ISSUE 9): the whole ``ingest_dedup_fused`` program
+# — dedup probe, intra-batch gram resolve, node scatter, merge touch, both
+# link scans, gated edge insert with prefix-sum pool compaction, incremental
+# int8 shadow update — composed with the device mesh as ONE distributed
+# shard_map dispatch + ONE packed readback. The write-path mirror of
+# ``make_fused_sharded`` (PR 5):
+#
+# - Every arena column, the edge arena, and the int8 shadow are row-sharded
+#   over the mesh axis; the fact batch (rows, embeddings, metadata, edge
+#   slots, link pool) is replicated.
+# - Each chip runs the SAME shard-local scan core the single-chip kernel
+#   traces (``_ingest_scan_core`` — dedup-probe top-1 + per-mode link top-k
+#   over one local score matrix), and the ONLY cross-chip traffic is ONE
+#   all_gather merging probe + every link mode's candidates in a single
+#   grouped combine (``ops.topk.sharded_grouped_topk_merge``).
+# - The dedup resolve, gate verdicts, and prefix-sum pool compaction are
+#   then REPLICATED arithmetic on the merged lists (identical on every
+#   chip), and all writes land owner-chip-local: row/slot index vectors are
+#   localized per chip with non-owned entries routed one-past-the-end —
+#   XLA drops out-of-bounds scatter updates, the PR 5 boost-scatter trick —
+#   so the node scatter, merge touch, shadow update, chain edges, and the
+#   compacted link insert are all shard-local writes through the SAME
+#   mutation kernels (``_arena_add`` / ``_arena_merge_touch`` /
+#   ``_shadow_scatter`` / ``_edges_add`` / ``_gated_link_insert``) the
+#   single-chip program traces. Parity is structural.
+# - The packed readback (dup verdicts, per-mode candidate triples, overflow
+#   flag, accepted-link count, pool occupancy) is replicated output — the
+#   host fetches it once, exactly like the single-chip readback.
+# ---------------------------------------------------------------------------
+
+
+class IngestShardedKernels(NamedTuple):
+    """The jit entry points one ``make_ingest_fused_sharded`` call builds:
+    the donated distributed ingest program and its copy-on-write twin (for
+    callers that cannot prove sole ownership of the states — also the
+    surface the peak-HBM gauge AOT-lowers, since it has no donation).
+    Tests and bench wrap the caller's dispatch hook to count calls — each
+    call is exactly ONE distributed dispatch."""
+
+    ingest: Callable
+    ingest_copy: Callable
+
+
+def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
+                              shard_modes: Tuple[int, ...] = (1, 0),
+                              with_shadow: bool = False
+                              ) -> IngestShardedKernels:
+    """Build the distributed fused ingest program for ``mesh``.
+
+    Call signature (``with_shadow=False``)::
+
+        ingest(arena, edges, rows [B], emb [B,d], salience [B],
+               timestamp [B], type_id [B], shard_id [B], tenant_id [B],
+               is_super [B], chain_gid [B], chain_slots [B],
+               link_pool [P+1], pool_len, now, tenant, dedup_gate,
+               chain_w, link_gate, link_scale)
+            -> (arena, edges, outs)
+
+    with ``arena``/``edges`` row-sharded over ``axis`` and every batch
+    input replicated; ``outs`` is bit-compatible with the single-chip
+    ``ingest_dedup_fused`` readback tuple (3 wide dup/target/chain leaves,
+    3 per shard mode, 3 trailing counters — all [B, k], fetched with
+    ``utils.batching.fetch_packed`` in ONE transfer). ``rows``,
+    ``chain_slots``, and ``link_pool`` carry GLOBAL row / edge-slot ids;
+    the global sentinel row/slot is the LAST row/slot of the last shard,
+    so the single-chip sentinel-routing convention carries over unchanged.
+    ``with_shadow=True`` inserts ``(q8 [rows,d] i8, scale [rows] f32)``
+    row-sharded args after ``edges`` and returns them updated — the
+    incremental int8 shadow maintenance riding the same dispatch.
+
+    ``ingest`` donates the state arguments (zero-copy shard-local
+    scatters); ``ingest_copy`` is the non-donating twin."""
+    from jax.sharding import PartitionSpec as P
+
+    from lazzaro_tpu.ops.topk import sharded_grouped_topk_merge
+    from lazzaro_tpu.utils.compat import shard_map
+
+    shard_modes = tuple(shard_modes)
+    n_modes = len(shard_modes)
+    n_shards = mesh.shape[axis]
+
+    def _localize(idx, base, n_local):
+        """Global index vector → this chip's local indices; non-owned
+        entries route to ``n_local`` (one past the end — OOB scatter
+        updates are dropped, never wrapped)."""
+        loc = idx - base
+        return jnp.where((loc >= 0) & (loc < n_local), loc, n_local)
+
+    def _local(arena, edges, *rest):
+        if with_shadow:
+            shadow, rest = (rest[0], rest[1]), rest[2:]
+        else:
+            shadow = None
+        (rows, emb, salience, timestamp, type_id, shard_id_v, tenant_id_v,
+         is_super, chain_gid, chain_slots, link_pool, pool_len, now, tenant,
+         dedup_gate, chain_w, link_gate, link_scale) = rest
+        shard = jax.lax.axis_index(axis)
+        local_n = arena.emb.shape[0]
+        cap = n_shards * local_n - 1           # GLOBAL capacity / sentinel
+        local_e = edges.src.shape[0]
+        b = rows.shape[0]
+        k_l = max(1, min(k, local_n))
+        valid = rows < cap
+        qf = normalize(emb)
+        qd = qf.astype(arena.emb.dtype)
+
+        # Shard-local scan: the SAME core the single-chip kernel traces,
+        # over this chip's rows — exclusion masks localized (the global
+        # sentinel lives on the LAST shard only).
+        row_base = shard * local_n
+        rows_l = _localize(rows, row_base, local_n)
+        probe_excl = jnp.arange(local_n) == (cap - row_base)
+        link_excl = (jnp.zeros((local_n,), bool).at[rows_l].set(True)
+                     | probe_excl)
+        # each chip's slice is n× narrower than the whole arena, so the
+        # scan streams n×-wider query chunks at the SAME f32 tile budget
+        # the single-chip QUERY_CHUNK bounds — fewer, denser gemms
+        flat = _ingest_scan_core(arena, qd, shard_id_v, probe_excl,
+                                 link_excl, tenant, k_l, shard_modes,
+                                 chunk=min(QUERY_CHUNK * n_shards, 4096))
+        # ONE all_gather merges the probe AND every link mode's local
+        # candidates (grouped combine; candidate ids globalized first, so
+        # masked/garbage entries route to the global sentinel row).
+        cat_s = jnp.concatenate([flat[2 * g] for g in range(1 + n_modes)],
+                                axis=1)
+        cat_i = jnp.concatenate(
+            [_globalize_rows(flat[2 * g + 1], flat[2 * g], shard, local_n,
+                             n_shards) for g in range(1 + n_modes)], axis=1)
+        merged = sharded_grouped_topk_merge(
+            axis, cat_s, cat_i, widths=[1] + [k_l] * n_modes,
+            ks=[1] + [k] * n_modes)
+        merged = jax.lax.optimization_barrier(merged)
+        p_s, p_r = merged[0][0][:, 0], merged[0][1][:, 0]
+        link_flat = tuple(a for pair in merged[1:] for a in pair)
+
+        # Dedup resolve + gate logic are replicated arithmetic from here —
+        # every chip computes identical verdicts, then scatters ONLY the
+        # rows/slots it owns.
+        target, dup, chain_src = _dedup_resolve(qf, rows, valid, chain_gid,
+                                                p_s, p_r, dedup_gate, cap)
+        live_new = valid & ~dup
+        add_rows = jnp.where(live_new, rows, cap)
+        add_l = _localize(add_rows, row_base, local_n)
+        arena = _arena_add(arena, add_l, emb, salience, timestamp, type_id,
+                           shard_id_v, tenant_id_v, is_super)
+        shadow = _shadow_scatter(shadow, add_l, qd)
+        touch_l = _localize(jnp.where(dup, target, cap), row_base, local_n)
+        arena = _arena_merge_touch(arena, touch_l, salience, now)
+
+        slot_base = shard * local_e
+        chain_live = chain_src >= 0
+        chain_l = _localize(chain_slots, slot_base, local_e)
+        edges = _edges_add(edges, chain_l, chain_src, rows,
+                           jnp.broadcast_to(chain_w, (b,)),
+                           jnp.ones((b,), jnp.int32), now, tenant,
+                           chain_live)
+        # The compacting gated insert runs UNCHANGED — it only ever touches
+        # slots through the pool array, so handing it a pool whose entries
+        # are pre-localized (non-owned → OOB) makes every accepted edge an
+        # owner-chip-local write while positions/readback stay global.
+        pool_l = _localize(link_pool, slot_base, local_e)
+        edges, outs = _gated_link_insert(edges, link_flat, pool_l, pool_len,
+                                         rows, live_new, now, tenant,
+                                         link_gate, link_scale, shard_modes)
+        wide = tuple(jnp.broadcast_to(a[:, None], (b, k))
+                     for a in (dup.astype(jnp.int32), target, chain_src))
+        if with_shadow:
+            return arena, edges, shadow[0], shadow[1], wide + outs
+        return arena, edges, wide + outs
+
+    arena_specs = ArenaState(
+        emb=P(axis, None), salience=P(axis), timestamp=P(axis),
+        last_accessed=P(axis), access_count=P(axis), type_id=P(axis),
+        shard_id=P(axis), tenant_id=P(axis), alive=P(axis),
+        is_super=P(axis))
+    edge_specs = EdgeState(
+        src=P(axis), tgt=P(axis), weight=P(axis), co=P(axis),
+        last_updated=P(axis), alive=P(axis), tenant_id=P(axis))
+    shadow_specs = (P(axis, None), P(axis)) if with_shadow else ()
+    batch_specs = (
+        P(None),        # rows
+        P(None, None),  # emb
+        P(None), P(None), P(None), P(None), P(None), P(None),  # per-fact
+        P(None),        # chain_gid
+        P(None),        # chain_slots
+        P(None),        # link_pool
+        P(), P(), P(), P(), P(), P(), P(),  # pool_len..link_scale scalars
+    )
+    n_out = 3 + 3 * n_modes + 3
+    out_state = (arena_specs, edge_specs) + shadow_specs
+    mapped = shard_map(
+        _local, mesh=mesh,
+        in_specs=(arena_specs, edge_specs) + shadow_specs + batch_specs,
+        out_specs=out_state + (tuple(P(None, None) for _ in range(n_out)),),
+        check_vma=False)
+    donate = tuple(range(2 + len(shadow_specs)))
+    return IngestShardedKernels(
+        ingest=jax.jit(mapped, donate_argnums=donate),
+        ingest_copy=jax.jit(mapped))
 
 
 # ---------------------------------------------------------------------------

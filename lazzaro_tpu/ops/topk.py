@@ -73,6 +73,37 @@ def sharded_topk_merge(axis: str, top_s: jax.Array, top_i: jax.Array,
     return fin_s, fin_i
 
 
+def sharded_grouped_topk_merge(axis: str, top_s: jax.Array,
+                               top_i: jax.Array, widths, ks):
+    """SEVERAL per-shard candidate groups merged with ONE all_gather pair
+    (ISSUE 9: the fused sharded ingest needs the dedup-probe top-1 AND
+    both link modes' top-k merged in the same dispatch — three
+    ``sharded_topk_merge`` calls would pay three collectives each way).
+    ``top_s``/``top_i`` are the groups' per-shard candidate lists
+    concatenated along the k axis (``[Q, sum(widths)]``); ``widths`` gives
+    each group's per-shard width and ``ks`` its merged output k. Must be
+    called INSIDE shard_map with ``axis`` bound; ids must already be
+    globalized. Returns one ``(scores [Q, k_g], ids [Q, k_g])`` pair per
+    group.
+
+    Tie order matches :func:`sharded_topk_merge`: each group's candidates
+    concatenate shard-major ([Q, n, w] → [Q, n·w]), so equal scores
+    resolve in global-row order — the same order a single-chip top-k over
+    the whole arena produces."""
+    all_s = jnp.moveaxis(jax.lax.all_gather(top_s, axis), 0, 1)  # [Q, n, W]
+    all_i = jnp.moveaxis(jax.lax.all_gather(top_i, axis), 0, 1)
+    q = top_s.shape[0]
+    outs = []
+    off = 0
+    for w, k_g in zip(widths, ks):
+        s = all_s[:, :, off:off + w].reshape(q, -1)
+        i = all_i[:, :, off:off + w].reshape(q, -1)
+        fin_s, pos = jax.lax.top_k(s, min(k_g, s.shape[1]))
+        outs.append((fin_s, jnp.take_along_axis(i, pos, axis=1)))
+        off += w
+    return outs
+
+
 def make_sharded_topk(mesh: Mesh, axis: str = "data", k: int = 10,
                       impl: str = "auto"):
     """Build a pjit-compiled distributed top-k over ``mesh``.

@@ -47,13 +47,15 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from lazzaro_tpu.core import state as S
-from lazzaro_tpu.core.index import build_host_csr, split_csr
+from lazzaro_tpu.core.index import (build_host_csr, link_pool_dev,
+                                    link_pool_size, split_csr)
 from lazzaro_tpu.ops.topk import make_sharded_topk
 from lazzaro_tpu.parallel.mesh import shard_stacked
 from lazzaro_tpu.utils.batching import (LRUKernelCache, bucket_size,
                                         decode_topk, empty_results,
-                                        next_pow2, pad_to_bucket,
-                                        pad_to_pow2, unpack_retrieval)
+                                        fetch_packed, next_pow2,
+                                        pad_to_bucket, pad_to_pow2,
+                                        unpack_retrieval)
 from lazzaro_tpu.utils.compat import trace_annotation
 from lazzaro_tpu.utils.telemetry import (default_registry, peak_bytes,
                                          record_device_counters)
@@ -87,7 +89,9 @@ class ShardedMemoryIndex:
                  epoch: Optional[float] = None, telemetry=None,
                  telemetry_hbm: bool = False, serve_ragged: bool = True,
                  serve_k_max: int = 128, serve_pad_granularity: int = 8,
-                 serve_kernel_cache_max: int = 8):
+                 serve_kernel_cache_max: int = 8,
+                 edge_capacity: int = 1 << 17,
+                 ingest_fused: bool = True):
         self.mesh = mesh
         # Serving telemetry (ISSUE 6): same registry contract as
         # MemoryIndex — spans per dispatch, device counters decoded from
@@ -148,6 +152,26 @@ class ShardedMemoryIndex:
         # maintained incrementally by add()'s scatter once built)
         self._int8_shadow = None
         self._int8_dirty = True
+
+        # Pod-scale fused ingest (ISSUE 9): a row-sharded edge arena is
+        # the write target of the distributed ingest program — the fused
+        # kernel's gated link insert compacts accepted edges into it
+        # owner-chip-local — while the host edge map (``self.edges``)
+        # mirrors every accepted edge from the packed readback, so the
+        # serving CSR build and checkpoints are unchanged. Slots are
+        # GLOBAL ids; the last slot of the last shard is the sentinel.
+        self.ingest_fused = bool(ingest_fused)
+        total_e = edge_capacity + 1
+        total_e = -(-total_e // self.n_parts) * self.n_parts
+        self.edge_capacity = total_e - 1
+        self._edge_state = self._reshard(S.init_edges(self.edge_capacity))
+        self._free_edge_slots: List[int] = list(
+            range(self.edge_capacity - 1, -1, -1))
+        self.edge_slots: Dict[Tuple[str, str], int] = {}
+        self._ingest_cache = LRUKernelCache(serve_kernel_cache_max)
+        self._ingest_classic_cache = LRUKernelCache(serve_kernel_cache_max)
+        self.link_pool_overflows = 0
+        self.ingest_dispatch_count = 0
 
         # IVF serve tables (publish via ivf_build): centroids replicated,
         # member/extras tables split per shard with LOCAL row indices
@@ -260,6 +284,528 @@ class ShardedMemoryIndex:
         self.dispatch_count += 1
         self.telemetry.bump("serve.dispatches", labels={"mode": "pod"})
         return fn(*args, **kwargs)
+
+    # The write-path twin: every device program the ingest path runs —
+    # the ONE distributed fused dispatch, or each step of the host-driven
+    # classic sequence — goes through here, so bench and the jit-counter
+    # tests measure ``dispatches_per_conversation`` by wrapping one hook.
+    def _ingest_dispatch(self, fn, *args, **kwargs):
+        self.dispatch_count += 1
+        self.ingest_dispatch_count += 1
+        return fn(*args, **kwargs)
+
+    # ------------------------------------------------------- edge arena
+    @property
+    def edge_state(self) -> S.EdgeState:
+        with self._state_lock:
+            return self._edge_state
+
+    @edge_state.setter
+    def edge_state(self, s: S.EdgeState) -> None:
+        self._edge_state = self._reshard(s)
+
+    def _alloc_edge_slots(self, n: int) -> List[int]:
+        if len(self._free_edge_slots) < n:
+            raise RuntimeError("ShardedMemoryIndex edge capacity exhausted")
+        return [self._free_edge_slots.pop() for _ in range(n)]
+
+    def _apply_edges(self, donated, copying, *args, **kwargs) -> None:
+        """Edge-arena twin of ``_apply_arena`` (same donation gate)."""
+        with self._state_lock:
+            cur = self._edge_state
+            fn = donated if sys.getrefcount(cur) <= self._SOLE_REFS else copying
+            out = self._ingest_dispatch(fn, cur, *args, **kwargs)
+            del cur
+            self.edge_state = out
+
+    def _edges_insert_device(self, triples, tenant_id_val: int,
+                             now_rel: float) -> List[Tuple[str, str]]:
+        """Insert NEW edges into the device edge arena + host maps (the
+        classic write path's edge step, and the fused path's overflow
+        retry). Keys already registered are skipped."""
+        fresh = [(s, t, w) for s, t, w in triples
+                 if (s, t) not in self.edge_slots
+                 and s in self.id_to_row and t in self.id_to_row]
+        if not fresh:
+            return []
+        slots = self._alloc_edge_slots(len(fresh))
+        ecap = self.edge_capacity
+        padded = S.pad_rows(np.asarray(slots, np.int32), ecap)
+        b = len(padded)
+        src_r = np.full((b,), -1, np.int32)
+        tgt_r = np.full((b,), -1, np.int32)
+        w_arr = np.zeros((b,), np.float32)
+        live = np.zeros((b,), bool)
+        made = []
+        for i, ((s, t, w), slot) in enumerate(zip(fresh, slots)):
+            src_r[i] = self.id_to_row[s]
+            tgt_r[i] = self.id_to_row[t]
+            w_arr[i] = w
+            live[i] = True
+            self.edge_slots[(s, t)] = slot
+            self.edges[(s, t)] = float(w)
+            made.append((s, t))
+        self._apply_edges(
+            S.edges_add, S.edges_add_copy, jnp.asarray(padded),
+            jnp.asarray(src_r), jnp.asarray(tgt_r), jnp.asarray(w_arr),
+            jnp.ones((b,), jnp.int32), jnp.float32(now_rel),
+            jnp.int32(tenant_id_val), jnp.asarray(live))
+        self._csr_dirty = True
+        return made
+
+    # --------------------------------------------------- fused pod ingest
+    def _ingest_kernels(self, k: int, shard_modes: Tuple[int, ...],
+                        with_shadow: bool) -> S.IngestShardedKernels:
+        key = (k, shard_modes, with_shadow)
+        kern = self._ingest_cache.get(key)
+        if kern is None:
+            kern = S.make_ingest_fused_sharded(
+                self.mesh, self.axis, k=k, shard_modes=shard_modes,
+                with_shadow=with_shadow)
+            self._ingest_cache.put(key, kern)
+            self.telemetry.gauge("kernel.cache_entries",
+                                 len(self._ingest_cache),
+                                 labels={"surface": "pod_ingest"})
+        return kern
+
+    def ingest(self, ids: Sequence[str], embeddings: np.ndarray,
+               tenant: str, saliences: Optional[Sequence[float]] = None, *,
+               dedup_gate: float = 0.95, chain: bool = False,
+               chain_weight: float = 0.5, link_k: int = 3,
+               link_gate: float = 0.5, link_scale: float = 0.8,
+               shard_modes: Sequence[int] = (0,),
+               link_accept_hint: float = 1.0,
+               now: Optional[float] = None) -> Dict:
+        """The pod WRITE path as ONE distributed dispatch (ISSUE 9): dedup
+        probe (shard-local top-1 → all_gather merge), intra-batch resolve,
+        owner-chip node scatter, merge touch, link scans, gated edge
+        insert with prefix-sum pool compaction, and the incremental int8
+        shadow update — the full ``ingest_dedup_fused`` program composed
+        with the mesh (``state.make_ingest_fused_sharded``), replacing the
+        host-driven multi-op sequence (probe dispatch + resolve + add
+        scatter + shadow scatter + link-scan dispatch + edge insert) the
+        pre-ISSUE-9 pod write path needed for the same semantics.
+        ``ingest_fused=False`` keeps that classic sequence for A/B and
+        fallback — same verdicts, many dispatches.
+
+        ``ids`` must be fresh (the consolidation contract — the dedup
+        verdict decides merge-vs-insert, so re-adding an existing id goes
+        through :meth:`add`). Returns ``{"rows", "created", "merged",
+        "links", "chains", "counters"}`` with ``merged`` mapping each
+        duplicate fact's id to the id it merged into and ``links`` the
+        gate-passing similarity edges the device inserted."""
+        n = len(ids)
+        out_empty = {"rows": [], "created": [], "merged": {}, "links": [],
+                     "chains": [], "counters": {}}
+        if n == 0:
+            return out_empty
+        for node_id in ids:
+            if node_id in self.id_to_row:
+                raise ValueError(f"ingest() requires fresh ids: {node_id!r}")
+        if saliences is None:
+            saliences = [0.5] * n
+        shard_modes = tuple(shard_modes)
+        emb_np = np.asarray(embeddings, np.float32).reshape(n, self.dim)
+        now_abs = now if now is not None else time.time()
+        if not self.ingest_fused:
+            return self._ingest_classic(
+                ids, emb_np, tenant, saliences, dedup_gate=dedup_gate,
+                chain=chain, chain_weight=chain_weight, link_k=link_k,
+                link_gate=link_gate, link_scale=link_scale,
+                shard_modes=shard_modes, now=now_abs)
+        tid = self.tenant_id(tenant)
+        rows = self._alloc(tenant, n)
+        k_eff = max(1, min(int(link_k), self.capacity))
+        n_modes = len(shard_modes)
+        pool_need = link_pool_size(n_modes * n * k_eff, link_accept_hint)
+        n_chain = n if chain else 0
+        slots = self._alloc_edge_slots(n_chain + pool_need)
+        chain_slot_list = slots[:n_chain]
+        link_pool_list = slots[n_chain:]
+        ecap = self.edge_capacity
+        padded = S.pad_rows(np.asarray(rows, np.int32), self.capacity)
+        b = len(padded)
+
+        def pad(vals, fill=0.0, dt=np.float32):
+            out = np.full((b,), fill, dt)
+            out[:n] = vals
+            return out
+
+        emb_p = np.zeros((b, self.dim), np.float32)
+        emb_p[:n] = emb_np
+        emb_p[n:, 0] = 1.0      # sentinel rows: unit vector (normalizable)
+        gids = pad(([0] * n) if chain else ([-1] * n), -1, np.int32)
+        chain_slots = np.full((b,), ecap, np.int32)
+        chain_slots[:n_chain] = chain_slot_list
+        pool_dev = link_pool_dev(link_pool_list, n_modes * b * k_eff, ecap)
+        now_rel = now_abs - self.epoch
+        with self._state_lock:
+            with_shadow = (
+                self.int8_serving and not self._int8_dirty
+                and self._int8_shadow is not None
+                and self._int8_shadow[0].shape[0] == self.capacity + 1)
+        kern = self._ingest_kernels(k_eff, shard_modes, with_shadow)
+        dev_args = (
+            jnp.asarray(padded), jnp.asarray(emb_p),
+            jnp.asarray(pad(np.asarray(saliences, np.float32))),
+            jnp.full((b,), now_rel, jnp.float32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.asarray(pad([0] * n, -1, np.int32)),
+            jnp.asarray(pad([tid] * n, -1, np.int32)),
+            jnp.asarray(pad([False] * n, False, bool)),
+            jnp.asarray(gids), jnp.asarray(chain_slots), pool_dev,
+            jnp.int32(len(link_pool_list)), jnp.float32(now_rel),
+            jnp.int32(tid), jnp.float32(dedup_gate),
+            jnp.float32(chain_weight), jnp.float32(link_gate),
+            jnp.float32(link_scale))
+        self._maybe_record_ingest_hbm(kern, dev_args, with_shadow, b)
+        tel = self.telemetry
+        t0 = time.perf_counter()
+        with trace_annotation("lz.ingest.pod_fused"):
+            with self._state_lock:
+                arena, edges = self._arena, self._edge_state
+                shadow = self._int8_shadow if with_shadow else None
+                sole = (sys.getrefcount(arena) <= self._SOLE_REFS
+                        and sys.getrefcount(edges) <= self._SOLE_REFS
+                        and (shadow is None
+                             or (sys.getrefcount(shadow[0]) <= 2
+                                 and sys.getrefcount(shadow[1]) <= 2)))
+                fn = kern.ingest if sole else kern.ingest_copy
+                if shadow is not None:
+                    new_arena, new_edges, q8n, sn, flat = \
+                        self._ingest_dispatch(fn, arena, edges, shadow[0],
+                                              shadow[1], *dev_args)
+                    self._int8_shadow = (q8n, sn)
+                else:
+                    new_arena, new_edges, flat = self._ingest_dispatch(
+                        fn, arena, edges, *dev_args)
+                del arena, edges, shadow
+                self._arena = new_arena
+                self._edge_state = new_edges
+            host = fetch_packed(*flat)          # the ONE readback
+        tel.record("ingest.dispatch_ms", (time.perf_counter() - t0) * 1e3,
+                   labels={"kind": "pod_fused"})
+        tel.bump("ingest.dispatches", labels={"kind": "pod_fused"})
+        return self._ingest_finish_host(
+            ids, rows, host, chain_slot_list, link_pool_list,
+            shard_modes=shard_modes, k_eff=k_eff, tid=tid,
+            chain_weight=chain_weight, link_scale=link_scale,
+            now_abs=now_abs, shadow_fresh=with_shadow)
+
+    def _ingest_finish_host(self, ids, rows, host, chain_slot_list,
+                            link_pool_list, *, shard_modes, k_eff, tid,
+                            chain_weight, link_scale, now_abs,
+                            shadow_fresh) -> Dict:
+        """Host bookkeeping after the ONE fused readback: register
+        surviving ids, free duplicate rows, mirror accepted edges into the
+        host map, reclaim the untouched pool suffix, retry overflowed
+        links (one extra dispatch for that rare batch only)."""
+        n = len(ids)
+        n_modes = len(shard_modes)
+        tel = self.telemetry
+        dup = host[0][:n, 0] > 0
+        target = host[1][:n, 0]
+        chain_src = host[2][:n, 0]
+        ctr = host[3 + 3 * n_modes:]
+        tel.bump("ingest.dedup_hits", int(dup.sum()))
+        tel.bump("ingest.links_accepted", int(ctr[1][0, 0]))
+        tel.bump("ingest.pool_slots_used", int(ctr[2][0, 0]))
+        live_rows: List[int] = []
+        merged: Dict[str, Optional[str]] = {}
+        for i in range(n):
+            r = rows[i]
+            if dup[i]:
+                self._free[r // self.part_rows].append(r)
+                merged[ids[i]] = self.row_to_id.get(int(target[i]))
+            else:
+                self.id_to_row[ids[i]] = r
+                self.row_to_id[r] = ids[i]
+                live_rows.append(r)
+        reclaim: List[int] = []
+        chains: List[Tuple[str, str]] = []
+        for i, slot in enumerate(chain_slot_list):
+            src_id = (self.row_to_id.get(int(chain_src[i]))
+                      if chain_src[i] >= 0 else None)
+            key = (src_id, ids[i]) if src_id and not dup[i] else None
+            if key is not None and key not in self.edge_slots:
+                self.edge_slots[key] = slot
+                self.edges[key] = float(chain_weight)
+                chains.append(key)
+            else:
+                reclaim.append(slot)
+        links: List[Tuple[str, str, float]] = []
+        overflowed: List[Tuple[str, str, float]] = []
+        pool_real = len(link_pool_list)
+        consumed = 0
+        for mi in range(n_modes):
+            sc = host[3 + 3 * mi]
+            cd = host[3 + 3 * mi + 1]
+            ps = host[3 + 3 * mi + 2]
+            for bi in range(n):
+                if dup[bi]:
+                    continue
+                nid = ids[bi]
+                for j in range(k_eff):
+                    p = int(ps[bi, j])
+                    if p < 0:
+                        continue            # rejected: no slot consumed
+                    s = float(sc[bi, j])
+                    cid = (self.row_to_id.get(int(cd[bi, j]))
+                           if s > NEG_INF / 2 else None)
+                    w = min(1.0, max(0.0, s * link_scale))
+                    if p >= pool_real:
+                        if cid is not None \
+                                and (nid, cid) not in self.edge_slots:
+                            overflowed.append((nid, cid, w))
+                            links.append((nid, cid, w))
+                        continue
+                    consumed = max(consumed, p + 1)
+                    key = (nid, cid)
+                    if cid is not None and key not in self.edge_slots:
+                        self.edge_slots[key] = link_pool_list[p]
+                        self.edges[key] = w
+                        links.append((nid, cid, w))
+                    else:
+                        reclaim.append(link_pool_list[p])
+        # dup facts' accepted positions never exist (valid_q gates them),
+        # but their pool PREFIX positions may still have been consumed by
+        # earlier live facts — the suffix comes back whole either way
+        self._free_edge_slots.extend(link_pool_list[consumed:])
+        self._free_edge_slots.extend(reclaim)
+        self._csr_dirty = True
+        if not shadow_fresh:
+            self._int8_dirty = True
+        self._emb_gen += 1
+        if self._ivf is not None and live_rows:
+            routed = self._ivf_routed
+            for r in live_rows:
+                if not routed[r] and r not in self._ivf_fresh:
+                    self._ivf_fresh.append(r)
+            self._ivf_tabs_cache = None
+        if self.tiering is not None and live_rows:
+            self.tiering.on_rows_written(live_rows)
+        if overflowed:
+            self.link_pool_overflows += 1
+            tel.bump("ingest.link_pool_overflows")
+            self._edges_insert_device(overflowed, tid, now_abs - self.epoch)
+        return {
+            "rows": rows,
+            "created": [i for i, d in zip(ids, dup) if not d],
+            "merged": merged, "links": links, "chains": chains,
+            "counters": {"dedup_hits": int(dup.sum()),
+                         "links_accepted": int(ctr[1][0, 0]),
+                         "pool_slots_used": int(ctr[2][0, 0]),
+                         "overflow": bool(ctr[0][0, 0])},
+        }
+
+    def _ingest_classic(self, ids, emb_np, tenant, saliences, *, dedup_gate,
+                        chain, chain_weight, link_k, link_gate, link_scale,
+                        shard_modes, now) -> Dict:
+        """The host-driven pod write sequence with the SAME semantics as
+        the fused program (the A/B baseline and ``ingest_fused=False``
+        fallback): probe dispatch → host dedup resolve → arena add (+
+        shadow scatter) → merge touch → one link-scan dispatch per shard
+        mode → host gate → edge-insert dispatch. Each device step routes
+        through ``_ingest_dispatch``, so the dispatch-count gap vs the
+        fused path is measured, not asserted."""
+        tid = self.tenant_id(tenant)
+        n = len(ids)
+        k_eff = max(1, min(int(link_k), self.capacity))
+        norms = np.maximum(np.linalg.norm(emb_np, axis=1, keepdims=True),
+                           1e-9)
+        qn = (emb_np / norms).astype(np.float32)
+        st = self.state
+        # probe: masked top-1 over the pre-add arena (one dispatch; the
+        # mask arithmetic itself is extra eager device work — part of why
+        # the host-driven path loses)
+        probe_kern = self._ingest_classic_cache.get(("probe", 1))
+        if probe_kern is None:
+            probe_kern = make_sharded_topk(self.mesh, self.axis, k=1)
+            self._ingest_classic_cache.put(("probe", 1), probe_kern)
+        mask = st.alive & (st.tenant_id == tid) & ~st.is_super
+        p_s, p_r = self._ingest_dispatch(probe_kern, st.emb, mask,
+                                         jnp.asarray(qn))
+        p_s, p_r = fetch_packed(p_s, p_r)
+        p_s, p_r = p_s[:, 0], p_r[:, 0]
+        # drop id-less probe hits (the sentinel/stale rows the classic
+        # decode path filters) and resolve duplicates on host
+        p_ok = np.asarray([self.row_to_id.get(int(r)) is not None
+                           for r in p_r])
+        p_s = np.where(p_ok, p_s, NEG_INF)
+        gram = qn @ qn.T
+        dup = np.zeros((n,), bool)
+        # a dup's target is either an existing arena ROW (probe hit) or an
+        # earlier FACT of this batch (intra hit, chained through that
+        # fact's own resolution — rows for live facts exist only after
+        # the add below)
+        t_row = np.full((n,), -1, np.int64)
+        t_fact = np.full((n,), -1, np.int64)
+        chain_src_id: List[Optional[str]] = [None] * n
+        last_live: Optional[str] = None
+        for i in range(n):
+            best_s, tr_i, tf_i = float(p_s[i]), int(p_r[i]), -1
+            if i > 0:
+                j = int(np.argmax(gram[i, :i]))
+                if float(gram[i, j]) > best_s:
+                    best_s = float(gram[i, j])
+                    if dup[j]:              # dup-of-a-dup: same survivor
+                        tr_i, tf_i = int(t_row[j]), int(t_fact[j])
+                    else:
+                        tr_i, tf_i = -1, j
+            if best_s > dedup_gate:
+                dup[i] = True
+                t_row[i], t_fact[i] = tr_i, tf_i
+                continue
+            if chain and last_live is not None:
+                chain_src_id[i] = last_live
+            if chain:
+                last_live = ids[i]
+        live_idx = [i for i in range(n) if not dup[i]]
+        live_ids = [ids[i] for i in live_idx]
+        rows_all = np.full((n,), -1, np.int64)
+        if live_ids:
+            got = self.add(live_ids, emb_np[live_idx], tenant,
+                           saliences=[saliences[i] for i in live_idx])
+            for i, r in zip(live_idx, got):
+                rows_all[i] = r
+        merged: Dict[str, Optional[str]] = {}
+        t_rows, t_sals = [], []
+        for i in range(n):
+            if dup[i]:
+                tgt_id = (ids[int(t_fact[i])] if t_fact[i] >= 0
+                          else self.row_to_id.get(int(t_row[i])))
+                merged[ids[i]] = tgt_id
+                r = self.id_to_row.get(tgt_id) if tgt_id else None
+                if r is not None:
+                    t_rows.append(int(r))
+                    t_sals.append(float(saliences[i]))
+        now_rel = now - self.epoch
+        if t_rows:
+            padded = S.pad_rows(np.asarray(t_rows, np.int32), self.capacity)
+            sal = np.zeros((len(padded),), np.float32)
+            sal[:len(t_sals)] = t_sals
+            with self._state_lock:
+                cur = self._arena
+                fn = (S.arena_merge_touch
+                      if sys.getrefcount(cur) <= self._SOLE_REFS
+                      else S.arena_merge_touch_copy)
+                out = self._ingest_dispatch(fn, cur, jnp.asarray(padded),
+                                            jnp.asarray(sal),
+                                            jnp.float32(now_rel))
+                del cur
+                self.state = out
+        links: List[Tuple[str, str, float]] = []
+        chains: List[Tuple[str, str]] = []
+        if live_ids:
+            # link scans: one distributed top-k per shard mode over the
+            # post-add arena, new rows excluded as candidates
+            st = self.state
+            excl = jnp.zeros((self.capacity + 1,), bool).at[
+                jnp.asarray(rows_all[live_idx].astype(np.int32))].set(True)
+            base = (st.alive & (st.tenant_id == tid) & ~st.is_super
+                    & ~excl)
+            link_kern = self._ingest_classic_cache.get(("link", k_eff))
+            if link_kern is None:
+                link_kern = make_sharded_topk(self.mesh, self.axis,
+                                              k=k_eff)
+                self._ingest_classic_cache.put(("link", k_eff), link_kern)
+            q_live = jnp.asarray(qn[live_idx])
+            seen: set = set()
+            for sm in shard_modes:
+                # the pod surface writes one shard group (add() stamps
+                # shard_id 0), so every mode shares the base mask
+                l_s, l_r = self._ingest_dispatch(link_kern, st.emb, base,
+                                                 q_live)
+                l_s, l_r = fetch_packed(l_s, l_r)
+                for li, bi in enumerate(live_idx):
+                    nid = ids[bi]
+                    for s, r in zip(l_s[li], l_r[li]):
+                        cid = (self.row_to_id.get(int(r))
+                               if s > NEG_INF / 2 else None)
+                        if cid is None or float(s) <= link_gate:
+                            continue
+                        if (nid, cid) in seen:
+                            continue
+                        seen.add((nid, cid))
+                        links.append((nid, cid,
+                                      min(1.0, max(0.0,
+                                                   float(s) * link_scale))))
+            if chain:
+                chains = [(chain_src_id[i], ids[i]) for i in live_idx
+                          if chain_src_id[i] is not None]
+            triples = ([(s, t, chain_weight) for s, t in chains]
+                       + links)
+            if triples:
+                self._edges_insert_device(triples, tid, now_rel)
+        return {
+            "rows": [int(r) for r in rows_all],
+            "created": live_ids, "merged": merged, "links": links,
+            "chains": chains,
+            "counters": {"dedup_hits": int(dup.sum()),
+                         "links_accepted": len(links),
+                         "pool_slots_used": 0, "overflow": False},
+        }
+
+    def _maybe_record_ingest_hbm(self, kern, dev_args, with_shadow: bool,
+                                 b: int) -> None:
+        """Opt-in peak-HBM gauge for one pod ingest-kernel geometry
+        (AOT lower + ``memory_analysis()`` of the non-donating twin; one
+        extra compile, zero extra dispatches) — feeds the
+        ``scripts/check_hbm_budget.py`` write-path gate."""
+        if not self.telemetry_hbm or not self.telemetry.enabled:
+            return    # never consume the once-key while warmup mutes the registry
+        key = ("ingest", b, with_shadow)
+        if key in self._hbm_recorded:
+            return
+        self._hbm_recorded.add(key)
+        try:
+            with self._state_lock:
+                sh = self._int8_shadow if with_shadow else None
+                args = ((self._arena, self._edge_state)
+                        + ((sh[0], sh[1]) if sh is not None else ())
+                        + dev_args)
+            peak = peak_bytes(
+                kern.ingest_copy.lower(*args).compile().memory_analysis())
+        except Exception:   # noqa: BLE001 — never fail the write path
+            return
+        if peak is not None:
+            self.telemetry.gauge(
+                "kernel.peak_hbm_bytes", peak,
+                labels={"path": "ingest", "batch": str(b),
+                        "rows": str(self.capacity + 1),
+                        "mesh": f"{self.n_parts}x{self.axis}"})
+
+    def warmup_ingest(self, geometries=(256,), *, dedup_gate: float = 0.95,
+                      link_k: int = 3) -> Dict[int, float]:
+        """Pod twin of ``MemoryIndex.warmup_ingest`` (ISSUE 9 satellite):
+        pre-compile the distributed fused ingest program for the given
+        fact-batch geometries by driving :meth:`ingest` with a throwaway
+        tenant and deleting the rows afterwards — the live corpus is
+        unchanged, the jit cache entries live traffic hits are warm. Wall
+        time lands in ``kernel.warmup_ms{path="ingest",batch}``."""
+        out: Dict[int, float] = {}
+        tel = self.telemetry
+        rng = np.random.default_rng(0)
+        buckets = sorted({len(S.pad_rows(np.zeros((g,), np.int32),
+                                         self.capacity))
+                          for g in geometries if g > 0})
+        for g in buckets:
+            t0 = time.perf_counter()
+            prev = tel.enabled
+            tel.enabled = False
+            try:
+                ids = [f"~warm:{g}:{i}" for i in range(g)]
+                got = self.ingest(
+                    ids, rng.standard_normal((g, self.dim)), "~warmup",
+                    dedup_gate=float(dedup_gate), link_k=link_k)
+                self.delete(got["created"])
+            finally:
+                tel.enabled = prev
+            ms = (time.perf_counter() - t0) * 1e3
+            tel.record("kernel.warmup_ms", ms,
+                       labels={"path": "ingest", "batch": str(g)})
+            out[g] = ms
+        return out
 
     # ------------------------------------------------------- tiered memory
     def attach_tiering(self, hot_budget_rows: int, **kw):
@@ -375,6 +921,9 @@ class ShardedMemoryIndex:
                       if key[0] in gone or key[1] in gone]
         for key in dead_edges:
             del self.edges[key]
+            slot = self.edge_slots.pop(key, None)
+            if slot is not None:      # reclaim the device edge-arena slot
+                self._free_edge_slots.append(slot)
         if dead_edges:
             self._csr_dirty = True
         for r in rows:
@@ -748,8 +1297,8 @@ class ShardedMemoryIndex:
         """Opt-in peak-HBM gauge for one pod serving geometry (AOT lower +
         ``memory_analysis()`` of the read twin; one extra compile, zero
         extra dispatches)."""
-        if not self.telemetry_hbm:
-            return
+        if not self.telemetry_hbm or not self.telemetry.enabled:
+            return    # never consume the once-key while warmup mutes the registry
         key = (mode, k_bucket, ragged)
         if key in self._hbm_recorded:
             return

@@ -252,6 +252,12 @@ class FlushPolicy:
             return 0.0
         return max(0.0, oldest + self.max_wait_s - now)
 
+    @property
+    def oldest(self) -> Optional[float]:
+        """First-add time of the current buffer (None when empty) — the
+        coalesce-wait telemetry reads it at drain time."""
+        return self._oldest
+
     def reset(self) -> None:
         self._oldest = None
 
@@ -299,6 +305,17 @@ class IngestCoalescer:
         import time as _time
         return self.policy.should_flush(
             len(self), now if now is not None else _time.time())
+
+    def oldest_age_s(self, now: Optional[float] = None) -> float:
+        """Age of the oldest buffered conversation (0.0 when empty) — the
+        per-mega-batch coalesce-wait the ingest telemetry records at drain
+        time (ISSUE 9 satellite: the write-path twin of the serving
+        queue-wait span)."""
+        import time as _time
+        oldest = self.policy.oldest
+        if oldest is None:
+            return 0.0
+        return max(0.0, (now if now is not None else _time.time()) - oldest)
 
     def __len__(self) -> int:
         return sum(len(c) for c in self._convs)

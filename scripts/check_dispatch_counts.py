@@ -45,7 +45,18 @@ the paths passed as arguments) and exits nonzero if:
     snuck back in; ragged kernels are keyed per (mode × geometry)
     only); pre-ragged artifacts (``pr2_``…``pr6_`` prefixes) are
     grandfathered,
-  - (ISSUE 8) a TIERED artifact (any dict with ``"tiered": true``) does
+  - (ISSUE 9) a SHARDED-INGEST artifact (any dict with
+    ``"ingest_sharded": true``) does not record a measured
+    ``dispatches_per_conversation`` (gated to == 1 like
+    ``dispatches_per_turn`` — one coalesced mega-batch must cost ONE
+    distributed dispatch on the fused pod write path), or lacks a
+    ``write_scaling``/``write_scaling_floor`` pair, or records
+    ``write_scaling`` below its floor (the sharded write path must never
+    regress below the single-chip fused path; real >1 scaling is the
+    TPU-window item — on a shared-socket CPU mesh the chips share
+    cores). ``dispatches_per_conversation`` values anywhere are gated to
+    == 1 exactly like ``dispatches_per_turn``, and a ``mesh``-carrying
+    artifact satisfies its measured-count requirement with either key,
     not record ``cold_hit_rate`` and ``hot_fraction``, or lacks a
     ``recall_at_10``/``recall_floor`` pair (the generic recall gate then
     enforces the floor — tiering must never silently trade recall for
@@ -86,8 +97,11 @@ _TELEMETRY_KEYS = ("pad_waste_fraction", "queue_wait_ms_p50",
                    "queue_wait_ms_p95", "peak_hbm_bytes")
 
 
+_DISPATCH_KEYS = ("dispatches_per_turn", "dispatches_per_conversation")
+
+
 def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
-          tiereds):
+          tiereds, ingests):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -95,25 +109,28 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             speedups.append((path, obj["fused_vs_classic_speedup"],
                              obj["speedup_floor"]))
         if isinstance(obj.get("mesh"), dict):
-            meshes.append((path, "dispatches_per_turn" in obj))
-        if "dispatches_per_turn" in obj or "telemetry" in obj:
-            tel_blocks.append((path, "dispatches_per_turn" in obj,
+            meshes.append((path, any(k in obj for k in _DISPATCH_KEYS)))
+        if any(k in obj for k in _DISPATCH_KEYS) or "telemetry" in obj:
+            tel_blocks.append((path,
+                               any(k in obj for k in _DISPATCH_KEYS),
                                obj.get("telemetry")))
         if obj.get("ragged") is True:
             raggeds.append((path, obj))
         if obj.get("tiered") is True:
             tiereds.append((path, obj))
+        if obj.get("ingest_sharded") is True:
+            ingests.append((path, obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
-            if k == "dispatches_per_turn":
+            if k in _DISPATCH_KEYS:
                 hits.append((here, v))
             else:
                 _walk(v, here, hits, recalls, speedups, meshes, tel_blocks,
-                      raggeds, tiereds)
+                      raggeds, tiereds, ingests)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
             _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
-                  tel_blocks, raggeds, tiereds)
+                  tel_blocks, raggeds, tiereds, ingests)
 
 
 def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
@@ -178,6 +195,29 @@ def _check_ragged(loc, obj, bad):
                          f"specialization snuck back in)"))
 
 
+def _check_ingest(loc, obj, bad):
+    """The ISSUE 9 sharded-ingest gate on one ``"ingest_sharded": true``
+    dict."""
+    if "dispatches_per_conversation" not in obj:
+        bad.append((loc, "sharded-ingest artifact must record a measured "
+                         "'dispatches_per_conversation'"))
+    scaling = obj.get("write_scaling")
+    floor = obj.get("write_scaling_floor")
+    if scaling is None or floor is None:
+        bad.append((loc, "sharded-ingest artifact must record both "
+                         "'write_scaling' and 'write_scaling_floor'"))
+        return
+    try:
+        ok = float(scaling) >= float(floor)
+    except (TypeError, ValueError):
+        ok = False
+    if not ok:
+        bad.append((loc, f"write_scaling == {scaling!r} < "
+                         f"write_scaling_floor {floor!r} (the pod write "
+                         f"path regressed below the single-chip fused "
+                         f"path)"))
+
+
 def _check_tiered(loc, obj, bad):
     """The ISSUE 8 tiered-memory gate on one ``"tiered": true`` dict."""
     for key in ("cold_hit_rate", "hot_fraction"):
@@ -214,6 +254,7 @@ def main(argv):
     checked_telemetry = 0
     checked_ragged = 0
     checked_tiered = 0
+    checked_ingest = 0
     bad = []
     for p in paths:
         try:
@@ -222,10 +263,10 @@ def main(argv):
         except (OSError, ValueError) as e:
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
-        hits, recalls, speedups, meshes, tel_blocks, raggeds, tiereds = \
-            [], [], [], [], [], [], []
+        (hits, recalls, speedups, meshes, tel_blocks, raggeds, tiereds,
+         ingests) = [], [], [], [], [], [], [], []
         _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
-              tel_blocks, raggeds, tiereds)
+              tel_blocks, raggeds, tiereds, ingests)
         grandfathered = os.path.basename(p).startswith(
             _PRE_TELEMETRY_PREFIXES)
         for loc, measured_fused, block in tel_blocks:
@@ -238,10 +279,13 @@ def main(argv):
         for loc, obj in tiereds:
             checked_tiered += 1
             _check_tiered(loc, obj, bad)
+        for loc, obj in ingests:
+            checked_ingest += 1
+            _check_ingest(loc, obj, bad)
         for loc, v in hits:
             checked += 1
             if v != 1:
-                bad.append((loc, f"dispatches_per_turn == {v!r} "
+                bad.append((loc, f"{loc.rsplit('.', 1)[-1]} == {v!r} "
                                  f"(expected 1)"))
         for loc, got, floor in recalls:
             checked_recall += 1
@@ -268,12 +312,13 @@ def main(argv):
                                  "records no measured dispatches_per_turn"))
     for loc, msg in bad:
         print(f"REGRESSION: {loc}: {msg}")
-    print(f"[check] {checked} dispatches_per_turn value(s), "
+    print(f"[check] {checked} dispatch-count value(s), "
           f"{checked_recall} recall pair(s), {checked_speedup} speedup "
           f"pair(s), {checked_mesh} sharded artifact(s), "
           f"{checked_telemetry} telemetry block(s), "
-          f"{checked_ragged} ragged gate(s), and "
-          f"{checked_tiered} tiered gate(s) across "
+          f"{checked_ragged} ragged gate(s), "
+          f"{checked_tiered} tiered gate(s), and "
+          f"{checked_ingest} sharded-ingest gate(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 

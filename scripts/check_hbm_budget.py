@@ -14,7 +14,12 @@ ISSUE 8 satellite) walks the checked-in artifacts and
 
 - FAILS (exit 1) when any recorded kernel's peak exceeds the budget
   (``--budget-gb``, default 16 — a v5e chip), so a geometry that will OOM
-  in production turns red in CI instead;
+  in production turns red in CI instead; since ISSUE 9 the ingest path
+  records ``kernel.peak_hbm_bytes{path="ingest",batch,rows,mesh}`` via
+  the same AOT read-twin lowering, so WRITE-path geometries (the fused
+  ingest program's arena + edge arena + shadow + link-scan tiles) are
+  gated here too — the summary line reports serve/ingest coverage
+  separately;
 - RECORDS the headroom back into each artifact (an ``hbm_budget`` block:
   max peak, worst kernel, headroom bytes and fraction), so the next
   size-doubling PR knows how much room the current programs leave.
@@ -99,6 +104,7 @@ def main(argv):
         paths = sorted(glob.glob(os.path.join(root, "*.json")))
     budget = args.budget_gb * (1 << 30)
     checked = 0
+    checked_ingest = 0
     breaches = []
     with_gauges = 0
     for p in paths:
@@ -106,12 +112,20 @@ def main(argv):
         checked += n
         if n:
             with_gauges += 1
+            try:
+                with open(p) as f:
+                    found: dict = {}
+                    _collect(json.load(f), found)
+                checked_ingest += sum(1 for k in found
+                                      if 'path="ingest"' in k)
+            except (OSError, ValueError):
+                pass
         breaches.extend(over)
     for path, key, val in breaches:
         print(f"HBM-BUDGET-EXCEEDED: {os.path.basename(path)}: {key} = "
               f"{val / (1 << 30):.2f} GiB > {args.budget_gb} GiB")
-    print(f"[hbm] {checked} kernel gauge(s) across {with_gauges}/"
-          f"{len(paths)} artifact(s) checked against "
+    print(f"[hbm] {checked} kernel gauge(s) ({checked_ingest} write-path) "
+          f"across {with_gauges}/{len(paths)} artifact(s) checked against "
           f"{args.budget_gb} GiB; {len(breaches)} breach(es)")
     return 1 if breaches else 0
 
