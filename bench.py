@@ -2761,8 +2761,380 @@ def tiered_stage_main():
                           if k not in ("telemetry",)}}}))
 
 
+def bench_fault_recovery(on_tpu: bool, rows: int = 8192, faults_n: int = 20,
+                         flood: int = 512):
+    """Fault-recovery acceptance stage (ISSUE 10): measures what failure
+    costs, proves recovery end-to-end, and records the counters the
+    ``scripts/check_fault_matrix.py`` CI gate requires.
+
+    Three measurements on one arena:
+
+    1. **Recovery latency** — serve p50 on the clean path, then inject a
+       dispatch fault (``index.dispatch``, transient) before ``faults_n``
+       separate serves: each one recovers through the non-donating twin
+       in the SAME call, and the faulted-turn wall time p50/p95 vs clean
+       p50 is the measured price of a retry.
+    2. **Shed rate under injected overload** — a thread flood submits
+       ``flood`` single-query requests against a deliberately small
+       admission budget; every future resolves (result or typed
+       ``LoadShed``) — the artifact records the shed rate and that ZERO
+       futures hung.
+    3. **The recovery matrix** — every injection point exercised on a
+       small fixture with post-recovery arena parity asserted, mirroring
+       tests/test_fault_injection.py so CI artifacts carry the same
+       evidence the suite pins.
+    """
+    import tempfile
+    import threading
+
+    from lazzaro_tpu.core import checkpoint as CK
+    from lazzaro_tpu.core.index import MemoryIndex
+    from lazzaro_tpu.reliability.errors import (ArenaPoisoned,
+                                                CheckpointCorrupt,
+                                                ColdReadError,
+                                                DispatchTimeout, LoadShed,
+                                                WorkerCrashed)
+    from lazzaro_tpu.reliability.faults import (INJECTOR, InjectedFault,
+                                                poison_states_hook,
+                                                torn_write_hook)
+    from lazzaro_tpu.serve import QueryScheduler, RetrievalRequest
+    from lazzaro_tpu.serve.scheduler import RetrievalResult
+    from lazzaro_tpu.utils.telemetry import Telemetry
+
+    EPOCH = 1000.0
+    kw = dict(cap_take=5, max_nbr=8, super_gate=0.4, acc_boost=0.05,
+              nbr_boost=0.02, now=1234.5)
+
+    def vecs(n, seed):
+        r = np.random.default_rng(seed)
+        v = r.standard_normal((n, DIM)).astype(np.float32)
+        return v / np.linalg.norm(v, axis=1, keepdims=True)
+
+    def build(n=256, int8=False, tiered=False):
+        idx = MemoryIndex(dim=DIM, capacity=max(n + 64, 255),
+                          int8_serving=int8 or tiered, epoch=EPOCH,
+                          coarse_slack=(n + 64 if (int8 or tiered) else 8),
+                          telemetry=Telemetry())
+        emb = vecs(n, 3)
+        idx.add([f"n{i}" for i in range(n)], emb, [0.5] * n, [0.0] * n,
+                ["semantic"] * n, ["default"] * n, "u0")
+        idx.add_edges([(f"n{i}", f"n{i + 1}", 0.7) for i in range(n - 1)],
+                      "u0", now=EPOCH)
+        if tiered:
+            tm = idx.enable_tiering(hot_budget_rows=n // 4,
+                                    hysteresis_s=0.0)
+            tm.demote_rows([idx.id_to_row[f"n{i}"]
+                            for i in range(n // 2, n)])
+        return idx, emb
+
+    def reqs(emb, nq=16, boost=True, seed=9):
+        r = np.random.default_rng(seed)
+        q = emb[:nq] + 0.01 * r.standard_normal(
+            (nq, DIM)).astype(np.float32)
+        return [RetrievalRequest(query=q[i], tenant="u0", k=10,
+                                 gate_enabled=False, boost=boost)
+                for i in range(nq)]
+
+    def parity(ia, ib):
+        for col in ("emb", "salience", "last_accessed", "access_count",
+                    "alive"):
+            if not np.array_equal(np.asarray(getattr(ia.state, col)),
+                                  np.asarray(getattr(ib.state, col))):
+                return False
+        return True
+
+    matrix = {}
+
+    def cell(name, fn):
+        INJECTOR.clear()
+        try:
+            recovered, par = fn()
+        except Exception as e:      # noqa: BLE001 — record, don't void
+            print(f"[bench] fault cell {name} FAILED: {e!r}",
+                  file=sys.stderr, flush=True)
+            recovered, par = False, False
+        finally:
+            INJECTOR.clear()
+        matrix[name] = {"recovered": bool(recovered), "parity": bool(par)}
+
+    # ---- 1. recovery latency on the main arena -------------------------
+    idx, emb = build(rows, int8=False)
+    tel = idx.telemetry
+    for _ in range(3):
+        idx.search_fused_requests(reqs(emb), **kw)        # warm
+    clean = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        idx.search_fused_requests(reqs(emb), **kw)
+        clean.append((time.perf_counter() - t0) * 1e3)
+    faulted = []
+    for _ in range(faults_n):
+        INJECTOR.arm("index.dispatch", times=1)
+        t0 = time.perf_counter()
+        idx.search_fused_requests(reqs(emb), **kw)        # recovers inline
+        faulted.append((time.perf_counter() - t0) * 1e3)
+    INJECTOR.clear()
+    clean_p50 = float(np.percentile(clean, 50))
+    rec_p50 = float(np.percentile(faulted, 50))
+    rec_p95 = float(np.percentile(faulted, 95))
+    retries = tel.counter_total("serve.dispatch_retries")
+
+    # ---- 2. shed rate under injected overload --------------------------
+    shed_tel = Telemetry()
+    sched = QueryScheduler(
+        lambda rs: idx.search_fused_requests(rs, **kw),
+        telemetry=shed_tel, shed_depth=32)
+    futures = []
+    fut_lock = threading.Lock()
+
+    def client(seed):
+        r = np.random.default_rng(seed)
+        for _ in range(flood // 8):
+            q = emb[int(r.integers(0, len(emb)))]
+            f = sched.submit(RetrievalRequest(query=q, tenant="u0", k=10))
+            with fut_lock:
+                futures.append(f)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    served = shed_n = hung = 0
+    max_wait = 0.0
+    from concurrent.futures import TimeoutError as _FutTimeout
+    for f in futures:
+        tw = time.perf_counter()
+        try:
+            f.result(timeout=60)
+            served += 1
+        except LoadShed:
+            shed_n += 1
+        except _FutTimeout:
+            hung += 1           # the one outcome the layer must forbid
+        except Exception:       # noqa: BLE001 — typed failure, not a hang
+            shed_n += 1
+        max_wait = max(max_wait, (time.perf_counter() - tw) * 1e3)
+    flood_s = time.perf_counter() - t0
+    sched.close()
+    shed_rate = shed_n / max(1, len(futures))
+
+    # ---- 3. the recovery matrix ----------------------------------------
+    def _dispatch_cell(int8, tiered):
+        a, e = build(int8=int8, tiered=tiered)
+        b, _ = build(int8=int8, tiered=tiered)
+        INJECTOR.arm("index.dispatch", times=1)
+        ra = a.search_fused_requests(reqs(e, nq=8), **kw)
+        rb = b.search_fused_requests(reqs(e, nq=8), **kw)
+        ok = all(x.ids == y.ids for x, y in zip(ra, rb))
+        return ok, parity(a, b)
+
+    cell("dispatch_raise:exact", lambda: _dispatch_cell(False, False))
+    cell("dispatch_raise:quant", lambda: _dispatch_cell(True, False))
+    cell("dispatch_raise:tiered", lambda: _dispatch_cell(False, True))
+
+    def _poison_cell():
+        a, e = build(int8=True)
+        ctrl, _ = build(int8=True)
+        with tempfile.TemporaryDirectory() as tmp:
+            CK.save_index(a, tmp + "/ck")
+            INJECTOR.arm("index.dispatch", times=1,
+                         hook=poison_states_hook)
+            try:
+                a.update_access(["n0"], now=2000.0)
+                return False, False          # must have raised
+            except ArenaPoisoned:
+                pass
+            restored = CK.load_index(tmp + "/ck", int8_serving=True,
+                                     coarse_slack=a.coarse_slack)
+            return True, parity(restored, ctrl)
+
+    cell("dispatch_poison:exact", _poison_cell)
+
+    def _worker_cell():
+        a, e = build()
+        wd_tel = Telemetry()
+        s = QueryScheduler(lambda rs: a.search_fused_requests(rs, **kw),
+                           telemetry=wd_tel)
+        INJECTOR.arm("scheduler.worker", times=1)
+        fs = s.submit_many(reqs(e, nq=4))
+        typed = 0
+        for f in fs:
+            try:
+                f.result(timeout=30)
+            except WorkerCrashed:
+                typed += 1
+        ok2 = all(r.ids for r in
+                  [f.result(timeout=30)
+                   for f in s.submit_many(reqs(e, nq=4))])
+        s.close()
+        return typed == 4 and ok2, True
+
+    cell("worker_death:exact", _worker_cell)
+
+    def _watchdog_cell():
+        calls = {"n": 0}
+
+        def ex(rs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(0.2)
+            return [RetrievalResult() for _ in rs]
+
+        wd_tel = Telemetry()
+        s = QueryScheduler(ex, telemetry=wd_tel, dispatch_timeout_s=0.05)
+        f = s.submit(RetrievalRequest(
+            query=np.zeros(DIM, np.float32), tenant="t"))
+        try:
+            f.result(timeout=30)
+            return False, False
+        except DispatchTimeout:
+            pass
+        f2 = s.submit(RetrievalRequest(
+            query=np.zeros(DIM, np.float32), tenant="t"))
+        ok = isinstance(f2.result(timeout=30), RetrievalResult)
+        s.close()
+        nonlocal_timeouts["n"] += wd_tel.counter_total(
+            "reliability.watchdog_timeouts")
+        return ok, True
+
+    nonlocal_timeouts = {"n": 0}
+    cell("watchdog_timeout:exact", _watchdog_cell)
+
+    def _pump_cell():
+        a, _ = build(int8=True)
+        b, _ = build(int8=True)
+        tm = a.enable_tiering(hot_budget_rows=64, hysteresis_s=0.0)
+        rows_ = [a.id_to_row[f"n{i}"] for i in range(128, 192)]
+        INJECTOR.arm("pump.mid_chunk", times=1)
+        try:
+            tm.demote_rows(rows_)
+            return False, False
+        except InjectedFault:
+            pass
+        ok = tm.cold_count == 0 and parity(a, b)
+        moved = tm.demote_rows(rows_)
+        return ok and moved == len(rows_), ok
+
+    cell("pump_mid_chunk:tiered", _pump_cell)
+
+    def _torn_cell():
+        a, e = build(tiered=True)
+        with tempfile.TemporaryDirectory() as tmp:
+            ck = tmp + "/ck"
+            INJECTOR.arm("checkpoint.torn", times=1, exc=None,
+                         hook=torn_write_hook())
+            CK.save_index(a, ck)
+            try:
+                CK.load_index(ck, int8_serving=True,
+                              coarse_slack=a.coarse_slack)
+                return False, False
+            except CheckpointCorrupt:
+                pass
+            CK.save_index(a, ck)
+            restored = CK.load_index(ck, int8_serving=True,
+                                     coarse_slack=a.coarse_slack)
+            return True, parity(restored, a)
+
+    cell("checkpoint_torn:tiered", _torn_cell)
+
+    def _cold_cell():
+        a, e = build(tiered=True)
+        b, _ = build(tiered=True)
+        INJECTOR.arm("coldstore.read", times=1, exc=ColdReadError)
+        try:
+            a.search_fused_requests(reqs(e, nq=8, boost=False), **kw)
+            return False, False
+        except ColdReadError:
+            pass
+        ra = a.search_fused_requests(reqs(e, nq=8, boost=False), **kw)
+        rb = b.search_fused_requests(reqs(e, nq=8, boost=False), **kw)
+        ok = all(x.ids == y.ids for x, y in zip(ra, rb))
+        return ok, parity(a, b)
+
+    cell("coldstore_read:tiered", _cold_cell)
+
+    def _journal_cell():
+        from lazzaro_tpu.reliability import IngestJournal
+        with tempfile.TemporaryDirectory() as tmp:
+            j = IngestJournal(tmp + "/ing.wal")
+            j.append([{"content": "a"}, {"content": "b"}])
+            j2 = IngestJournal(tmp + "/ing.wal")   # crash + reopen
+            pend = j2.pending()
+            n = sum(len(f) for _, f in pend)
+            journal_counts["replayed"] += n
+            j2.commit(j2.last_seq)
+            return n == 2 and IngestJournal(
+                tmp + "/ing.wal").pending_count == 0, True
+
+    journal_counts = {"replayed": 0}
+    cell("ingest_journal:replay", _journal_cell)
+
+    all_recovered = all(c["recovered"] and c["parity"]
+                        for c in matrix.values())
+    return {
+        "reliability": True,
+        "rows": rows,
+        "dim": DIM,
+        "fault_matrix": matrix,
+        "all_recovered": all_recovered,
+        "clean_p50_ms": round(clean_p50, 3),
+        "recovery_latency_ms_p50": round(rec_p50, 3),
+        "recovery_latency_ms_p95": round(rec_p95, 3),
+        "recovery_overhead_x": round(rec_p50 / max(clean_p50, 1e-9), 2),
+        "shed": {"submitted": len(futures), "served": served,
+                 "shed": shed_n, "hung_futures": hung,
+                 "flood_s": round(flood_s, 2),
+                 "max_future_wait_ms": round(max_wait, 1)},
+        "shed_rate": round(shed_rate, 4),
+        "counters": {
+            "dispatch_retries": retries,
+            "load_shed": shed_tel.counter_total("reliability.load_shed"),
+            "watchdog_timeouts": nonlocal_timeouts["n"],
+            "worker_restarts": shed_tel.counter_total(
+                "reliability.worker_restarts"),
+            "journal_replayed": journal_counts["replayed"],
+        },
+        "telemetry": _telemetry_block(tel),
+    }
+
+
+def fault_recovery_stage_main():
+    """Standalone fault-recovery stage (BENCH_FAULT_RECOVERY=<rows> or =1
+    for the default 8192): runs ONLY the reliability stage and writes
+    bench_artifacts/pr10_fault_recovery_<dev>.json — the artifact
+    ``scripts/check_fault_matrix.py`` gates in CI."""
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    spec = os.environ.get("BENCH_FAULT_RECOVERY", "1")
+    rows = 8192 if spec.strip() in ("", "1") else int(spec)
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    dev_tag = "tpu" if on_tpu else "cpu"
+    print(f"[bench] fault-recovery stage at {rows} rows", file=sys.stderr,
+          flush=True)
+    t0 = time.perf_counter()
+    out = bench_fault_recovery(on_tpu, rows)
+    out["stage_total_s"] = round(time.perf_counter() - t0, 1)
+    path = os.path.join(art_dir, f"pr10_fault_recovery_{dev_tag}.json")
+    with open(path, "w") as f:
+        json.dump({"metric": "fault_recovery_latency_p95_ms",
+                   "value": out["recovery_latency_ms_p95"], "unit": "ms",
+                   "device": dev_tag, "reliability": True,
+                   "sizes": {"default": out}}, f, indent=1)
+    print(f"[bench] wrote {path}", file=sys.stderr, flush=True)
+    print(json.dumps({"metric": "fault_recovery_latency_p95_ms",
+                      "value": out["recovery_latency_ms_p95"],
+                      "fault_matrix": out["fault_matrix"],
+                      "shed_rate": out["shed_rate"]}))
+
+
 if __name__ == "__main__":
     try:
+        if os.environ.get("BENCH_FAULT_RECOVERY"):
+            fault_recovery_stage_main()
+            sys.exit(0)
         if os.environ.get("BENCH_TIERED"):
             tiered_stage_main()
             sys.exit(0)

@@ -171,6 +171,48 @@ class MemoryConfig:
     # many distinct nodes.
     serve_boost_flush_max: int = 4096
 
+    # --- reliability (ISSUE 10) --------------------------------------------
+    # Per-dispatch watchdog deadline for the query scheduler: > 0 arms a
+    # timer per device dispatch; on expiry the batch's futures fail with
+    # the typed DispatchTimeout (the stuck dispatch is left to finish and
+    # its late results are discarded) and the circuit breaker records a
+    # failure. 0 (default) = no deadline.
+    serve_dispatch_timeout_s: float = 0.0
+    # Serving circuit breaker: this many CONSECUTIVE dispatch failures/
+    # timeouts open it; while open (for serve_breaker_cooldown_s) every
+    # batch serves DEGRADED — per-request nprobe/cap_take clamped to the
+    # serve_degrade_* rung (cheaper device work, same k results) — then
+    # one half-open probe at full quality decides re-close vs re-open.
+    # 0 disables the breaker.
+    serve_breaker_threshold: int = 5
+    serve_breaker_cooldown_s: float = 5.0
+    serve_degrade_cap_take: int = 1
+    serve_degrade_nprobe: int = 1
+    # Admission load-shedding budgets: a submit that would push the
+    # pending queue past this many requests (or this many query bytes)
+    # fails immediately with the typed LoadShed — the device never sees
+    # it, and the caller backs off instead of queueing unboundedly.
+    # 0 = unlimited.
+    serve_shed_depth: int = 0
+    serve_shed_bytes: int = 0
+    # Donation-safe dispatch recovery (reliability.guard): a failed
+    # donated dispatch whose input survived retries through the
+    # non-donating *_copy twin this many times with exponential backoff
+    # (serve.dispatch_retries{mode,reason} counts); one whose input was
+    # consumed poisons the index and raises the typed ArenaPoisoned.
+    dispatch_retry_max: int = 2
+    dispatch_retry_backoff_s: float = 0.005
+    # Durable ingest journal (reliability.journal): extracted facts are
+    # appended to a CRC-framed WAL the moment extraction returns and
+    # committed only after their fused ingest dispatch lands, so a crash
+    # anywhere in the extraction → coalescer → dispatch window loses
+    # ZERO facts — startup replays uncommitted batches through the
+    # normal ingest, where the in-dispatch dedup probe makes the replay
+    # idempotent. ingest_journal_fsync additionally fsyncs per append
+    # (power-loss durability) at ~1 ms/batch cost.
+    ingest_journal: bool = True
+    ingest_journal_fsync: bool = False
+
     # --- tiered memory (ISSUE 8) -------------------------------------------
     # Hot-row budget: > 0 attaches the tiered-memory manager + pump
     # (tier.TierManager / tier.TierPump). The int8 shadow stays HBM-

@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import zlib
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -33,6 +34,8 @@ import ml_dtypes
 
 from lazzaro_tpu.core import state as S
 from lazzaro_tpu.core.index import MemoryIndex
+from lazzaro_tpu.reliability import faults
+from lazzaro_tpu.reliability.errors import CheckpointCorrupt
 
 _ARENA_COLS = ("emb", "salience", "timestamp", "last_accessed", "access_count",
                "type_id", "shard_id", "tenant_id", "alive", "is_super")
@@ -66,6 +69,48 @@ def _fsync_path(path: str) -> None:
         os.fsync(fd)
     finally:
         os.close(fd)
+
+
+def _file_crc(path: str) -> int:
+    """crc32 of a file's bytes, streamed (the npz payload can be GBs)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 22)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
+def _verify_version_dir(vdir: str) -> None:
+    """Per-file checksum verification (ISSUE 10 satellite): every version
+    dir carries a ``checksums.json`` written BEFORE the commit rename; a
+    payload whose bytes no longer match (torn write the filesystem lied
+    about, bit rot, truncation) raises the typed
+    :class:`CheckpointCorrupt` instead of loading garbage. Pre-ISSUE-10
+    checkpoints without the sidecar still load (np.load decode errors are
+    typed below either way)."""
+    sums_path = os.path.join(vdir, "checksums.json")
+    try:
+        with open(sums_path) as f:
+            sums = json.load(f)
+    except FileNotFoundError:
+        return                       # legacy checkpoint: no sidecar
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorrupt(
+            f"unreadable checksum sidecar {sums_path}: {e}") from e
+    for fname, want in sums.items():
+        fpath = os.path.join(vdir, fname)
+        try:
+            got = _file_crc(fpath)
+        except OSError as e:
+            raise CheckpointCorrupt(
+                f"checkpoint payload {fpath} unreadable: {e}") from e
+        if got != int(want):
+            raise CheckpointCorrupt(
+                f"checkpoint payload {fpath} failed its checksum "
+                f"(crc32 {got:#010x} != recorded {int(want):#010x}) — "
+                f"torn or corrupted write; refusing to load")
 
 
 def _write_versioned(ckpt_dir: str, arrays: Dict[str, np.ndarray],
@@ -116,6 +161,17 @@ def _write_versioned_rank0(ckpt_dir: str, arrays: Dict[str, np.ndarray],
             json.dump(meta, f)
             f.flush()
             os.fsync(f.fileno())
+        # Per-file checksums (ISSUE 10): recorded at write time, verified
+        # by every load — a torn/corrupt payload raises the typed
+        # CheckpointCorrupt instead of deserializing garbage. The sidecar
+        # covers the tier residency + ColdStore payload too (they ride
+        # arrays.npz).
+        sums = {"arrays.npz": _file_crc(os.path.join(tmp, "arrays.npz")),
+                "meta.json": _file_crc(os.path.join(tmp, "meta.json"))}
+        with open(os.path.join(tmp, "checksums.json"), "w") as f:
+            json.dump(sums, f)
+            f.flush()
+            os.fsync(f.fileno())
         # rename alone doesn't make the payload durable: fsync the staged
         # files and both directories around the rename, or a power cut can
         # leave CURRENT pointing at a version whose npz is garbage.
@@ -143,6 +199,11 @@ def _write_versioned_rank0(ckpt_dir: str, arrays: Dict[str, np.ndarray],
     for entry in os.listdir(ckpt_dir):
         if entry != vname and (entry.startswith("v") or entry.startswith(".stage-")):
             shutil.rmtree(os.path.join(ckpt_dir, entry), ignore_errors=True)
+    # Fault point "checkpoint.torn" (ISSUE 10): the armed hook corrupts
+    # the COMMITTED payload after the flip — modeling a torn write the
+    # fsync chain failed to make durable. The recovery matrix then pins
+    # that load raises the typed CheckpointCorrupt, never garbage.
+    faults.fire("checkpoint.torn", dir=os.path.join(ckpt_dir, vname))
 
 
 def _broadcast_ok(local_ok: bool) -> bool:
@@ -170,9 +231,19 @@ def _read_versioned(ckpt_dir: str):
     if cur is None:
         raise FileNotFoundError(f"no checkpoint at {ckpt_dir} (missing CURRENT)")
     vdir = os.path.join(ckpt_dir, cur)
-    with open(os.path.join(vdir, "meta.json")) as f:
-        meta = json.load(f)
-    return np.load(os.path.join(vdir, "arrays.npz")), meta
+    _verify_version_dir(vdir)
+    try:
+        with open(os.path.join(vdir, "meta.json")) as f:
+            meta = json.load(f)
+        return np.load(os.path.join(vdir, "arrays.npz")), meta
+    except (CheckpointCorrupt, FileNotFoundError):
+        raise
+    except Exception as e:             # noqa: BLE001 — typed re-raise
+        # np.load raises zipfile.BadZipFile on a torn npz, json a decode
+        # error on a torn sidecar — surface every decode failure as the
+        # one typed error instead of letting garbage half-load.
+        raise CheckpointCorrupt(
+            f"checkpoint {vdir} failed to decode: {e}") from e
 
 
 def _current_path(ckpt_dir: str) -> str:

@@ -25,6 +25,8 @@ import numpy as np
 
 from lazzaro_tpu.core import state as S
 from lazzaro_tpu.ops import graphops
+from lazzaro_tpu.reliability.errors import ArenaPoisoned
+from lazzaro_tpu.reliability.guard import check_not_poisoned, run_guarded
 from lazzaro_tpu.utils.batching import (LRUKernelCache, bucket_size,
                                         decode_topk, empty_results,
                                         fetch_packed, next_pow2,
@@ -133,9 +135,19 @@ class MemoryIndex:
                  serve_ragged: bool = True, serve_k_max: int = 128,
                  serve_pad_granularity: int = 8,
                  serve_kernel_cache_max: int = 8,
-                 ingest_sharded: bool = True):
+                 ingest_sharded: bool = True,
+                 dispatch_retry_max: int = 2,
+                 dispatch_retry_backoff_s: float = 0.005):
         self.dim = dim
         self.dtype = dtype
+        # Donation-safe recovery (ISSUE 10): a failed donated dispatch
+        # whose input survived retries through the non-donating *_copy
+        # twin (bounded, with backoff); one whose input was consumed
+        # marks the index poisoned and every later touch raises the
+        # typed ArenaPoisoned instead of XLA's "Array has been deleted".
+        self.dispatch_retry_max = max(0, int(dispatch_retry_max))
+        self.dispatch_retry_backoff_s = float(dispatch_retry_backoff_s)
+        self._poisoned = False
         # Serving telemetry (ISSUE 6): spans + device counters land in this
         # registry (the process-wide default unless the owner — typically
         # MemorySystem — injects its own). ``telemetry_hbm=True``
@@ -436,19 +448,44 @@ class MemoryIndex:
     # ``sys.getrefcount``'s own argument.
     _SOLE_REFS = 3
 
+    @property
+    def poisoned(self) -> bool:
+        """True once a donated dispatch consumed this index's state and
+        then failed — the HBM arena is unrecoverable in-process. Restore
+        from checkpoint and replay the ingest journal."""
+        return self._poisoned
+
+    def _guarded(self, call, donated, copying, sole, states, mode):
+        """Donation-safe dispatch executor (ISSUE 10): snapshot of the
+        refcount-gated handoff goes through ``reliability.run_guarded`` —
+        a transient failure retries via the non-donating twin (bounded,
+        ``serve.dispatch_retries{mode,reason}`` counted), a consumed
+        input poisons the index and raises typed."""
+        check_not_poisoned(self._poisoned)
+        try:
+            return run_guarded(call, donated, copying, sole, states,
+                               telemetry=self.telemetry, mode=mode,
+                               retries=self.dispatch_retry_max,
+                               backoff_s=self.dispatch_retry_backoff_s)
+        except ArenaPoisoned:
+            self._poisoned = True
+            raise
+
     def _apply_arena(self, donated, copying, *args, **kwargs) -> None:
         with self._state_lock:
             cur = self._state
-            fn = donated if sys.getrefcount(cur) <= self._SOLE_REFS else copying
-            out = fn(cur, *args, **kwargs)
+            sole = sys.getrefcount(cur) <= self._SOLE_REFS
+            out = self._guarded(lambda fn: fn(cur, *args, **kwargs),
+                                donated, copying, sole, (cur,), "arena")
             del cur
             self.state = out
 
     def _apply_edges(self, donated, copying, *args, **kwargs) -> None:
         with self._state_lock:
             cur = self._edge_state
-            fn = donated if sys.getrefcount(cur) <= self._SOLE_REFS else copying
-            out = fn(cur, *args, **kwargs)
+            sole = sys.getrefcount(cur) <= self._SOLE_REFS
+            out = self._guarded(lambda fn: fn(cur, *args, **kwargs),
+                                donated, copying, sole, (cur,), "edges")
             del cur
             self.edge_state = out
 
@@ -493,10 +530,11 @@ class MemoryIndex:
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                     and sys.getrefcount(edges) <= self._SOLE_REFS
                     and self._shadow_sole(shadow))
-            fn = S.ingest_fused if sole else S.ingest_fused_copy
-            new_arena, new_edges, new_shadow, link_flat = \
-                self._ingest_dispatch(fn, arena, edges, shadow, *args,
-                                      **kwargs)
+            new_arena, new_edges, new_shadow, link_flat = self._guarded(
+                lambda fn: self._ingest_dispatch(fn, arena, edges, shadow,
+                                                 *args, **kwargs),
+                S.ingest_fused, S.ingest_fused_copy, sole,
+                (arena, edges, shadow), "ingest")
             del arena, edges, shadow
             self.state = new_arena
             self.edge_state = new_edges
@@ -979,22 +1017,27 @@ class MemoryIndex:
             if sharded:
                 kern = self._ingest_sharded_kernels(k, tuple(shard_modes),
                                                     shadow is not None)
-                fn = kern.ingest if sole else kern.ingest_copy
                 if shadow is not None:
-                    new_arena, new_edges, q8n, sn, flat = \
-                        self._ingest_dispatch(fn, arena, edges, shadow[0],
-                                              shadow[1], *args)
+                    new_arena, new_edges, q8n, sn, flat = self._guarded(
+                        lambda fn: self._ingest_dispatch(
+                            fn, arena, edges, shadow[0], shadow[1], *args),
+                        kern.ingest, kern.ingest_copy, sole,
+                        (arena, edges, shadow), "ingest_sharded")
                     new_shadow = (q8n, sn)
                 else:
-                    new_arena, new_edges, flat = self._ingest_dispatch(
-                        fn, arena, edges, *args)
+                    new_arena, new_edges, flat = self._guarded(
+                        lambda fn: self._ingest_dispatch(fn, arena, edges,
+                                                         *args),
+                        kern.ingest, kern.ingest_copy, sole,
+                        (arena, edges), "ingest_sharded")
                     new_shadow = None
             else:
-                fn = (S.ingest_dedup_fused if sole
-                      else S.ingest_dedup_fused_copy)
-                new_arena, new_edges, new_shadow, flat = \
-                    self._ingest_dispatch(fn, arena, edges, shadow, *args,
-                                          k=k, shard_modes=shard_modes)
+                new_arena, new_edges, new_shadow, flat = self._guarded(
+                    lambda fn: self._ingest_dispatch(
+                        fn, arena, edges, shadow, *args, k=k,
+                        shard_modes=shard_modes),
+                    S.ingest_dedup_fused, S.ingest_dedup_fused_copy, sole,
+                    (arena, edges, shadow), "ingest")
             del arena, edges, shadow
             self.state = new_arena
             self.edge_state = new_edges
@@ -1747,6 +1790,7 @@ class MemoryIndex:
         nq = len(reqs)
         if nq == 0:
             return []
+        check_not_poisoned(self._poisoned)
         results = [RetrievalResult() for _ in range(nq)]
         if not self.id_to_row:
             return results
@@ -1933,23 +1977,26 @@ class MemoryIndex:
                                jnp.float32(nbr_boost))
                     boost_dev = jnp.asarray(padb(boost_on))
                     sole = sys.getrefcount(cur) <= self._SOLE_REFS
+                    # Each branch picks the (donated, copying) twin pair
+                    # and the per-mode leading operands; ONE guarded call
+                    # at the end executes it donation-safe (ISSUE 10):
+                    # a transient failure retries through the copying
+                    # twin, a consumed input raises typed ArenaPoisoned.
                     if tiered:
                         # (arena, shadow, residency) all taken against
                         # ``cur`` under the lock — the triple never tears
                         q8, scale = self._int8_shadow_for(cur)
                         cold_dev = tm.cold_mask_dev()
+                        pre = (q8, scale, cold_dev)
                         if ragged:
-                            fn = (S.search_fused_tiered_ragged if sole
-                                  else S.search_fused_tiered_ragged_copy)
+                            twins = (S.search_fused_tiered_ragged,
+                                     S.search_fused_tiered_ragged_copy)
                             boost_args = (boost_dev, k_dev,
                                           capq_dev) + scalars
                         else:
-                            fn = (S.search_fused_tiered if sole
-                                  else S.search_fused_tiered_copy)
+                            twins = (S.search_fused_tiered,
+                                     S.search_fused_tiered_copy)
                             boost_args = (boost_dev,) + scalars
-                        new_state, packed = fn(cur, q8, scale, cold_dev,
-                                               *args, *boost_args,
-                                               **statics)
                     elif ivf_tabs is not None:
                         cent, members, extras, _ = ivf_tabs
                         # shadow (when int8 is on too) taken against ``cur``
@@ -1957,47 +2004,47 @@ class MemoryIndex:
                         # tears
                         shadow = (self._int8_shadow_for(cur) if use_quant
                                   else None)
+                        pre = (shadow, cent, members, extras)
                         if ragged:
-                            fn = (S.search_fused_ivf_ragged if sole
-                                  else S.search_fused_ivf_ragged_copy)
+                            twins = (S.search_fused_ivf_ragged,
+                                     S.search_fused_ivf_ragged_copy)
                             boost_args = (boost_dev, k_dev, capq_dev,
                                           npq_dev) + scalars
                         else:
-                            fn = (S.search_fused_ivf if sole
-                                  else S.search_fused_ivf_copy)
+                            twins = (S.search_fused_ivf,
+                                     S.search_fused_ivf_copy)
                             boost_args = (boost_dev,) + scalars
-                        new_state, packed = fn(cur, shadow, cent, members,
-                                               extras, *args, *boost_args,
-                                               **statics)
                     elif use_quant:
                         # shadow taken against ``cur`` under the lock, so
                         # the (arena, codes) pair can never tear across a
                         # racing writer (re-entrant RLock; rebuild is
                         # dispatch-only)
                         q8, scale = self._int8_shadow_for(cur)
+                        pre = (q8, scale)
                         if ragged:
-                            fn = (S.search_fused_quant_ragged if sole
-                                  else S.search_fused_quant_ragged_copy)
+                            twins = (S.search_fused_quant_ragged,
+                                     S.search_fused_quant_ragged_copy)
                             boost_args = (boost_dev, k_dev,
                                           capq_dev) + scalars
                         else:
-                            fn = (S.search_fused_quant if sole
-                                  else S.search_fused_quant_copy)
+                            twins = (S.search_fused_quant,
+                                     S.search_fused_quant_copy)
                             boost_args = (boost_dev,) + scalars
-                        new_state, packed = fn(cur, q8, scale, *args,
-                                               *boost_args, **statics)
                     else:
+                        pre = ()
                         if ragged:
-                            fn = (S.search_fused_ragged if sole
-                                  else S.search_fused_ragged_copy)
+                            twins = (S.search_fused_ragged,
+                                     S.search_fused_ragged_copy)
                             boost_args = (boost_dev, k_dev,
                                           capq_dev) + scalars
                         else:
-                            fn = (S.search_fused if sole
-                                  else S.search_fused_copy)
+                            twins = (S.search_fused, S.search_fused_copy)
                             boost_args = (boost_dev,) + scalars
-                        new_state, packed = fn(cur, *args, *boost_args,
-                                               **statics)
+                    new_state, packed = self._guarded(
+                        lambda fn: fn(cur, *pre, *args, *boost_args,
+                                      **statics),
+                        twins[0], twins[1], sole, (cur,),
+                        "serve_" + mode)
                     del cur
                     self.state = new_state
             elif tiered:
@@ -2339,18 +2386,18 @@ class MemoryIndex:
             with self._state_lock:
                 cur = self._state
                 tables = _tables(cur)
-                fn = (kern.serve
-                      if sys.getrefcount(cur) <= self._SOLE_REFS
-                      else kern.serve_copy)
+                sole = sys.getrefcount(cur) <= self._SOLE_REFS
                 boost_extra = ((jnp.asarray(padb(boost_on)), k_dev,
                                 capq_dev, npq_dev) if ragged
                                else (jnp.asarray(padb(boost_on)),))
-                new_state, packed = fn(cur, tables, *sargs,
-                                       *boost_extra,
-                                       jnp.float32(now_rel),
-                                       jnp.float32(super_gate),
-                                       jnp.float32(acc_boost),
-                                       jnp.float32(nbr_boost))
+                new_state, packed = self._guarded(
+                    lambda fn: fn(cur, tables, *sargs, *boost_extra,
+                                  jnp.float32(now_rel),
+                                  jnp.float32(super_gate),
+                                  jnp.float32(acc_boost),
+                                  jnp.float32(nbr_boost)),
+                    kern.serve, kern.serve_copy, sole, (cur,),
+                    "serve_sharded")
                 del cur
                 self.state = new_state
             return packed
@@ -2683,9 +2730,10 @@ class MemoryIndex:
             return []
         with self._state_lock:
             cur = self._edge_state
-            fn = (S.edges_prune if sys.getrefcount(cur) <= self._SOLE_REFS
-                  else S.edges_prune_copy)
-            new_state, pruned = fn(cur, jnp.int32(tid), jnp.float32(threshold))
+            sole = sys.getrefcount(cur) <= self._SOLE_REFS
+            new_state, pruned = self._guarded(
+                lambda fn: fn(cur, jnp.int32(tid), jnp.float32(threshold)),
+                S.edges_prune, S.edges_prune_copy, sole, (cur,), "edges")
             del cur
             self.edge_state = new_state
         pruned = np.asarray(pruned)

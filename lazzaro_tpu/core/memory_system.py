@@ -165,7 +165,10 @@ class MemorySystem:
                                  serve_k_max=cfg.serve_k_max,
                                  serve_pad_granularity=cfg.serve_pad_granularity,
                                  serve_kernel_cache_max=cfg.serve_kernel_cache_max,
-                                 ingest_sharded=cfg.ingest_sharded)
+                                 ingest_sharded=cfg.ingest_sharded,
+                                 dispatch_retry_max=cfg.dispatch_retry_max,
+                                 dispatch_retry_backoff_s=(
+                                     cfg.dispatch_retry_backoff_s))
 
         # Tiered memory (ISSUE 8): a hot-row budget attaches the residency
         # manager and (with async on) the background demotion/promotion
@@ -252,6 +255,11 @@ class MemorySystem:
         self._journal = None
         self._recovered_turns = False
         self._setup_journal(replay=bool(load_from_disk))
+        # Durable ingest journal (ISSUE 10): extracted facts appended
+        # before they enter the coalescer, committed after their fused
+        # dispatch lands, replayed idempotently here on startup.
+        self._ingest_journal = None
+        self._setup_ingest_journal(replay=bool(load_from_disk))
 
     # --------------------------------------------------------------- journal
     #
@@ -327,6 +335,47 @@ class MemorySystem:
                 self._journal.append(json.dumps(t).encode("utf-8"))
         except OSError:
             pass
+
+    # -------------------------------------------------------- ingest journal
+    #
+    # Append → dispatch → commit (ISSUE 10): the turn WAL above covers raw
+    # conversation turns, but extracted FACTS used to exist only in process
+    # memory between the LLM extraction and the fused ingest dispatch — a
+    # crash in that window re-paid the extraction at best. The ingest
+    # journal makes the facts themselves durable the moment extraction
+    # returns; replay feeds them through the normal ingest, where the
+    # in-dispatch dedup probe collapses anything that DID land before the
+    # crash into merges. Zero lost facts, zero double-ingest.
+
+    def _setup_ingest_journal(self, replay: bool = True) -> None:
+        self._ingest_journal = None
+        journal_dir = getattr(self.store, "db_dir", None)
+        if not self.config.ingest_journal or not journal_dir:
+            return
+        from urllib.parse import quote
+
+        from lazzaro_tpu.reliability import IngestJournal
+
+        path = f"{journal_dir}/ingest__{quote(self.user_id, safe='')}.wal"
+        try:
+            self._ingest_journal = IngestJournal(
+                path, fsync=self.config.ingest_journal_fsync)
+        except OSError as e:
+            self._log(f"⚠ Ingest journal unavailable: {e}")
+            return
+        if not replay:
+            return
+        pending = self._ingest_journal.pending()
+        if not pending:
+            return
+        n_facts = sum(len(f) for _, f in pending)
+        self._log(f"🛟 Replaying {n_facts} journaled fact(s) from "
+                  f"{len(pending)} uncommitted ingest batch(es)")
+        for _seq, facts in pending:
+            self._ingest_facts(facts)
+        self.telemetry.bump("reliability.journal_replayed", n_facts)
+        self._ingest_journal.commit(self._ingest_journal.last_seq)
+        self._save_to_persistence()
 
     # ------------------------------------------------------------------ util
     def _log(self, msg: str) -> None:
@@ -875,7 +924,14 @@ class MemorySystem:
                     max_wait_us=self.config.serve_flush_us,
                     telemetry=self.telemetry,
                     continuous=self.config.serve_continuous,
-                    tenant_max_inflight=self.config.serve_tenant_max_inflight)
+                    tenant_max_inflight=self.config.serve_tenant_max_inflight,
+                    dispatch_timeout_s=self.config.serve_dispatch_timeout_s,
+                    breaker_threshold=self.config.serve_breaker_threshold,
+                    breaker_cooldown_s=self.config.serve_breaker_cooldown_s,
+                    shed_depth=self.config.serve_shed_depth,
+                    shed_bytes=self.config.serve_shed_bytes,
+                    degrade_cap_take=self.config.serve_degrade_cap_take,
+                    degrade_nprobe=self.config.serve_degrade_nprobe)
                 self.query_scheduler = sched
         return sched
 
@@ -1022,6 +1078,22 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
 """
 
     def _async_consolidate(self) -> None:
+        """Crash-surviving wrapper (ISSUE 10 satellite): the consolidation
+        worker runs on a ThreadPoolExecutor whose futures nobody reads, so
+        an uncaught exception used to strand the in-flight batches forever
+        — silently. Any failure now requeues the turns for the next
+        consolidation pass (they stay WAL-journaled meanwhile); if their
+        facts were already extracted + journaled, the in-dispatch dedup
+        probe collapses the re-extraction into merges."""
+        try:
+            self._consolidate_once()
+        except Exception as e:      # noqa: BLE001 — worker must survive
+            self._log(f"⚠ Consolidation worker error: {e!r} "
+                      f"(turns requeued for retry)")
+            self.telemetry.bump("reliability.ingest_failures")
+            self._requeue_inflight()
+
+    def _consolidate_once(self) -> None:
         with self._mutex:
             if not self.consolidation_queue:
                 return
@@ -1061,6 +1133,19 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
 
         memories = [m for m in memories if isinstance(m, dict)]
         self._log(f"✓ Extracted {len(memories)} memory candidates")
+        # Durable ingest journal (ISSUE 10): the facts become durable the
+        # moment extraction returns — BEFORE the coalescer buffers them —
+        # so a crash anywhere between here and the fused dispatch loses
+        # nothing (startup replay + dedup probe make recovery idempotent).
+        if self._ingest_journal is not None and memories:
+            try:
+                self._ingest_journal.append(memories)
+            except OSError as e:
+                self._log(f"⚠ Ingest journal append failed: {e}")
+        # Fault point "ingest.worker" (ISSUE 10): a raise here models the
+        # consolidation worker dying between extraction and ingest.
+        from lazzaro_tpu.reliability import faults as _faults
+        _faults.fire("ingest.worker", facts=len(memories))
         # Cross-conversation coalescing: this extraction already covers
         # every queued conversation (one LLM call over the drained queue);
         # the coalescer merges it with anything still buffered and hands
@@ -1085,17 +1170,45 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         # ingest_flush_wait_s trade (denser dispatches vs added latency)
         # is measured, not guessed.
         coalesce_wait_ms = self._ingest_coalescer.oldest_age_s() * 1e3
+        # Everything the drain pops is covered by journal sequences up to
+        # here; captured BEFORE the drain so facts appended concurrently
+        # are never committed by this pass.
+        commit_to = (self._ingest_journal.last_seq
+                     if self._ingest_journal is not None else 0)
         mega_batches = self._ingest_coalescer.drain()
         if len(mega_batches) > 1:
             self._log(f"   (ingest split into {len(mega_batches)} mega-"
                       f"batches of ≤ {self._ingest_coalescer.max_facts} facts)")
         new_nodes: List[Tuple[str, str]] = []
-        for facts, _n_convs in mega_batches:
-            self.telemetry.record("ingest.coalesce_wait_ms",
-                                  coalesce_wait_ms)
-            new_nodes.extend(self._ingest_facts(facts))
+        done = 0
+        try:
+            for facts, _n_convs in mega_batches:
+                self.telemetry.record("ingest.coalesce_wait_ms",
+                                      coalesce_wait_ms)
+                new_nodes.extend(self._ingest_facts(facts))
+                done += 1
+        except Exception as e:      # noqa: BLE001 — ingest must not strand
+            # An ingest dispatch failed (ISSUE 10): the un-ingested
+            # mega-batches go BACK to the front of the coalescer (they
+            # retry on the next flush) and their source turns move to the
+            # deferred set so the WAL keeps covering them; the ingest
+            # journal still holds every fact uncommitted.
+            self._ingest_coalescer.requeue(mega_batches[done:])
+            self.telemetry.bump("reliability.ingest_failures")
+            with self._mutex:
+                self._deferred_batches.extend(self._inflight_batches)
+                self._inflight_batches.clear()
+                self._journal_sync()
+            self._log(f"⚠ Ingest failed after {done}/{len(mega_batches)} "
+                      f"mega-batches ({e!r}); facts requeued, journal "
+                      f"retains them")
+            return
 
         self._finish_consolidation(new_nodes, start_time)
+        if self._ingest_journal is not None:
+            # append → dispatch → COMMIT: every drained fact is durable in
+            # the arena + store now, so the journal can retire them.
+            self._ingest_journal.commit(commit_to)
 
     def _ingest_facts(self, memories: List[Dict]) -> List[Tuple[str, str]]:
         """Stage, dedup, and ingest one mega-batch of extracted facts;
@@ -1860,6 +1973,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         self.user_id = new_user_id
         self._load_from_persistence()
         self._setup_journal()          # per-user journal; replays crashed turns
+        self._setup_ingest_journal()   # per-user fact journal + replay
         self._log(f"👤 Switched context to user: {new_user_id}")
 
     def get_all_users(self) -> List[str]:
@@ -2499,6 +2613,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         # Reopen the WAL for the (possibly different) restored user —
         # mirrors switch_user; replays that user's crashed turns if any.
         self._setup_journal()
+        self._setup_ingest_journal()
         return f"✓ Snapshot loaded from {snapshot_dir}{pair_warning}"
 
     def save_state(self, filename: str = "memory_state.json") -> str:
@@ -2714,11 +2829,46 @@ Be clinical yet insightful. Do not include conversational filler."""
             "peak_hbm_bytes": peak_hbm or None,
             "scheduler": (self.query_scheduler.stats()
                           if self.query_scheduler is not None else None),
+            # Reliability layer (ISSUE 10): breaker state, recovery and
+            # shed counters, journal depth — the numbers the fault-matrix
+            # CI gate and the dashboard's /api/reliability read.
+            "reliability": self.reliability_summary(),
             "counters": {
                 "llm_calls": self.metrics["llm_calls"],
                 "embedding_calls": self.metrics["embedding_calls"],
                 "edges_linked": self.metrics["edges_linked"],
             },
+        }
+
+    def reliability_summary(self) -> Dict:
+        """Derived reliability view (ISSUE 10): circuit-breaker state,
+        dispatch-retry / shed / restart / replay counters, ingest-journal
+        depth, and the poisoned flag. Served by the dashboard's
+        ``GET /api/reliability`` and embedded in ``metrics_summary()``."""
+        tel = self.telemetry
+        sched = self.query_scheduler
+        jr = self._ingest_journal
+        return {
+            "poisoned": bool(getattr(self.index, "poisoned", False)),
+            "breaker": (sched.breaker.stats()
+                        if sched is not None and sched.breaker is not None
+                        else None),
+            "dispatch_retries": tel.counter_total("serve.dispatch_retries"),
+            "load_shed": tel.counter_total("reliability.load_shed"),
+            "degraded_requests": tel.counter_total(
+                "reliability.degraded_requests"),
+            "watchdog_timeouts": tel.counter_total(
+                "reliability.watchdog_timeouts"),
+            "worker_restarts": tel.counter_total(
+                "reliability.worker_restarts"),
+            "ingest_failures": tel.counter_total(
+                "reliability.ingest_failures"),
+            "journal_replayed": tel.counter_total(
+                "reliability.journal_replayed"),
+            "journal_pending_batches": (jr.pending_count
+                                        if jr is not None else None),
+            "journal_pending_facts": (jr.pending_facts
+                                      if jr is not None else None),
         }
 
     def display_stats(self) -> str:
@@ -2786,11 +2936,15 @@ STORAGE:
         if getattr(self, "_ingest_coalescer", None) and len(self._ingest_coalescer):
             start = time.time()
             wait_ms = self._ingest_coalescer.oldest_age_s() * 1e3
+            commit_to = (self._ingest_journal.last_seq
+                         if self._ingest_journal is not None else 0)
             drained: List[Tuple[str, str]] = []
             for facts, _n_convs in self._ingest_coalescer.drain():
                 self.telemetry.record("ingest.coalesce_wait_ms", wait_ms)
                 drained.extend(self._ingest_facts(facts))
             self._finish_consolidation(drained, start)
+            if self._ingest_journal is not None:
+                self._ingest_journal.commit(commit_to)
         if getattr(self, "_pending_boosts", None):
             self._flush_pending_boosts()
         if hasattr(self, "store") and self.store is not None:

@@ -18,6 +18,10 @@ Observability additions (ISSUE 6, no reference counterpart):
                            readback counters, pad-waste, peak-HBM gauges)
   GET  /api/metrics      → the same registry as JSON
                            (``MemorySystem.metrics_summary()``)
+  GET  /api/reliability  → the reliability layer's derived view (ISSUE 10:
+                           circuit-breaker state, dispatch-retry / shed /
+                           worker-restart counters, ingest-journal depth,
+                           poisoned flag — ``reliability_summary()``)
 
 Differences by design: built on stdlib ``http.server`` (zero extra deps in
 this image; FastAPI optional elsewhere), and the UI is fully self-contained
@@ -131,6 +135,8 @@ class DashboardHandler(BaseHTTPRequestHandler):
                                         "charset=utf-8")
             elif url.path == "/api/metrics":
                 self._send(ms.metrics_summary())
+            elif url.path == "/api/reliability":
+                self._send(ms.reliability_summary())
             elif url.path == "/api/stats":
                 ms.check_for_updates()
                 stats = ms.get_stats()

@@ -51,6 +51,8 @@ from lazzaro_tpu.core.index import (build_host_csr, link_pool_dev,
                                     link_pool_size, split_csr)
 from lazzaro_tpu.ops.topk import make_sharded_topk
 from lazzaro_tpu.parallel.mesh import shard_stacked
+from lazzaro_tpu.reliability.errors import ArenaPoisoned
+from lazzaro_tpu.reliability.guard import check_not_poisoned, run_guarded
 from lazzaro_tpu.utils.batching import (LRUKernelCache, bucket_size,
                                         decode_topk, empty_results,
                                         fetch_packed, next_pow2,
@@ -128,6 +130,12 @@ class ShardedMemoryIndex:
         self._mat_sh = NamedSharding(mesh, P(axis, None))
         self._rep = NamedSharding(mesh, P())
         self._stacked = shard_stacked(mesh, axis)
+        # Donation-safe recovery (ISSUE 10): same contract as MemoryIndex —
+        # transient failures retry through the copying twin, a consumed
+        # input poisons the index and raises typed.
+        self.dispatch_retry_max = 2
+        self.dispatch_retry_backoff_s = 0.005
+        self._poisoned = False
 
         self._state_lock = threading.RLock()
         self._arena = self._reshard(S.init_arena(self.capacity, dim, dtype))
@@ -264,6 +272,26 @@ class ShardedMemoryIndex:
             raise RuntimeError("ShardedMemoryIndex capacity exhausted")
         return rows
 
+    @property
+    def poisoned(self) -> bool:
+        """True once a donated dispatch consumed this index's state and
+        then failed (recovery: checkpoint restore + journal replay)."""
+        return self._poisoned
+
+    def _guarded(self, call, donated, copying, sole, states, mode):
+        """Donation-safe executor (ISSUE 10) — the pod twin of
+        ``MemoryIndex._guarded``: copy-twin retries on transient failure,
+        typed ``ArenaPoisoned`` when the input was consumed."""
+        check_not_poisoned(self._poisoned, "ShardedMemoryIndex")
+        try:
+            return run_guarded(call, donated, copying, sole, states,
+                               telemetry=self.telemetry, mode=mode,
+                               retries=self.dispatch_retry_max,
+                               backoff_s=self.dispatch_retry_backoff_s)
+        except ArenaPoisoned:
+            self._poisoned = True
+            raise
+
     def _apply_arena(self, donated, copying, *args, **kwargs) -> None:
         """The zero-copy mutation gate (PR 1 contract): donate when this
         index provably holds the sole reference to the arena pytree,
@@ -271,8 +299,10 @@ class ShardedMemoryIndex:
         is never invalidated."""
         with self._state_lock:
             cur = self._arena
-            fn = donated if sys.getrefcount(cur) <= self._SOLE_REFS else copying
-            out = fn(cur, *args, **kwargs)
+            sole = sys.getrefcount(cur) <= self._SOLE_REFS
+            out = self._guarded(lambda fn: fn(cur, *args, **kwargs),
+                                donated, copying, sole, (cur,),
+                                "pod_arena")
             del cur
             self.state = out
 
@@ -313,8 +343,10 @@ class ShardedMemoryIndex:
         """Edge-arena twin of ``_apply_arena`` (same donation gate)."""
         with self._state_lock:
             cur = self._edge_state
-            fn = donated if sys.getrefcount(cur) <= self._SOLE_REFS else copying
-            out = self._ingest_dispatch(fn, cur, *args, **kwargs)
+            sole = sys.getrefcount(cur) <= self._SOLE_REFS
+            out = self._guarded(
+                lambda fn: self._ingest_dispatch(fn, cur, *args, **kwargs),
+                donated, copying, sole, (cur,), "pod_edges")
             del cur
             self.edge_state = out
 
@@ -470,15 +502,20 @@ class ShardedMemoryIndex:
                         and (shadow is None
                              or (sys.getrefcount(shadow[0]) <= 2
                                  and sys.getrefcount(shadow[1]) <= 2)))
-                fn = kern.ingest if sole else kern.ingest_copy
                 if shadow is not None:
-                    new_arena, new_edges, q8n, sn, flat = \
-                        self._ingest_dispatch(fn, arena, edges, shadow[0],
-                                              shadow[1], *dev_args)
+                    new_arena, new_edges, q8n, sn, flat = self._guarded(
+                        lambda fn: self._ingest_dispatch(
+                            fn, arena, edges, shadow[0], shadow[1],
+                            *dev_args),
+                        kern.ingest, kern.ingest_copy, sole,
+                        (arena, edges, shadow), "pod_ingest")
                     self._int8_shadow = (q8n, sn)
                 else:
-                    new_arena, new_edges, flat = self._ingest_dispatch(
-                        fn, arena, edges, *dev_args)
+                    new_arena, new_edges, flat = self._guarded(
+                        lambda fn: self._ingest_dispatch(fn, arena, edges,
+                                                         *dev_args),
+                        kern.ingest, kern.ingest_copy, sole,
+                        (arena, edges), "pod_ingest")
                 del arena, edges, shadow
                 self._arena = new_arena
                 self._edge_state = new_edges
@@ -686,12 +723,13 @@ class ShardedMemoryIndex:
             sal[:len(t_sals)] = t_sals
             with self._state_lock:
                 cur = self._arena
-                fn = (S.arena_merge_touch
-                      if sys.getrefcount(cur) <= self._SOLE_REFS
-                      else S.arena_merge_touch_copy)
-                out = self._ingest_dispatch(fn, cur, jnp.asarray(padded),
-                                            jnp.asarray(sal),
-                                            jnp.float32(now_rel))
+                sole = sys.getrefcount(cur) <= self._SOLE_REFS
+                out = self._guarded(
+                    lambda fn: self._ingest_dispatch(
+                        fn, cur, jnp.asarray(padded), jnp.asarray(sal),
+                        jnp.float32(now_rel)),
+                    S.arena_merge_touch, S.arena_merge_touch_copy, sole,
+                    (cur,), "pod_arena")
                 del cur
                 self.state = out
         links: List[Tuple[str, str, float]] = []
@@ -1241,17 +1279,19 @@ class ShardedMemoryIndex:
                 now_rel = time.time() - self.epoch
                 with self._state_lock:
                     cur = self._arena
-                    fn = (kern.serve
-                          if sys.getrefcount(cur) <= self._SOLE_REFS
-                          else kern.serve_copy)
+                    sole = sys.getrefcount(cur) <= self._SOLE_REFS
                     boost_extra = ((jnp.asarray(padb(boost_on)), k_dev,
                                     capq_dev, npq_dev) if ragged
                                    else (jnp.asarray(padb(boost_on)),))
-                    new_state, packed = self._dispatch(
-                        fn, cur, *args, *boost_extra,
-                        jnp.float32(now_rel), jnp.float32(self.super_gate),
-                        jnp.float32(self.acc_boost),
-                        jnp.float32(self.nbr_boost))
+                    new_state, packed = self._guarded(
+                        lambda fn: self._dispatch(
+                            fn, cur, *args, *boost_extra,
+                            jnp.float32(now_rel),
+                            jnp.float32(self.super_gate),
+                            jnp.float32(self.acc_boost),
+                            jnp.float32(self.nbr_boost)),
+                        kern.serve, kern.serve_copy, sole, (cur,),
+                        "serve_pod")
                     del cur
                     self.state = new_state
             else:

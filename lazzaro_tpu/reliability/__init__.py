@@ -1,0 +1,38 @@
+"""Reliability layer for the fused serving/ingest stack (ISSUE 10).
+
+Four pieces, spanning the donation machinery, all three async actors
+(QueryScheduler, IngestCoalescer's consolidation worker, TierPump), and
+durability:
+
+- :mod:`~lazzaro_tpu.reliability.guard` — donation-safe dispatch
+  execution: poisoning detection after a failed donated dispatch,
+  bounded copy-twin retries, typed :class:`ArenaPoisoned`.
+- :mod:`~lazzaro_tpu.reliability.watchdog` — the serving circuit
+  breaker behind the QueryScheduler's dispatch deadlines and
+  degradation ladder.
+- :mod:`~lazzaro_tpu.reliability.journal` — the durable ingest journal
+  (append → dispatch → commit; idempotent replay via the dedup probe).
+- :mod:`~lazzaro_tpu.reliability.faults` — named fault-injection points
+  driving the CI'd recovery matrix (tests/test_fault_injection.py).
+
+Typed errors live in :mod:`~lazzaro_tpu.reliability.errors`; an actor
+that fails does so with one of them, never by hanging a future.
+"""
+
+from lazzaro_tpu.reliability.errors import (ArenaPoisoned,
+                                            CheckpointCorrupt,
+                                            ColdReadError, DispatchTimeout,
+                                            LoadShed, ReliabilityError,
+                                            WorkerCrashed)
+from lazzaro_tpu.reliability import faults
+from lazzaro_tpu.reliability.guard import (check_not_poisoned, is_poisoned,
+                                           run_guarded)
+from lazzaro_tpu.reliability.journal import IngestJournal
+from lazzaro_tpu.reliability.watchdog import CircuitBreaker
+
+__all__ = [
+    "ReliabilityError", "ArenaPoisoned", "DispatchTimeout", "LoadShed",
+    "WorkerCrashed", "CheckpointCorrupt", "ColdReadError",
+    "run_guarded", "is_poisoned", "check_not_poisoned",
+    "IngestJournal", "CircuitBreaker", "faults",
+]

@@ -1,0 +1,62 @@
+"""Typed failure vocabulary for the serving/ingest reliability layer.
+
+Every failure mode an actor can surface to a caller gets its own exception
+class, so callers (and tests) can branch on *what* went wrong instead of
+string-matching a generic RuntimeError — and so a future that fails does
+it with a diagnosis, never by hanging. The taxonomy mirrors the failure
+model in README "Failure model & recovery":
+
+- :class:`ArenaPoisoned` — a donated dispatch failed AFTER consuming its
+  input buffers; the in-HBM state is gone and only checkpoint restore +
+  journal replay can bring the index back. Every subsequent mutation and
+  serve raises this immediately instead of surfacing XLA's generic
+  "Array has been deleted".
+- :class:`DispatchTimeout` — the per-dispatch watchdog deadline expired;
+  the affected requests' futures fail with this while the stuck dispatch
+  is left to finish (its results are discarded) and the circuit breaker
+  records the failure.
+- :class:`LoadShed` — admission control refused the request outright
+  (queue depth or byte budget exceeded). Callers should back off; the
+  device never saw the request.
+- :class:`WorkerCrashed` — an actor's worker thread died outside the
+  demuxed dispatch path; in-flight futures fail with this and the worker
+  restarts.
+- :class:`CheckpointCorrupt` — a checkpoint payload failed its checksum
+  or could not be decoded (torn write, bit rot); raised instead of
+  loading garbage.
+- :class:`ColdReadError` — the host cold tier could not produce bytes
+  for a row the residency column says it owns.
+"""
+
+from __future__ import annotations
+
+
+class ReliabilityError(RuntimeError):
+    """Base class for every typed reliability failure."""
+
+
+class ArenaPoisoned(ReliabilityError):
+    """A donated dispatch consumed its input state and then failed —
+    the live arena/edge buffers are gone. Recover by reloading the last
+    checkpoint and replaying the ingest journal."""
+
+
+class DispatchTimeout(ReliabilityError):
+    """The dispatch watchdog deadline expired for this request's batch."""
+
+
+class LoadShed(ReliabilityError):
+    """Admission control rejected the request before it was queued."""
+
+
+class WorkerCrashed(ReliabilityError):
+    """The owning actor's worker thread died; the request was failed
+    rather than left to block forever. The worker restarts automatically."""
+
+
+class CheckpointCorrupt(ReliabilityError):
+    """Checkpoint payload failed checksum/decoding — refusing to load."""
+
+
+class ColdReadError(ReliabilityError):
+    """The cold tier failed to produce a row it is marked as owning."""

@@ -1,0 +1,112 @@
+"""Donation-safe guarded dispatch execution.
+
+Donation is what makes the fused hot paths zero-copy (PR 1), but it also
+means a dispatch that fails mid-flight may have already consumed the ONLY
+copy of the arena: after ``jit(donate_argnums=0)`` raises, the input
+pytree's buffers are either intact (the failure happened before execution
+— tracing error, injected fault, host OOM building an operand) or deleted
+(the runtime consumed them before dying). The two cases need opposite
+treatment, and conflating them is how a transient error becomes silent
+state loss:
+
+- **Input intact** → the failure was transient from the state's point of
+  view. Retry through the *non-donating* ``*_copy`` twin (bounded, with
+  backoff): the copy twin cannot consume the input, so a retry can never
+  make things worse, and a success leaves the index exactly where the
+  donated dispatch would have. Each retry bumps
+  ``serve.dispatch_retries{mode,reason}``.
+- **Input consumed ("poisoned")** → there is nothing left to retry with.
+  Raise :class:`~lazzaro_tpu.reliability.errors.ArenaPoisoned` so the
+  caller marks the index poisoned and every later touch fails typed and
+  fast instead of surfacing XLA's "Array has been deleted" from a random
+  depth; recovery is checkpoint restore + ingest-journal replay
+  (``reliability.poisoned`` counts these).
+
+``run_guarded`` is the one implementation both donation gates use
+(``core.index.MemoryIndex`` and ``parallel.index.ShardedMemoryIndex``);
+the fault point ``index.dispatch`` fires per attempt inside it, which is
+how the recovery matrix drives both branches deterministically.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+from lazzaro_tpu.reliability import faults
+from lazzaro_tpu.reliability.errors import ArenaPoisoned, ReliabilityError
+
+
+def is_poisoned(states: Sequence) -> bool:
+    """True when any device leaf of the given pytrees has been deleted
+    (a failed donated dispatch consumed it)."""
+    import jax
+
+    for tree in states:
+        if tree is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "is_deleted"):
+                try:
+                    if leaf.is_deleted():
+                        return True
+                except Exception:   # noqa: BLE001 — conservative: unknown
+                    return True     # buffer state counts as unusable
+    return False
+
+
+def run_guarded(call: Callable, donated: Callable, copying: Callable,
+                sole: bool, states: Sequence, *, telemetry=None,
+                mode: str = "mutate", retries: int = 2,
+                backoff_s: float = 0.005,
+                fault_point: str = "index.dispatch"):
+    """Execute one state dispatch with donation-safe recovery.
+
+    ``call(fn)`` must invoke ``fn`` on the captured state + args;
+    ``donated``/``copying`` are the twin kernels and ``sole`` is the
+    refcount gate's verdict (computed by the caller BEFORE building the
+    ``call`` closure — the closure itself holds a reference). ``states``
+    are the pytrees a failed donated dispatch may have consumed; they are
+    probed after every failure and an intact state is retried through the
+    copying twin only. Raises :class:`ArenaPoisoned` when the state is
+    gone, or the last error when retries are exhausted."""
+    fn = donated if sole else copying
+    attempt = 0
+    while True:
+        try:
+            faults.fire(fault_point, states=states, mode=mode,
+                        attempt=attempt)
+            return call(fn)
+        except ArenaPoisoned:
+            raise
+        except Exception as e:               # noqa: BLE001 — typed below
+            if is_poisoned(states):
+                if telemetry is not None:
+                    telemetry.bump("reliability.poisoned",
+                                   labels={"mode": mode})
+                raise ArenaPoisoned(
+                    f"donated {mode} dispatch failed after consuming its "
+                    f"input ({type(e).__name__}: {e}); restore from "
+                    f"checkpoint and replay the ingest journal") from e
+            if attempt >= retries:
+                raise
+            if telemetry is not None:
+                telemetry.bump("serve.dispatch_retries",
+                               labels={"mode": mode,
+                                       "reason": type(e).__name__})
+            time.sleep(backoff_s * (2 ** attempt))
+            attempt += 1
+            fn = copying          # never donate on a retry
+
+
+def check_not_poisoned(flag: bool, what: str = "index") -> None:
+    """Entry-point guard: raise typed-and-fast on a poisoned index."""
+    if flag:
+        raise ArenaPoisoned(
+            f"{what} is poisoned (a donated dispatch consumed its state "
+            f"and failed); restore from checkpoint and replay the ingest "
+            f"journal")
+
+
+__all__ = ["is_poisoned", "run_guarded", "check_not_poisoned",
+           "ArenaPoisoned", "ReliabilityError"]

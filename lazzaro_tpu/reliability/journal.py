@@ -1,0 +1,127 @@
+"""Durable ingest journal: extracted facts survive any crash window.
+
+The turn-level WAL (``native.WriteAheadLog`` driven by MemorySystem's
+``_journal_sync``) already guarantees no *turn* is lost — but turns are
+raw conversation text: replaying them re-runs the LLM extraction, and the
+extraction → coalescer → fused-dispatch window used to be the one place
+extracted FACTS existed only in process memory. A crash between buffering
+and the fused ingest dispatch meant re-paying the LLM call at best and —
+if the source turns had already been retired — losing facts outright.
+
+``IngestJournal`` closes that window with the classic append → dispatch →
+commit discipline over the same CRC-framed record format as the turn WAL:
+
+- ``append(facts)`` durably logs one conversation's extracted facts the
+  moment extraction returns (BEFORE they enter the coalescer), assigning
+  a monotonically increasing sequence number;
+- ``commit(seq)`` appends a commit marker once every fact up to ``seq``
+  has landed in the arena (the coalescer drains everything, so one
+  marker retires the whole drain);
+- ``pending()`` replays the log tolerantly (torn tail dropped by the CRC
+  framing) and returns the uncommitted batches in append order — the
+  startup path feeds them back through the normal ingest, where the
+  EXISTING in-dispatch dedup probe makes replay idempotent: facts that
+  did land before the crash resolve as duplicates, facts that didn't are
+  ingested now. Zero lost facts, zero double-ingest.
+
+The log compacts (resets to empty) whenever a commit retires everything
+outstanding, so steady-state size is one drain's worth of facts.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Tuple
+
+from lazzaro_tpu.native import WriteAheadLog
+
+
+class IngestJournal:
+    """Append/commit journal of extracted-fact batches (one per
+    conversation), built on the CRC-framed WAL."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self._wal = WriteAheadLog(path, fsync=fsync)
+        self._lock = threading.Lock()
+        self._pending: Dict[int, List[dict]] = {}
+        self._next_seq = 1
+        self._replay_into_memory()
+
+    # ------------------------------------------------------------- internal
+    def _replay_into_memory(self) -> None:
+        pending: Dict[int, List[dict]] = {}
+        committed = 0
+        for payload in self._wal.replay():
+            try:
+                rec = json.loads(payload.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                continue                      # foreign/garbled record
+            if not isinstance(rec, dict):
+                continue
+            op = rec.get("op")
+            seq = int(rec.get("seq", 0))
+            if op == "add" and isinstance(rec.get("facts"), list):
+                pending[seq] = rec["facts"]
+            elif op == "commit":
+                committed = max(committed, seq)
+        self._pending = {s: f for s, f in pending.items() if s > committed}
+        top = max(pending.keys(), default=0)
+        self._next_seq = max(top, committed) + 1
+
+    # ------------------------------------------------------------------ api
+    def append(self, facts: List[dict]) -> int:
+        """Durably log one conversation's extracted facts; returns the
+        assigned sequence number (0 when there is nothing to log)."""
+        facts = [f for f in facts if isinstance(f, dict)]
+        if not facts:
+            return 0
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._wal.append(json.dumps(
+                {"op": "add", "seq": seq, "facts": facts}).encode("utf-8"))
+            self._pending[seq] = facts
+            return seq
+
+    def commit(self, seq: int) -> None:
+        """Mark every batch with sequence <= ``seq`` as durably ingested.
+        Compacts the log file when nothing is left outstanding."""
+        if seq <= 0:
+            return
+        with self._lock:
+            for s in [s for s in self._pending if s <= seq]:
+                del self._pending[s]
+            if not self._pending:
+                # everything retired: truncating IS the commit record
+                self._wal.reset()
+            else:
+                self._wal.append(json.dumps(
+                    {"op": "commit", "seq": seq}).encode("utf-8"))
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._next_seq - 1
+
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    @property
+    def pending_facts(self) -> int:
+        with self._lock:
+            return sum(len(f) for f in self._pending.values())
+
+    def pending(self) -> List[Tuple[int, List[dict]]]:
+        """Uncommitted (seq, facts) batches in append order — the startup
+        replay set."""
+        with self._lock:
+            return sorted(self._pending.items())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self._wal.reset()

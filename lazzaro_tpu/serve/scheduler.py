@@ -33,28 +33,52 @@ cross-request mega-batching, the one-dispatch turn, and zero-RTT
 query-cache hits), while ``parallel.index.ShardedMemoryIndex`` plugs in
 its own pod executor (``serve_requests``) — since ISSUE 5 the SAME full
 chat-turn program as one distributed shard_map dispatch per mixed-tenant
-mega-batch: per-query tenant column, device gate verdict, CSR neighbor
-gather, and shard-local boost scatters (the old pod executor was a plain
-multitenant top-k that dropped the gate/neighbor/boost semantics). Same
-coalescing, same policy, different device program. Mega-batched IVF or
-pod turns change NOTHING here: the futures API, flush policy, and
-per-request demux are identical because the coarse-stage and partitioning
-choices live entirely behind the executor.
+mega-batch. Same coalescing, same policy, different device program.
+
+Failure model (ISSUE 10) — a request future resolves with a RESULT or a
+TYPED ERROR; it never blocks forever:
+
+- an **executor exception** demuxes to every future of that batch (the
+  PR 2 behavior) and counts a breaker failure;
+- a **worker-thread death** anywhere outside the demuxed executor call
+  fails the admitted batch's futures with :class:`WorkerCrashed` and the
+  worker RESTARTS (``reliability.worker_restarts``) — pending requests
+  stay queued and are served by the restarted worker;
+- a **dispatch deadline** (``dispatch_timeout_s > 0``) arms a watchdog
+  per dispatch: on expiry the batch's futures fail with
+  :class:`DispatchTimeout` while the stuck dispatch is left to finish
+  (its late results are discarded) and the breaker records the failure;
+- **sustained pressure** opens the circuit breaker
+  (``breaker_threshold`` consecutive failures/timeouts): for
+  ``breaker_cooldown_s`` every batch is served DEGRADED — per-request
+  ``nprobe``/``cap_take`` clamped to the cheap rung — then one
+  half-open probe at full quality decides re-close vs re-open;
+- **admission overload** (``shed_depth``/``shed_bytes`` exceeded) fails
+  new submissions immediately with :class:`LoadShed`
+  (``reliability.load_shed``) — the device never sees them.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from lazzaro_tpu.reliability import faults
+from lazzaro_tpu.reliability.errors import (DispatchTimeout, LoadShed,
+                                            WorkerCrashed)
+from lazzaro_tpu.reliability.watchdog import CircuitBreaker
 from lazzaro_tpu.utils.batching import FlushPolicy
 from lazzaro_tpu.utils.compat import step_trace_annotation
 from lazzaro_tpu.utils.telemetry import default_registry
+
+logger = logging.getLogger("lazzaro_tpu.serve")
 
 
 @dataclass
@@ -98,12 +122,34 @@ class RetrievalResult:
 Executor = Callable[[List[RetrievalRequest]], List[RetrievalResult]]
 
 
+def _fail_future(fut: Future, err: BaseException) -> None:
+    """Set an exception, tolerating a future that already resolved (the
+    watchdog and the late dispatch race by design)."""
+    if fut.cancelled():
+        return
+    try:
+        fut.set_exception(err)
+    except InvalidStateError:
+        pass
+
+
+def _set_future(fut: Future, res) -> None:
+    if fut.cancelled():
+        return
+    try:
+        fut.set_result(res)
+    except InvalidStateError:
+        pass            # watchdog already failed it — late result discarded
+
+
 class QueryScheduler:
     """Coalesce concurrent retrievals into dense device batches.
 
     One daemon worker thread pops pending requests and runs ``executor``
     on them; callers block on per-request futures. ``close()`` drains
-    pending work before returning.
+    pending work before returning. The worker is crash-restarting and
+    every failure path resolves futures with a typed error (see the
+    module docstring's failure model).
 
     Two batching disciplines (ISSUE 7):
 
@@ -124,7 +170,12 @@ class QueryScheduler:
     def __init__(self, executor: Executor, max_batch: int = 64,
                  max_wait_us: int = 2000, name: str = "lz-query-scheduler",
                  telemetry=None, continuous: bool = True,
-                 tenant_max_inflight: int = 0):
+                 tenant_max_inflight: int = 0,
+                 dispatch_timeout_s: float = 0.0,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_s: float = 5.0,
+                 shed_depth: int = 0, shed_bytes: int = 0,
+                 degrade_cap_take: int = 1, degrade_nprobe: int = 1):
         self._executor = executor
         # Serving telemetry (ISSUE 6): every request records its
         # enqueue→flush queue wait (per-tenant label), every flushed batch
@@ -135,14 +186,29 @@ class QueryScheduler:
         self.policy = FlushPolicy(max_batch, max_wait_us / 1e6)
         self.continuous = bool(continuous)
         self.tenant_max_inflight = max(0, int(tenant_max_inflight))
+        # Reliability knobs (ISSUE 10)
+        self.dispatch_timeout_s = max(0.0, float(dispatch_timeout_s))
+        self.shed_depth = max(0, int(shed_depth))
+        self.shed_bytes = max(0, int(shed_bytes))
+        self.degrade_cap_take = max(1, int(degrade_cap_take))
+        self.degrade_nprobe = max(1, int(degrade_nprobe))
+        self.breaker: Optional[CircuitBreaker] = (
+            CircuitBreaker(breaker_threshold, breaker_cooldown_s,
+                           telemetry=self.telemetry, name=name)
+            if breaker_threshold > 0 else None)
         self._cond = threading.Condition()
         self._pending: List[Tuple[RetrievalRequest, Future, float]] = []
+        self._pending_bytes = 0
         self._inflight = 0
         self._closed = False
         self.batches_flushed = 0
         self.requests_served = 0
         self.requests_deferred = 0           # tenant-cap admission defers
+        self.requests_shed = 0               # admission-control rejections
+        self.worker_restarts = 0
+        self.watchdog_timeouts = 0
         self.batch_sizes: List[int] = []     # observability (bench reads it)
+        self._name = name
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name=name)
         self._worker.start()
@@ -158,19 +224,72 @@ class QueryScheduler:
     def submit_many(self, requests: Sequence[RetrievalRequest]
                     ) -> List["Future[RetrievalResult]"]:
         """Enqueue a group atomically (a ``search_memories_batch`` fleet
-        stays contiguous, so it lands in as few flushes as possible)."""
+        stays contiguous, so it lands in as few flushes as possible).
+        Under admission overload the whole group's futures fail
+        immediately with :class:`LoadShed` — the futures API is uniform,
+        so callers see the typed error at ``.result()`` like any other
+        failure."""
         futures = [Future() for _ in requests]
         now = time.time()
+        nbytes = (sum(np.asarray(r.query).nbytes for r in requests)
+                  if self.shed_bytes else 0)
         with self._cond:
             if self._closed:
                 raise RuntimeError("QueryScheduler is closed")
+            over_depth = (self.shed_depth and
+                          len(self._pending) + len(requests)
+                          > self.shed_depth)
+            over_bytes = (self.shed_bytes and
+                          self._pending_bytes + nbytes > self.shed_bytes)
+            if over_depth or over_bytes:
+                self.requests_shed += len(requests)
+                self.telemetry.bump("reliability.load_shed", len(requests))
+                reason = "depth" if over_depth else "bytes"
+                err = LoadShed(
+                    f"admission queue over {reason} budget "
+                    f"({len(self._pending)} pending); retry with backoff")
+                for fut in futures:
+                    _fail_future(fut, err)
+                return futures
             for req, fut in zip(requests, futures):
                 self._pending.append((req, fut, now))
+            self._pending_bytes += nbytes
+            self._ensure_worker_locked()
             self._cond.notify()
         return futures
 
+    def _ensure_worker_locked(self) -> None:
+        """Respawn the worker if it is gone (belt-and-braces: the restart
+        loop already survives crashes, but a dead thread must never let a
+        future sit unserved)."""
+        if self._closed or self._worker.is_alive():
+            return
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name=self._name)
+        self._worker.start()
+
     # ------------------------------------------------------------- worker
     def _run(self) -> None:
+        """Crash-restarting wrapper: a worker death fails the admitted
+        batch's futures (inside ``_serve_loop``) and restarts the loop —
+        pending requests stay queued and are served after the restart.
+        Only a clean close exits."""
+        while True:
+            try:
+                self._serve_loop()
+                return
+            except BaseException:       # noqa: BLE001 — must not die silent
+                logger.exception("query-scheduler worker crashed; "
+                                 "restarting")
+                self.worker_restarts += 1
+                self.telemetry.bump("reliability.worker_restarts",
+                                    labels={"actor": "query_scheduler"})
+                with self._cond:
+                    if self._closed and not self._pending:
+                        return
+                time.sleep(0.005)       # never spin on a persistent fault
+
+    def _serve_loop(self) -> None:
         while True:
             with self._cond:
                 while True:
@@ -193,7 +312,19 @@ class QueryScheduler:
                 batch = self._admit_locked()
                 self._inflight += 1
             try:
-                self._execute(batch)
+                # Fault point "scheduler.worker" (ISSUE 10): a raise here
+                # models the worker dying OUTSIDE the demuxed executor
+                # call — the pre-ISSUE-10 scheduler would strand these
+                # futures forever.
+                try:
+                    faults.fire("scheduler.worker", batch=len(batch))
+                    self._execute(batch)
+                except BaseException as e:
+                    err = WorkerCrashed(
+                        f"query-scheduler worker died mid-batch: {e!r}")
+                    for _, fut, _ in batch:
+                        _fail_future(fut, err)
+                    raise
             finally:
                 with self._cond:
                     self._inflight -= 1
@@ -210,6 +341,7 @@ class QueryScheduler:
         if not cap:
             batch = self._pending[:limit]
             del self._pending[:len(batch)]
+            self._note_admitted_locked(batch)
             return batch
         batch: List[Tuple[RetrievalRequest, Future, float]] = []
         kept: List[Tuple[RetrievalRequest, Future, float]] = []
@@ -225,10 +357,29 @@ class QueryScheduler:
                 if len(batch) < limit:
                     deferred += 1        # capped out, not batch-full
         self._pending = kept
+        self._note_admitted_locked(batch)
         if deferred:
             self.requests_deferred += deferred
             self.telemetry.bump("serve.admission_deferred", deferred)
         return batch
+
+    def _note_admitted_locked(self, batch) -> None:
+        if self.shed_bytes and batch:
+            self._pending_bytes = max(
+                0, self._pending_bytes
+                - sum(np.asarray(req.query).nbytes for req, _, _ in batch))
+
+    def _degrade(self, req: RetrievalRequest) -> RetrievalRequest:
+        """The breaker's cheap rung: clamp the per-request knobs the
+        ragged kernels read as device data (fewer IVF probes, smaller
+        boost/retrieval cap) — same k results, less device work. The
+        request object is copied, never mutated (the caller may retry it
+        at full quality)."""
+        cap = (self.degrade_cap_take if req.cap_take is None
+               else min(req.cap_take, self.degrade_cap_take))
+        npr = (self.degrade_nprobe if req.nprobe is None
+               else min(req.nprobe, self.degrade_nprobe))
+        return dataclasses.replace(req, cap_take=cap, nprobe=npr)
 
     def _execute(self, batch) -> None:
         reqs = [req for req, _, _ in batch]
@@ -237,6 +388,26 @@ class QueryScheduler:
             self.telemetry.record("serve.queue_wait_ms",
                                   (flush_t - enq) * 1e3,
                                   labels={"tenant": req.tenant})
+        if self.breaker is not None and self.breaker.degraded(flush_t):
+            reqs = [self._degrade(r) for r in reqs]
+            self.telemetry.bump("reliability.degraded_requests", len(reqs))
+        timer = None
+        timed_out = threading.Event()
+        if self.dispatch_timeout_s > 0:
+            def _deadline():
+                timed_out.set()
+                self.watchdog_timeouts += 1
+                self.telemetry.bump("reliability.watchdog_timeouts")
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                err = DispatchTimeout(
+                    f"dispatch exceeded the {self.dispatch_timeout_s:.3f}s "
+                    f"watchdog deadline (batch of {len(batch)})")
+                for _, fut, _ in batch:
+                    _fail_future(fut, err)
+            timer = threading.Timer(self.dispatch_timeout_s, _deadline)
+            timer.daemon = True
+            timer.start()
         try:
             # one mega-batch == one profiler step, so TPU captures line up
             # with the host spans batch-for-batch
@@ -244,10 +415,22 @@ class QueryScheduler:
                                        self.batches_flushed):
                 results = self._executor(reqs)
         except Exception as e:                      # noqa: BLE001 — demuxed
+            if timer is not None:
+                timer.cancel()
+            if self.breaker is not None:
+                self.breaker.record_failure()
             for _, fut, _ in batch:
-                if not fut.cancelled():
-                    fut.set_exception(e)
+                _fail_future(fut, e)
             return
+        if timer is not None:
+            timer.cancel()
+        if timed_out.is_set():
+            # The dispatch came back AFTER the watchdog failed its
+            # futures: discard the late results (the callers have moved
+            # on) but leave state/telemetry consistent.
+            return
+        if self.breaker is not None:
+            self.breaker.record_success()
         self.batches_flushed += 1
         self.requests_served += len(batch)
         self.telemetry.bump("serve.requests", len(batch))
@@ -257,8 +440,7 @@ class QueryScheduler:
         if len(self.batch_sizes) > 1024:
             del self.batch_sizes[:512]
         for (_, fut, _), res in zip(batch, results):
-            if not fut.cancelled():
-                fut.set_result(res)
+            _set_future(fut, res)
 
     # ----------------------------------------------------------- lifecycle
     def flush(self, timeout: float = 30.0) -> None:
@@ -287,6 +469,11 @@ class QueryScheduler:
                 "batches_flushed": self.batches_flushed,
                 "requests_served": self.requests_served,
                 "requests_deferred": self.requests_deferred,
+                "requests_shed": self.requests_shed,
+                "worker_restarts": self.worker_restarts,
+                "watchdog_timeouts": self.watchdog_timeouts,
+                "breaker": (self.breaker.stats()
+                            if self.breaker is not None else None),
                 "continuous": self.continuous,
                 "pending": len(self._pending),
                 "mean_batch": (round(float(np.mean(sizes)), 2)

@@ -128,9 +128,24 @@ class ColdStore:
                 self._codes[slot] = codes[i]
                 self._scales[slot] = float(scales[i])
 
+    def flush(self) -> None:
+        """Durably commit the vector slab (ISSUE 10): for the memmap/SSD
+        tier this flushes dirty pages to the backing file, so a demote
+        chunk's cold bytes are on disk BEFORE the hot master row is
+        zeroed (commit-then-zero). Host-RAM slabs are a no-op."""
+        with self._lock:
+            if self.path and hasattr(self._vecs, "flush"):
+                self._vecs.flush()
+
     def gather(self, rows: Sequence[int]) -> np.ndarray:
         """Exact vectors for ``rows`` in the arena dtype; rows not in the
         store come back as zeros (the caller's cold mask gates them)."""
+        from lazzaro_tpu.reliability import faults
+
+        # Fault point "coldstore.read" (ISSUE 10): models an SSD/mmap
+        # read error on the cold tier — the serving finish and the
+        # promote path must surface it typed, never zero-fill silently.
+        faults.fire("coldstore.read", rows=len(rows))
         out = np.zeros((len(rows), self.dim), self._wire)
         with self._lock:
             for i, r in enumerate(rows):

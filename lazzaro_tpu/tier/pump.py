@@ -232,14 +232,33 @@ class TierManager:
                 by_store: Dict[int, List[int]] = {}
                 for i, r in enumerate(chunk):
                     by_store.setdefault(self._part_of(r), []).append(i)
+                # COMMIT-then-zero (ISSUE 10 satellite): the cold copy is
+                # written AND durably flushed before the hot scatter
+                # zeroes the master row — a crash between the two leaves
+                # the row live in BOTH tiers (benign residue, dropped on
+                # the next write), never zeroed in the master with no
+                # committed cold copy.
                 for p, idxs in by_store.items():
                     rs = [chunk[i] for i in idxs]
                     self.stores[p].put(rs, vecs[idxs], codes[idxs],
                                        scales[idxs])
-                padded = S.pad_rows(np.asarray(chunk, np.int32),
-                                    idx.state.capacity)
-                idx._apply_arena(S.tier_demote, S.tier_demote_copy,
-                                 jnp.asarray(padded))
+                    self.stores[p].flush()
+                try:
+                    from lazzaro_tpu.reliability import faults
+                    # Fault point "pump.mid_chunk": the pump dying between
+                    # the cold commit and the hot zero-scatter.
+                    faults.fire("pump.mid_chunk", chunk=len(chunk))
+                    padded = S.pad_rows(np.asarray(chunk, np.int32),
+                                        idx.state.capacity)
+                    idx._apply_arena(S.tier_demote, S.tier_demote_copy,
+                                     jnp.asarray(padded))
+                except BaseException:
+                    # zero-scatter never ran (or failed with the master
+                    # intact): the rows are still HOT — drop the cold
+                    # residue so serving keeps reading the master only.
+                    for p, idxs in by_store.items():
+                        self.stores[p].drop([chunk[i] for i in idxs])
+                    raise
                 self.cold_np[chunk] = True
                 self._invalidate_mask()
                 moved += len(chunk)
@@ -515,6 +534,8 @@ class TierPump:
                 self.manager.run_once()
             except Exception:               # noqa: BLE001 — pump must survive
                 logger.exception("tier pump pass failed")
+                self.manager.telemetry.bump(
+                    "reliability.worker_restarts", labels={"actor": "pump"})
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()

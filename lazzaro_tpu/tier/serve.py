@@ -125,16 +125,17 @@ def tiered_decode_and_finish(index, tm, reqs, results, valid, boost_on,
         indptr_f, nbr_f = index._flat_csr_for()
         with index._state_lock:
             cur = index.state
-            fn = (S.tier_cold_finish
-                  if sys.getrefcount(cur) <= index._SOLE_REFS
-                  else S.tier_cold_finish_copy)
-            new_state, packed2 = fn(
-                cur, indptr_f, nbr_f, dev(q2), dev(ten2), dev(rows2),
-                dev(s2), dev(m2), dev(vecs2), dev(gs2), dev(gr2),
-                dev(fast2), dev(boost2), dev(capq2),
-                jnp.float32(now_rel), jnp.float32(acc_boost),
-                jnp.float32(nbr_boost), k=k_dec, cap_take=cap_take,
-                max_nbr=max_nbr)
+            sole = sys.getrefcount(cur) <= index._SOLE_REFS
+            new_state, packed2 = index._guarded(
+                lambda fn: fn(
+                    cur, indptr_f, nbr_f, dev(q2), dev(ten2), dev(rows2),
+                    dev(s2), dev(m2), dev(vecs2), dev(gs2), dev(gr2),
+                    dev(fast2), dev(boost2), dev(capq2),
+                    jnp.float32(now_rel), jnp.float32(acc_boost),
+                    jnp.float32(nbr_boost), k=k_dec, cap_take=cap_take,
+                    max_nbr=max_nbr),
+                S.tier_cold_finish, S.tier_cold_finish_copy, sole, (cur,),
+                "serve_tiered_cold")
             del cur
             index.state = new_state
     else:

@@ -324,6 +324,21 @@ class IngestCoalescer:
     def pending_conversations(self) -> int:
         return len(self._convs)
 
+    def requeue(self, batches: Sequence[Tuple[Sequence[dict], int]],
+                now: Optional[float] = None) -> None:
+        """Put drained-but-not-ingested mega-batches BACK at the front of
+        the buffer (ISSUE 10): an ingest dispatch failure must not lose
+        the facts the drain already popped — they retry on the next
+        flush, ahead of anything buffered since, and the durable ingest
+        journal keeps them crash-safe meanwhile."""
+        if not batches:
+            return
+        import time as _time
+        self._convs = [list(facts) for facts, _ in batches
+                       if facts] + self._convs
+        if self._convs:
+            self.policy.note_add(now if now is not None else _time.time())
+
     def drain(self) -> List[Tuple[List[dict], int]]:
         batches: List[Tuple[List[dict], int]] = []
         batch: List[dict] = []

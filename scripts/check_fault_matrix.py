@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""Guard: fail when a reliability bench artifact is incomplete or red.
+
+The fault-recovery acceptance bar (ISSUE 10) is a CI'd recovery matrix:
+every named injection point — failed donated dispatch, worker-thread
+death, pump crash mid-chunk, torn checkpoint write, cold-store read
+error — must end in state parity with an uninjected run, and the
+artifact must record the recovery counters that prove the layer was
+actually exercised (a reliability stage that silently stops measuring
+retries/sheds must fail loudly, not pass vacuously). This script walks
+every ``bench_artifacts/*.json`` (or the paths passed as arguments) and
+exits nonzero when an artifact flagged ``"reliability": true``
+
+  - has NO ``fault_matrix`` (in the flagged dict or any of its
+    sub-dicts), or an EMPTY one,
+  - has any matrix cell with ``recovered`` != true or ``parity`` !=
+    true — an injected fault that does not recover to parity is exactly
+    the regression this layer exists to prevent,
+  - omits the recovery counters block or any required counter
+    (``dispatch_retries``, ``load_shed``, ``watchdog_timeouts``,
+    ``worker_restarts``, ``journal_replayed``),
+  - omits the measured ``recovery_latency_ms_p95`` or ``shed_rate``
+    (the two headline numbers the stage exists to record), or
+  - records a ``shed`` block whose ``hung_futures`` != 0 — a future
+    that resolves with neither a result nor a typed error is the one
+    outcome the failure model forbids.
+
+Usage:
+    python scripts/check_fault_matrix.py [artifact.json ...]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+_REQUIRED_COUNTERS = ("dispatch_retries", "load_shed",
+                      "watchdog_timeouts", "worker_restarts",
+                      "journal_replayed")
+_REQUIRED_HEADLINES = ("recovery_latency_ms_p95", "shed_rate")
+
+
+def _find(obj, key):
+    """First value of ``key`` found in ``obj`` or any descendant dict."""
+    if isinstance(obj, dict):
+        if key in obj:
+            return obj[key]
+        for v in obj.values():
+            hit = _find(v, key)
+            if hit is not None:
+                return hit
+    elif isinstance(obj, list):
+        for v in obj:
+            hit = _find(v, key)
+            if hit is not None:
+                return hit
+    return None
+
+
+def _reliability_roots(obj, path, roots):
+    """Top-most dicts flagged ``"reliability": true`` (nested re-flags
+    inside a found root are part of that root's payload)."""
+    if isinstance(obj, dict):
+        if obj.get("reliability") is True:
+            roots.append((path, obj))
+            return
+        for k, v in obj.items():
+            _reliability_roots(v, f"{path}.{k}", roots)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _reliability_roots(v, f"{path}[{i}]", roots)
+
+
+def check_artifact(path: str, bad: list) -> int:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        bad.append((path, f"unreadable artifact: {e}"))
+        return 0
+    roots: list = []
+    _reliability_roots(data, os.path.basename(path), roots)
+    for loc, root in roots:
+        matrix = _find(root, "fault_matrix")
+        if not isinstance(matrix, dict) or not matrix:
+            bad.append((loc, "reliability artifact has no (non-empty) "
+                             "'fault_matrix'"))
+        else:
+            for cell, verdict in sorted(matrix.items()):
+                if not isinstance(verdict, dict):
+                    bad.append((loc, f"matrix cell '{cell}' is not a dict"))
+                    continue
+                if verdict.get("recovered") is not True:
+                    bad.append((loc, f"matrix cell '{cell}' is UNRECOVERED"))
+                if "parity" in verdict and verdict["parity"] is not True:
+                    bad.append((loc, f"matrix cell '{cell}' recovered "
+                                     f"WITHOUT state parity"))
+        counters = _find(root, "counters")
+        if not isinstance(counters, dict):
+            bad.append((loc, "reliability artifact omits its recovery "
+                             "'counters' block"))
+        else:
+            for key in _REQUIRED_COUNTERS:
+                if key not in counters:
+                    bad.append((loc, f"recovery counters omit '{key}'"))
+        for key in _REQUIRED_HEADLINES:
+            if _find(root, key) is None:
+                bad.append((loc, f"reliability artifact omits '{key}'"))
+        shed = _find(root, "shed")
+        if isinstance(shed, dict) and shed.get("hung_futures") not in (0,):
+            bad.append((loc, f"shed block records hung_futures="
+                             f"{shed.get('hung_futures')} (must be 0)"))
+    return len(roots)
+
+
+def main(argv) -> int:
+    paths = argv[1:]
+    if not paths:
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(here, "bench_artifacts",
+                                              "*.json")))
+    if not paths:
+        print("check_fault_matrix: no artifacts found", file=sys.stderr)
+        return 0
+    bad: list = []
+    n_rel = 0
+    for p in paths:
+        n_rel += check_artifact(p, bad)
+    if bad:
+        print("check_fault_matrix: FAIL", file=sys.stderr)
+        for loc, msg in bad:
+            print(f"  {loc}: {msg}", file=sys.stderr)
+        return 1
+    print(f"check_fault_matrix: OK ({len(paths)} artifact(s), "
+          f"{n_rel} reliability block(s) checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
