@@ -3130,8 +3130,351 @@ def fault_recovery_stage_main():
                       "shed_rate": out["shed_rate"]}))
 
 
+def bench_hbm_plan(on_tpu: bool, rows: int = 8192):
+    """Memory-safe serving acceptance stage (ISSUE 11): serve a query-
+    batch geometry LADDER across a throttled HBM budget and prove the
+    planner turns would-be OOMs into planned degradations.
+
+    Measurements:
+
+    1. **The ladder** — batches 8→128 against a budget sized so the small
+       geometries admit FUSED and the large ones need planned splits /
+       chunked scans: per point, the decision, the MEASURED
+       dispatches-per-turn next to the PLANNED count (the dispatch-count
+       gate accepts exactly that pairing), and p95 latency of the planned
+       turn vs an unthrottled single-dispatch control — the measured
+       price of staying inside the budget.
+    2. **Replan recovery** — injected ``RESOURCE_EXHAUSTED`` at the
+       dispatch (the ``plan.oom`` point) across exact/quant/tiered
+       fixtures: every cell must recover via ONE replan through the copy
+       twins to bit-parity, and the replan-turn latency p50/p95 vs clean
+       p50 is recorded (the fault-matrix gate checks the cells + the
+       ``oom_replans`` counter).
+    3. **Typed shed** — a flood against an infeasible-budget index: every
+       future resolves with the typed ``PlanInfeasible`` (shed like
+       LoadShed), ZERO hang, ZERO ``RESOURCE_EXHAUSTED`` crashes anywhere
+       in the stage.
+
+    The stage also records every geometry it EXERCISED (not just ones
+    that compiled) for ``scripts/check_hbm_budget.py``'s planner sweep,
+    and persists the cost-model calibration beside the artifacts."""
+    from lazzaro_tpu.core.index import MemoryIndex
+    from lazzaro_tpu.reliability.errors import PlanInfeasible
+    from lazzaro_tpu.reliability.faults import INJECTOR, oom_error
+    from lazzaro_tpu.reliability.guard import is_resource_exhausted
+    from lazzaro_tpu.serve import QueryScheduler, RetrievalRequest
+    from lazzaro_tpu.utils.telemetry import Telemetry
+
+    EPOCH = 1000.0
+    kw = dict(cap_take=5, max_nbr=8, super_gate=0.4, acc_boost=0.05,
+              nbr_boost=0.02, now=1234.5)
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    calib_path = os.path.join(art_dir, "plan_calibration.json")
+    oom_crashes = 0
+
+    def vecs(n, seed):
+        r = np.random.default_rng(seed)
+        v = r.standard_normal((n, DIM)).astype(np.float32)
+        return v / np.linalg.norm(v, axis=1, keepdims=True)
+
+    def build(n=rows, budget=0, int8=False, tiered=False, calib=False,
+              tel_hbm=False):
+        idx = MemoryIndex(
+            dim=DIM, capacity=max(n + 64, 255), epoch=EPOCH,
+            int8_serving=int8 or tiered,
+            coarse_slack=(n + 64 if (int8 or tiered) else 8),
+            telemetry=Telemetry(), telemetry_hbm=tel_hbm,
+            hbm_budget_bytes=budget,
+            plan_calibration_path=(calib_path if calib else None))
+        emb = vecs(n, 3)
+        idx.add([f"n{i}" for i in range(n)], emb, [0.5] * n, [0.0] * n,
+                ["semantic"] * n, ["default"] * n, "u0")
+        idx.add_edges([(f"n{i}", f"n{i + 1}", 0.7)
+                       for i in range(min(n, 512) - 1)], "u0", now=EPOCH)
+        if tiered:
+            tm = idx.enable_tiering(hot_budget_rows=n // 4,
+                                    hysteresis_s=0.0)
+            tm.demote_rows([idx.id_to_row[f"n{i}"]
+                            for i in range(n // 2, n)])
+        return idx, emb
+
+    def reqs(emb, nq, boost=False, seed=9):
+        r = np.random.default_rng(seed)
+        q = emb[:nq] + 0.01 * r.standard_normal(
+            (nq, DIM)).astype(np.float32)
+        return [RetrievalRequest(query=q[i], tenant="u0", k=10,
+                                 gate_enabled=False, boost=boost)
+                for i in range(nq)]
+
+    def parity(ia, ib):
+        for col in ("emb", "salience", "last_accessed", "access_count",
+                    "alive"):
+            if not np.array_equal(np.asarray(getattr(ia.state, col)),
+                                  np.asarray(getattr(ib.state, col))):
+                return False
+        return True
+
+    # ---- budget sizing: the ladder must CROSS it --------------------
+    # Size from the SAME calibration the throttled index will load, or a
+    # previously-persisted (grown) multiplier would shift the whole
+    # ladder past the budget.
+    from lazzaro_tpu.plan import CostModel
+    ctrl, emb = build()                        # planner off = the control
+    model = CostModel.load_or_default(
+        calib_path if os.path.exists(calib_path) else None)
+    # Just above the ONE-bucket geometry (batch 8, maximally chunked
+    # scan): the smallest ladder point admits fused, everything larger
+    # must take planned sub-dispatches — the ladder crosses the budget.
+    probe_g = ctrl._serve_geometry(8, "exact", ctrl.serve_k_max)
+    budget = int(model.predict(probe_g.with_(scan_chunk=8)) / 0.9) \
+        + (48 << 10)
+    planned, _ = build(budget=budget, calib=True, tel_hbm=True)
+    tel = planned.telemetry
+    geoms_exercised = []
+    ladder = []
+    ladder_batches = (8, 32, 64, 128)
+    turns = 6
+    for b in ladder_batches:
+        g = planned._serve_geometry(b, "exact", planned.serve_k_max)
+        d = planned.planner.plan(g)
+        geoms_exercised.append({
+            "kind": "serve", "mode": g.mode, "batch": g.batch,
+            "rows": g.rows, "dim": g.dim, "k": g.k,
+            "dtype_bytes": g.dtype_bytes, "mesh_parts": g.mesh_parts,
+            "edge_cap": g.edge_cap})
+        rs = reqs(emb, b)
+        for idx in (planned, ctrl):            # warm both kernels
+            idx.search_fused_requests(rs, **kw)
+        t_planned, t_ctrl = [], []
+        before = tel.counter_total("serve.dispatches")
+        for _ in range(turns):
+            t0 = time.perf_counter()
+            try:
+                res_p = planned.search_fused_requests(rs, **kw)
+            except Exception as e:  # noqa: BLE001 — the crash we forbid
+                if is_resource_exhausted(e):
+                    oom_crashes += 1
+                raise
+            t_planned.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            res_c = ctrl.search_fused_requests(rs, **kw)
+            t_ctrl.append((time.perf_counter() - t0) * 1e3)
+        measured = (tel.counter_total("serve.dispatches")
+                    - before) / turns
+        assert all(x.ids == y.ids for x, y in zip(res_p, res_c))
+        ladder.append({
+            "batch": b,
+            "decision": d.reason,
+            "planned_splits": d.splits,
+            "scan_chunk": d.scan_chunk,
+            "predicted_bytes": d.predicted_bytes,
+            # "measured_" prefix: the top-level dict carries the GATED
+            # dispatches_per_turn/planned pair next to its telemetry
+            # block; per-point dicts record without re-triggering the
+            # ISSUE 6 per-dict telemetry requirement
+            "measured_dispatches_per_turn": round(measured, 2),
+            "p95_ms_planned": round(float(np.percentile(t_planned, 95)),
+                                    3),
+            "p95_ms_unsplit": round(float(np.percentile(t_ctrl, 95)), 3),
+            "split_overhead_x": round(
+                float(np.percentile(t_planned, 95))
+                / max(float(np.percentile(t_ctrl, 95)), 1e-9), 2),
+        })
+    split_points = [p for p in ladder if p["planned_splits"] > 1]
+    fused_points = [p for p in ladder if p["planned_splits"] == 1]
+    # ingest geometry exercised through the same admission surface (on
+    # the deliberately throttled budget a typed rejection is a VALID
+    # planner outcome — the point is it is never a runtime OOM)
+    try:
+        d_ing = planned.plan_ingest(1024)
+        ing_decision = {"splits": d_ing.splits, "reason": d_ing.reason}
+    except PlanInfeasible:
+        ing_decision = {"splits": 0, "reason": "infeasible (typed)"}
+    gi = planned._ingest_geometry(1024)
+    geoms_exercised.append({
+        "kind": "ingest", "mode": "ingest", "batch": gi.batch,
+        "rows": gi.rows, "dim": gi.dim, "k": gi.k,
+        "dtype_bytes": gi.dtype_bytes, "mesh_parts": gi.mesh_parts})
+
+    # ---- replan recovery: injected RESOURCE_EXHAUSTED ----------------
+    # A dedicated generous-budget index: every injected OOM legitimately
+    # inflates the model (each one is evidence it under-predicted), so a
+    # deliberately-throttled budget could not absorb 8 of them — the
+    # throttled index's single replan is covered by the matrix cells.
+    replanner, _ = build(budget=1 << 34)
+    clean = []
+    rs16 = reqs(emb, 16)
+    replanner.search_fused_requests(rs16, **kw)     # warm
+    for _ in range(8):
+        t0 = time.perf_counter()
+        replanner.search_fused_requests(rs16, **kw)
+        clean.append((time.perf_counter() - t0) * 1e3)
+    replan_ms = []
+    for _ in range(8):
+        INJECTOR.arm("plan.oom", times=1, exc=oom_error)
+        t0 = time.perf_counter()
+        replanner.search_fused_requests(rs16, **kw)  # recovers inline
+        replan_ms.append((time.perf_counter() - t0) * 1e3)
+    INJECTOR.clear()
+
+    matrix = {}
+
+    def cell(name, int8, tiered):
+        INJECTOR.clear()
+        try:
+            a, e = build(n=256, budget=1 << 34, int8=int8, tiered=tiered)
+            c, _ = build(n=256, int8=int8, tiered=tiered)
+            INJECTOR.arm("plan.oom", times=1, exc=oom_error)
+            ra = a.search_fused_requests(reqs(e, 8), **kw)
+            rc = c.search_fused_requests(reqs(e, 8), **kw)
+            ok = all(x.ids == y.ids for x, y in zip(ra, rc))
+            ok = ok and a.telemetry.counter_total("plan.oom_replans") >= 1
+            matrix[name] = {"recovered": bool(ok),
+                            "parity": bool(parity(a, c))}
+        except Exception as exc:  # noqa: BLE001 — record, don't void
+            print(f"[bench] replan cell {name} FAILED: {exc!r}",
+                  file=sys.stderr, flush=True)
+            matrix[name] = {"recovered": False, "parity": False}
+        finally:
+            INJECTOR.clear()
+
+    cell("plan.oom:exact", False, False)
+    cell("plan.oom:quant", True, False)
+    cell("plan.oom:tiered", False, True)
+
+    # ---- typed shed: infeasible geometry never hangs a future --------
+    infeasible_idx, _ = build(n=256, budget=4096)
+    shed_tel = Telemetry()
+
+    def admission(requests):
+        infeasible_idx.planner.check_feasible(
+            infeasible_idx._serve_geometry(
+                1, "exact", infeasible_idx.serve_k_max))
+
+    sched = QueryScheduler(
+        lambda r_: infeasible_idx.search_fused_requests(r_, **kw),
+        telemetry=shed_tel, admission_check=admission)
+    futs = sched.submit_many(reqs(emb, 64))
+    hung = served = shed_n = 0
+    from concurrent.futures import TimeoutError as _FutTimeout
+    for f in futs:
+        try:
+            f.result(timeout=30)
+            served += 1
+        except PlanInfeasible:
+            shed_n += 1
+        except _FutTimeout:
+            hung += 1
+        except Exception:  # noqa: BLE001 — typed failure, not a hang
+            shed_n += 1
+    sched.close()
+
+    all_recovered = all(c["recovered"] and c["parity"]
+                        for c in matrix.values())
+    worst = max(split_points, key=lambda p: p["planned_splits"],
+                default=ladder[-1])
+    return {
+        "hbm_plan": True,
+        "reliability": True,
+        "rows": rows,
+        "dim": DIM,
+        "budget_bytes": budget,
+        "headroom_fraction": planned.planner.headroom_fraction,
+        "ladder": ladder,
+        "ladder_split_points": len(split_points),
+        "ladder_fused_points": len(fused_points),
+        "dispatches_per_turn": worst["measured_dispatches_per_turn"],
+        "planned_dispatches_per_turn": worst["planned_splits"],
+        "fused_probe": {"batch": fused_points[0]["batch"],
+                        "measured_dispatches_per_turn":
+                            fused_points[0]
+                            ["measured_dispatches_per_turn"]}
+        if fused_points else None,
+        "geometries_exercised": geoms_exercised,
+        "plan": {
+            "split_dispatches":
+                tel.counter_total("plan.split_dispatches"),
+            "planned_turns": tel.counter_total("plan.planned_turns"),
+            "scan_chunked": tel.counter_total("plan.scan_chunked"),
+            "oom_replans":
+                replanner.telemetry.counter_total("plan.oom_replans"),
+            "infeasible_shed":
+                shed_tel.counter_total("plan.infeasible_shed"),
+            "ingest_decision": ing_decision,
+            "resource_exhausted_crashes": oom_crashes,
+            "calibration_path": os.path.relpath(
+                calib_path, os.path.dirname(art_dir)),
+            "multipliers": dict(planned.planner.model.multipliers),
+        },
+        "fault_matrix": matrix,
+        "all_recovered": all_recovered,
+        "clean_p50_ms": round(float(np.percentile(clean, 50)), 3),
+        "recovery_latency_ms_p50":
+            round(float(np.percentile(replan_ms, 50)), 3),
+        "recovery_latency_ms_p95":
+            round(float(np.percentile(replan_ms, 95)), 3),
+        "shed": {"submitted": len(futs), "served": served,
+                 "shed": shed_n, "hung_futures": hung},
+        "shed_rate": round(shed_n / max(1, len(futs)), 4),
+        "counters": {
+            "dispatch_retries":
+                tel.counter_total("serve.dispatch_retries"),
+            "load_shed": shed_tel.counter_total("reliability.load_shed"),
+            "watchdog_timeouts":
+                tel.counter_total("reliability.watchdog_timeouts"),
+            "worker_restarts":
+                tel.counter_total("reliability.worker_restarts"),
+            "journal_replayed":
+                tel.counter_total("reliability.journal_replayed"),
+            "oom_replans":
+                replanner.telemetry.counter_total("plan.oom_replans"),
+        },
+        "telemetry": _telemetry_block(tel),
+    }
+
+
+def hbm_plan_stage_main():
+    """Standalone memory-safe-serving stage (BENCH_HBM_PLAN=<rows> or =1
+    for the default 8192): runs ONLY the planner ladder and writes
+    bench_artifacts/pr11_hbm_plan_<dev>.json — gated in CI by
+    ``check_hbm_budget.py`` (plan block, geometry sweep, model soundness),
+    ``check_dispatch_counts.py`` (planned counts), and
+    ``check_fault_matrix.py`` (replan cells + oom_replans counter)."""
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    spec = os.environ.get("BENCH_HBM_PLAN", "1")
+    rows = 8192 if spec.strip() in ("", "1") else int(spec)
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    dev_tag = "tpu" if on_tpu else "cpu"
+    print(f"[bench] hbm-plan stage at {rows} rows", file=sys.stderr,
+          flush=True)
+    t0 = time.perf_counter()
+    out = bench_hbm_plan(on_tpu, rows)
+    out["stage_total_s"] = round(time.perf_counter() - t0, 1)
+    path = os.path.join(art_dir, f"pr11_hbm_plan_{dev_tag}.json")
+    with open(path, "w") as f:
+        json.dump({"metric": "hbm_plan_split_overhead_x",
+                   "value": max(p["split_overhead_x"]
+                                for p in out["ladder"]),
+                   "unit": "x", "device": dev_tag,
+                   "sizes": {"default": out}}, f, indent=1)
+    print(f"[bench] wrote {path}", file=sys.stderr, flush=True)
+    print(json.dumps({"metric": "hbm_plan_split_overhead_x",
+                      "value": max(p["split_overhead_x"]
+                                   for p in out["ladder"]),
+                      "split_points": out["ladder_split_points"],
+                      "resource_exhausted_crashes":
+                          out["plan"]["resource_exhausted_crashes"],
+                      "shed_rate": out["shed_rate"]}))
+
+
 if __name__ == "__main__":
     try:
+        if os.environ.get("BENCH_HBM_PLAN"):
+            hbm_plan_stage_main()
+            sys.exit(0)
         if os.environ.get("BENCH_FAULT_RECOVERY"):
             fault_recovery_stage_main()
             sys.exit(0)

@@ -202,6 +202,30 @@ class MemoryConfig:
     # consumed poisons the index and raises the typed ArenaPoisoned.
     dispatch_retry_max: int = 2
     dispatch_retry_backoff_s: float = 0.005
+    # --- memory-safe serving (ISSUE 11) ------------------------------------
+    # Per-chip HBM budget the admission-time planner (lazzaro_tpu/plan)
+    # guarantees BEFORE any fused serving/ingest geometry compiles: a
+    # request predicted to exceed budget minus headroom is served as a
+    # chunked-scan single dispatch or as PLANNED sub-dispatches riding
+    # the linear pad buckets (plan.split_dispatches counts them — never
+    # silent), and a geometry no split can fit is rejected with the typed
+    # PlanInfeasible (shed like LoadShed). Runtime RESOURCE_EXHAUSTED is
+    # reclassified non-transient (guard.run_guarded): one replan through
+    # the copy twins, then typed failure. 0 (default) disables planning
+    # entirely — the fused paths are exactly the pre-ISSUE-11 code.
+    hbm_budget_bytes: int = 0
+    # Fraction of the budget held back as headroom (allocator slop,
+    # fragmentation, the packed readback's host staging).
+    hbm_headroom_fraction: float = 0.1
+    # Hard ceiling on how many planned sub-dispatches one turn may split
+    # into before the planner declares the geometry infeasible.
+    plan_max_splits: int = 16
+    # Where the cost model persists its calibration (per-family safety
+    # multipliers grown until predictions over-bound every recorded AOT
+    # memory_analysis() gauge, plus the residual log CI re-checks).
+    # None = in-memory only.
+    plan_calibration_path: Optional[str] = None
+
     # Durable ingest journal (reliability.journal): extracted facts are
     # appended to a CRC-framed WAL the moment extraction returns and
     # committed only after their fused ingest dispatch lands, so a crash

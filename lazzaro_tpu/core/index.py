@@ -25,8 +25,13 @@ import numpy as np
 
 from lazzaro_tpu.core import state as S
 from lazzaro_tpu.ops import graphops
-from lazzaro_tpu.reliability.errors import ArenaPoisoned
-from lazzaro_tpu.reliability.guard import check_not_poisoned, run_guarded
+from lazzaro_tpu.plan import Geometry, HbmPlanner
+from lazzaro_tpu.reliability import faults
+from lazzaro_tpu.reliability.errors import (ArenaPoisoned, DeviceOom,
+                                            PlanInfeasible)
+from lazzaro_tpu.reliability.guard import (check_not_poisoned,
+                                           is_resource_exhausted,
+                                           run_guarded)
 from lazzaro_tpu.utils.batching import (LRUKernelCache, bucket_size,
                                         decode_topk, empty_results,
                                         fetch_packed, next_pow2,
@@ -137,7 +142,12 @@ class MemoryIndex:
                  serve_kernel_cache_max: int = 8,
                  ingest_sharded: bool = True,
                  dispatch_retry_max: int = 2,
-                 dispatch_retry_backoff_s: float = 0.005):
+                 dispatch_retry_backoff_s: float = 0.005,
+                 hbm_budget_bytes: int = 0,
+                 hbm_headroom_fraction: float = 0.1,
+                 plan_max_splits: int = 16,
+                 plan_calibration_path: Optional[str] = None,
+                 planner: Optional[HbmPlanner] = None):
         self.dim = dim
         self.dtype = dtype
         # Donation-safe recovery (ISSUE 10): a failed donated dispatch
@@ -159,6 +169,18 @@ class MemoryIndex:
             else default_registry()
         self.telemetry_hbm = bool(telemetry_hbm)
         self._hbm_recorded: set = set()
+        # Admission-time HBM planner (ISSUE 11): every fused serving/
+        # ingest geometry clears it BEFORE compiling — admit fused, chunk
+        # the arena scan inside the one dispatch, split the query batch
+        # into PLANNED sub-dispatches, or reject typed (PlanInfeasible).
+        # hbm_budget_bytes == 0 (default) disables it entirely.
+        self.planner = planner if planner is not None else HbmPlanner(
+            budget_bytes=hbm_budget_bytes,
+            headroom_fraction=hbm_headroom_fraction,
+            telemetry=self.telemetry,
+            granularity=max(1, int(serve_pad_granularity)),
+            max_splits=plan_max_splits,
+            calibration_path=plan_calibration_path)
         # Coarse-stage over-fetch slack, shared by every two-stage serving
         # path (ISSUE 3 satellite): the IVF member scan over-fetches
         # k + slack before the host dedup trims (a reused slot can sit in
@@ -1045,6 +1067,25 @@ class MemoryIndex:
                 self._int8_shadow = new_shadow
         return flat, new_shadow is not None
 
+    def _ingest_geometry(self, n: int, link_k: int = 3) -> Geometry:
+        return Geometry(
+            kind="ingest", mode="ingest", batch=max(1, int(n)),
+            rows=self.state.emb.shape[0], dim=self.dim,
+            k=max(1, int(link_k)),
+            dtype_bytes=int(np.dtype(self.dtype).itemsize),
+            mesh_parts=self._n_parts, edge_cap=self.edge_state.capacity,
+            link_k=max(1, int(link_k)))
+
+    def plan_ingest(self, n: int, link_k: int = 3):
+        """Admission decision for an ``n``-fact fused ingest mega-batch
+        (ISSUE 11): the coalescer drain consults this BEFORE building the
+        dispatch and splits the mega-batch into ``decision.splits``
+        planned sub-batches when the geometry would blow the budget.
+        Raises the typed :class:`PlanInfeasible` when no split fits
+        (the resident live set alone is over budget)."""
+        return self.planner.check_feasible(
+            self._ingest_geometry(n, link_k), chunkable=False)
+
     def ingest_batch_dedup(self, embeddings: np.ndarray,
                            saliences: Sequence[float],
                            timestamps: Sequence[float],
@@ -1073,6 +1114,14 @@ class MemoryIndex:
         shard_modes = tuple(shard_modes)
         if n == 0:
             return None
+        if self.planner is not None and self.planner.active:
+            # admission gate (ISSUE 11): a geometry no split can fit
+            # raises typed BEFORE rows/slots are allocated or anything
+            # compiles; mega-batch SPLITTING happens one level up at the
+            # coalescer drain (``plan_ingest``)
+            self.planner.check_feasible(
+                self._ingest_geometry(n, min(link_k, self.state.capacity)),
+                chunkable=False)
         rows = self._alloc_rows(n)
         tid = self.tenant_id(tenant)
         k_eff = min(link_k, self.state.capacity)
@@ -1304,6 +1353,8 @@ class MemoryIndex:
                         "rows": str(self.state.emb.shape[0]),
                         "mesh": (f"{self._n_parts}x{self.shard_axis}"
                                  if self.mesh is not None else "1")})
+            self.planner.observe_gauge(
+                self._ingest_geometry(b, k_eff), peak)
 
     def warmup_ingest(self, geometries=(256,), *, dedup_gate: float = 0.95,
                       link_k: int = 3, shard_modes=(1, 0),
@@ -1330,6 +1381,18 @@ class MemoryIndex:
         for g in buckets:
             if len(self._free_rows) < g:
                 continue                    # would grow: wrong geometry
+            if self.planner is not None and self.planner.active:
+                # planner compile gate (ISSUE 11): don't precompile an
+                # ingest geometry the admission path would refuse or
+                # split — warm the planned sub-batch size instead
+                try:
+                    d = self.plan_ingest(g, link_k=link_k)
+                except PlanInfeasible:
+                    tel.bump("plan.warmup_skipped",
+                             labels={"path": "ingest"})
+                    continue
+                if d.splits > 1:
+                    g = max(1, -(-g // d.splits))
             t0 = time.perf_counter()
             prev = tel.enabled
             tel.enabled = False
@@ -1759,10 +1822,146 @@ class MemoryIndex:
         self._csr_cache = (n, dev[0], dev[1])
         return dev
 
+    # ------------------------------------------------- memory-safe serving
+    def _serve_mode_hint(self, cap_take: int, reqs) -> Tuple[str, int]:
+        """Cheap (mode, k-ceiling) prediction of what the fused dispatch
+        will route to — the planner's geometry key. Mirrors the routing
+        in ``_search_fused_once`` without building any device arrays."""
+        cap = self.state.capacity
+        tm = self.tiering
+        tiered = tm is not None and tm.cold_count > 0
+        if self.serve_ragged:
+            k_bucket = int(min(max(self.serve_k_max, cap_take, 1), cap))
+        else:
+            k_eff = max(cap_take,
+                        max((min(int(r.k), cap) for r in reqs), default=1),
+                        1)
+            k_bucket = min(max(next_pow2(k_eff), 1), cap)
+        if self.mesh is not None:
+            base = ("tiered" if tiered
+                    else "quant" if self.int8_serving else "exact")
+            return "sharded_" + base, k_bucket
+        if tiered:
+            return "tiered", k_bucket
+        if self._ivf_fused_pack(k_bucket) is not None:
+            return "ivf", k_bucket
+        if self.int8_serving:
+            return "quant", k_bucket
+        return "exact", k_bucket
+
+    def _serve_geometry(self, nq: int, mode: str, k_bucket: int) -> Geometry:
+        pad_n = (bucket_size(nq, self.serve_pad_granularity)
+                 if self.serve_ragged else next_pow2(nq))
+        st = self.state
+        return Geometry(
+            kind="serve", mode=mode, batch=pad_n, rows=st.emb.shape[0],
+            dim=self.dim, k=k_bucket,
+            dtype_bytes=int(np.dtype(self.dtype).itemsize),
+            mesh_parts=self._n_parts, edge_cap=self.edge_state.capacity,
+            nprobe=int(self.ivf_nprobe or 0))
+
     def search_fused_requests(self, reqs, *, cap_take: int, max_nbr: int,
                               super_gate: float, acc_boost: float,
                               nbr_boost: float,
                               now: Optional[float] = None) -> List:
+        """Memory-safe entry point of the fused serving path (ISSUE 11):
+        with a planner budget configured, the requested geometry is
+        ADMITTED before anything compiles or dispatches — it runs as the
+        usual ONE fused dispatch when the prediction fits, with a chunked
+        arena scan (still one dispatch) or as PLANNED sub-dispatches
+        riding the linear pad buckets when it doesn't, and raises the
+        typed :class:`PlanInfeasible` when no split can fit. A runtime
+        ``RESOURCE_EXHAUSTED`` the model missed (reclassified by
+        ``guard.run_guarded`` into :class:`DeviceOom`, never retried with
+        backoff) gets exactly ONE replan — harder split, copy twins —
+        before failing typed. With the planner disabled (the default)
+        this is a zero-overhead passthrough to the fused dispatch."""
+        nq = len(reqs)
+        kw = dict(cap_take=cap_take, max_nbr=max_nbr,
+                  super_gate=super_gate, acc_boost=acc_boost,
+                  nbr_boost=nbr_boost, now=now)
+        planner = self.planner
+        if (nq == 0 or planner is None or not planner.active
+                or not self.id_to_row):
+            try:
+                return self._search_fused_once(reqs, **kw)
+            except DeviceOom:
+                raise
+            except Exception as e:  # noqa: BLE001 — typed OOM, uniform
+                if not is_resource_exhausted(e):
+                    raise
+                # the read twins bypass run_guarded; keep the serving
+                # surface's OOM contract typed there too
+                self.telemetry.bump("reliability.oom",
+                                    labels={"mode": "serve"})
+                raise DeviceOom(
+                    f"serving dispatch exhausted device memory and no "
+                    f"planner budget is configured to replan it: {e}"
+                ) from e
+        check_not_poisoned(self._poisoned)
+        mode, k_bucket = self._serve_mode_hint(cap_take, reqs)
+        geom = self._serve_geometry(nq, mode, k_bucket)
+        chunkable = self.serve_ragged and self.mesh is None
+        decision = planner.check_feasible(geom, chunkable=chunkable)
+        return self._serve_planned(reqs, geom, decision, kw,
+                                   replanned=False)
+
+    def _serve_planned(self, reqs, geom, decision, kw,
+                       replanned: bool) -> List:
+        """Execute one plan decision: dispatch the (possibly split) batch,
+        recording planned sub-dispatches, and answer a runtime OOM with
+        ONE harder replan through the copy twins."""
+        tel = self.telemetry
+        n = len(reqs)
+        splits = max(1, min(decision.splits, n))
+        per = -(-n // splits)
+        groups = [reqs[i:i + per] for i in range(0, n, per)]
+        if len(groups) > 1:
+            # a planned multi-dispatch turn is RECORDED, never silent —
+            # the dispatch-count gate accepts exactly these
+            tel.bump("plan.planned_turns", labels={"path": "serve"})
+            tel.bump("plan.split_dispatches", len(groups),
+                     labels={"path": "serve"})
+        if decision.scan_chunk:
+            tel.bump("plan.scan_chunked", labels={"path": "serve"})
+        out: List = []
+        done = 0
+        try:
+            for g in groups:
+                out.extend(self._search_fused_once(
+                    g, scan_chunk=decision.scan_chunk,
+                    force_copy=replanned, **kw))
+                done += len(g)
+        except Exception as e:      # noqa: BLE001 — OOM-only replan below
+            if not is_resource_exhausted(e):
+                raise
+            if replanned:
+                tel.bump("plan.infeasible", labels={"path": "serve"})
+                raise PlanInfeasible(
+                    f"replanned serving dispatch still exhausted device "
+                    f"memory (mode={geom.mode}, batch={geom.batch}, "
+                    f"rows={geom.rows}): {e}") from e
+            self.planner.note_oom(geom)
+            harder = self.planner.replan_after_oom(
+                geom, decision,
+                chunkable=(self.serve_ragged and self.mesh is None))
+            if harder is None:
+                tel.bump("plan.infeasible", labels={"path": "serve"})
+                raise PlanInfeasible(
+                    f"serving dispatch exhausted device memory and no "
+                    f"harder split fits the budget (mode={geom.mode}, "
+                    f"batch={geom.batch}, rows={geom.rows})") from e
+            tel.bump("plan.oom_replans", labels={"path": "serve"})
+            out.extend(self._serve_planned(reqs[done:], geom, harder, kw,
+                                           replanned=True))
+        return out
+
+    def _search_fused_once(self, reqs, *, cap_take: int, max_nbr: int,
+                           super_gate: float, acc_boost: float,
+                           nbr_boost: float,
+                           now: Optional[float] = None,
+                           scan_chunk: int = 0,
+                           force_copy: bool = False) -> List:
         """Serve a coalesced batch of ``serve.RetrievalRequest``s with ONE
         device dispatch + ONE packed readback: masked super-node top-1
         gate, main-arena ANN top-k, CSR neighbor gather, and the neighbor-
@@ -1869,13 +2068,18 @@ class MemoryIndex:
             mode = ("sharded_tiered" if tiered
                     else "sharded_quant" if self.int8_serving
                     else "sharded_exact")
+            # Fault point "plan.oom" (ISSUE 11): models an HBM allocation
+            # failure the admission plan missed — recovery is ONE replan
+            # into split sub-dispatches through the copy twins.
+            faults.fire("plan.oom", mode=mode, batch=pad_n)
             t0 = time.perf_counter()
             with trace_annotation(f"lz.serve.{mode}"):
                 packed = self._dispatch_fused_sharded(
                     st, indptr, nbr, qp, padb, valid, tenants, gate_on,
                     boost_on, k_bucket, cap_take, max_nbr, super_gate,
                     acc_boost, nbr_boost, now, ragged=ragged,
-                    k_arr=k_arr, cap_arr=cap_arr, tiered=tiered)
+                    k_arr=k_arr, cap_arr=cap_arr, tiered=tiered,
+                    force_copy=force_copy)
                 host = np.asarray(packed)      # the ONE readback
             tel.record("serve.dispatch_ms",
                        (time.perf_counter() - t0) * 1e3,
@@ -1956,6 +2160,11 @@ class MemoryIndex:
                                  else ceil_np)
                 np_arr[~valid] = 0
                 npq_dev = jnp.asarray(padb(np_arr, 0, np.int32))
+        if ragged and scan_chunk:
+            # Planner streaming-width override (ISSUE 11): the scan
+            # chunks the arena stream tighter — smaller [chunk, rows]
+            # score tile, SAME single dispatch, bit-identical results.
+            statics["scan_chunk"] = int(scan_chunk)
         self._note_serve_kernel(mode, statics, ragged)
         tier_pack = ((*self._int8_shadow_for(st), tm.cold_mask_dev())
                      if tiered else None)
@@ -1963,6 +2172,9 @@ class MemoryIndex:
                                ivf_tabs, use_quant, ragged=ragged,
                                k_dev=k_dev, npq_dev=npq_dev,
                                tier_pack=tier_pack)
+        # Fault point "plan.oom" (ISSUE 11): an HBM allocation failure the
+        # admission plan missed; the wrapper answers with one replan.
+        faults.fire("plan.oom", mode=mode, batch=pad_n)
         t0 = time.perf_counter()
         with trace_annotation(f"lz.serve.{mode}"):
             if boost_on.any():
@@ -1976,7 +2188,10 @@ class MemoryIndex:
                                jnp.float32(acc_boost),
                                jnp.float32(nbr_boost))
                     boost_dev = jnp.asarray(padb(boost_on))
-                    sole = sys.getrefcount(cur) <= self._SOLE_REFS
+                    # force_copy: a post-OOM replan always dispatches
+                    # through the non-donating twin (ISSUE 11)
+                    sole = (not force_copy
+                            and sys.getrefcount(cur) <= self._SOLE_REFS)
                     # Each branch picks the (donated, copying) twin pair
                     # and the per-mode leading operands; ONE guarded call
                     # at the end executes it donation-safe (ISSUE 10):
@@ -2192,7 +2407,12 @@ class MemoryIndex:
             prev = tel.enabled
             tel.enabled = False
             try:
-                # serve twin (one boosting request), then the read twin
+                # serve twin (one boosting request), then the read twin.
+                # Warmups route through the SAME planner-gated entry as
+                # live traffic (ISSUE 11), so a planned-split geometry
+                # precompiles exactly the sub-dispatch kernels it will
+                # serve with; an infeasible one is skipped typed instead
+                # of compiling a program that could never dispatch.
                 self.search_fused_requests(
                     [RetrievalRequest(query=zero_q, tenant="~warmup", k=kk,
                                       gate_enabled=True, boost=(i == 0))
@@ -2201,6 +2421,10 @@ class MemoryIndex:
                     [RetrievalRequest(query=zero_q, tenant="~warmup", k=kk,
                                       gate_enabled=True)
                      for i in range(g)], **kw)
+            except PlanInfeasible:
+                tel.enabled = prev
+                tel.bump("plan.warmup_skipped", labels={"path": "serve"})
+                continue
             finally:
                 tel.enabled = prev
             ms = (time.perf_counter() - t0) * 1e3
@@ -2273,8 +2497,23 @@ class MemoryIndex:
                 labels={"mode": mode,
                         "k": str(statics.get("k")),
                         "rows": str(st.emb.shape[0]),
+                        "batch": str(int(args[2].shape[0])),
                         "mesh": (f"{self._n_parts}x{self.shard_axis}"
                                  if self.mesh is not None else "1")})
+            # Calibrate the admission model against the measured truth
+            # (ISSUE 11): predictions must over-bound every recorded
+            # gauge — the multiplier grows here whenever one beats it.
+            self.planner.observe_gauge(
+                Geometry(kind="serve", mode=mode,
+                         batch=int(args[2].shape[0]),
+                         rows=int(st.emb.shape[0]), dim=self.dim,
+                         k=int(statics.get("k") or 1),
+                         dtype_bytes=int(np.dtype(self.dtype).itemsize),
+                         mesh_parts=self._n_parts,
+                         edge_cap=self.edge_state.capacity,
+                         nprobe=int(statics.get("nprobe") or 0),
+                         scan_chunk=int(statics.get("scan_chunk") or 0)),
+                peak)
 
     def _demux_fused(self, reqs, results, valid, boost_on, gate_s, gate_r,
                      ann_s, ann_r, fast, cap, lengths=None):
@@ -2323,7 +2562,8 @@ class MemoryIndex:
                                 tenants, gate_on, boost_on, k_bucket,
                                 cap_take, max_nbr, super_gate, acc_boost,
                                 nbr_boost, now, ragged=False, k_arr=None,
-                                cap_arr=None, tiered=False):
+                                cap_arr=None, tiered=False,
+                                force_copy=False):
         """The pod serving dispatch (ISSUE 5): the full chat-turn program
         as ONE distributed shard_map dispatch against the row-sharded
         arena. Exact by default; with ``int8_serving`` the shard-local
@@ -2379,14 +2619,26 @@ class MemoryIndex:
                         labels={"mode": f"sharded_{mode}",
                                 "k": str(k_bucket),
                                 "rows": str(st.emb.shape[0]),
+                                "batch": str(int(qp.shape[0])),
                                 "mesh": f"{self._n_parts}x{self.shard_axis}"})
+                    self.planner.observe_gauge(
+                        Geometry(kind="serve", mode=f"sharded_{mode}",
+                                 batch=int(qp.shape[0]),
+                                 rows=int(st.emb.shape[0]), dim=self.dim,
+                                 k=int(k_bucket),
+                                 dtype_bytes=int(
+                                     np.dtype(self.dtype).itemsize),
+                                 mesh_parts=self._n_parts,
+                                 edge_cap=self.edge_state.capacity),
+                        peak)
         if boost_on.any():
             del st      # a live snapshot would trip the sole-owner gate
             now_rel = (now if now is not None else time.time()) - self.epoch
             with self._state_lock:
                 cur = self._state
                 tables = _tables(cur)
-                sole = sys.getrefcount(cur) <= self._SOLE_REFS
+                sole = (not force_copy
+                        and sys.getrefcount(cur) <= self._SOLE_REFS)
                 boost_extra = ((jnp.asarray(padb(boost_on)), k_dev,
                                 capq_dev, npq_dev) if ragged
                                else (jnp.asarray(padb(boost_on)),))

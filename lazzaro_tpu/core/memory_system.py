@@ -168,7 +168,13 @@ class MemorySystem:
                                  ingest_sharded=cfg.ingest_sharded,
                                  dispatch_retry_max=cfg.dispatch_retry_max,
                                  dispatch_retry_backoff_s=(
-                                     cfg.dispatch_retry_backoff_s))
+                                     cfg.dispatch_retry_backoff_s),
+                                 hbm_budget_bytes=cfg.hbm_budget_bytes,
+                                 hbm_headroom_fraction=(
+                                     cfg.hbm_headroom_fraction),
+                                 plan_max_splits=cfg.plan_max_splits,
+                                 plan_calibration_path=(
+                                     cfg.plan_calibration_path))
 
         # Tiered memory (ISSUE 8): a hot-row budget attaches the residency
         # manager and (with async on) the background demotion/promotion
@@ -931,9 +937,27 @@ class MemorySystem:
                     shed_depth=self.config.serve_shed_depth,
                     shed_bytes=self.config.serve_shed_bytes,
                     degrade_cap_take=self.config.serve_degrade_cap_take,
-                    degrade_nprobe=self.config.serve_degrade_nprobe)
+                    degrade_nprobe=self.config.serve_degrade_nprobe,
+                    admission_check=self._plan_admission)
                 self.query_scheduler = sched
         return sched
+
+    def _plan_admission(self, reqs) -> None:
+        """Scheduler admission probe (ISSUE 11): a submission whose
+        MINIMUM geometry — one pad bucket, maximal chunking — no split
+        can fit raises the typed ``PlanInfeasible`` before it queues
+        (shed like LoadShed; larger coalesced batches split fine, so
+        only the truly impossible are rejected here)."""
+        planner = self.index.planner
+        if planner is None or not planner.active \
+                or not self.index.id_to_row:
+            return
+        mode, k_bucket = self.index._serve_mode_hint(
+            self.config.retrieval_cap, reqs)
+        planner.check_feasible(
+            self.index._serve_geometry(1, mode, k_bucket),
+            chunkable=(self.index.serve_ragged
+                       and self.index.mesh is None))
 
     def _serve_requests(self, reqs: List[RetrievalRequest]):
         """Scheduler executor: ONE fused device dispatch + ONE packed
@@ -1455,6 +1479,37 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
         return new_nodes
 
     def _ingest_facts_dedup_fused(
+            self, staged: List[Tuple[Dict, str, np.ndarray]]
+    ) -> List[Tuple[str, str]]:
+        """Memory-safe entry of the device-dedup mega-batch ingest
+        (ISSUE 11): with a planner budget configured, the fact mega-batch
+        is admitted BEFORE building the dispatch — split into planned
+        sub-batches when its geometry would blow the HBM budget
+        (``plan.split_dispatches{path="ingest"}`` counts them; the
+        in-dispatch dedup probe keeps every sub-batch idempotent and
+        dedup-exact against already-landed facts — the one semantic
+        seam is that a chain edge cannot span a sub-batch boundary), or
+        rejected typed (``PlanInfeasible``) when no split fits. Planner
+        disabled = straight passthrough."""
+        n = len(staged)
+        planner = self.index.planner
+        if planner is not None and planner.active and n > 1:
+            d = self.index.plan_ingest(
+                n, link_k=self.config.cross_link_top_k)
+            if d.splits > 1:
+                per = -(-n // d.splits)
+                groups = [staged[i:i + per] for i in range(0, n, per)]
+                self.telemetry.bump("plan.planned_turns",
+                                    labels={"path": "ingest"})
+                self.telemetry.bump("plan.split_dispatches", len(groups),
+                                    labels={"path": "ingest"})
+                out: List[Tuple[str, str]] = []
+                for g in groups:
+                    out.extend(self._ingest_facts_dedup_fused_one(g))
+                return out
+        return self._ingest_facts_dedup_fused_one(staged)
+
+    def _ingest_facts_dedup_fused_one(
             self, staged: List[Tuple[Dict, str, np.ndarray]]
     ) -> List[Tuple[str, str]]:
         """Device-dedup mega-batch ingest (caller holds ``self._mutex``):

@@ -1264,7 +1264,7 @@ def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
                        tenant: jax.Array, gate_on: jax.Array,
                        boost_on: jax.Array, super_gate: jax.Array,
                        k: int, cap_take: int, max_nbr: int,
-                       k_q=None, cap_q=None):
+                       k_q=None, cap_q=None, scan_chunk: int = 0):
     """Per-chunk compute phase: the exact two-tier top-k core, the
     device-side gate verdict, and the CSR neighbor gather with per-query
     dedup. Returns sentinel-padded row lists for the scatter phase
@@ -1274,7 +1274,14 @@ def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
     ``k`` and ``cap_take`` become the static batch ceilings the compute
     runs to, and each query masks at its own top-k boundary
     (``_ragged_topk_mask``) — per-request shapes are data, not trace
-    constants."""
+    constants.
+
+    ``scan_chunk > 0`` (ISSUE 11) overrides the default ``QUERY_CHUNK``
+    streaming width: the HBM planner shrinks the ``[chunk, rows]`` score
+    tile — the dominant transient of the dispatch — to fit a throttled
+    budget WITHOUT splitting the turn. Results are bit-identical (the
+    per-query computation never sees the chunk boundary); only the
+    streaming granularity, and therefore the peak footprint, changes."""
     ragged = k_q is not None
 
     def chunk(q_c, valid_c, tenant_c, gate_c, boost_c, *rag):
@@ -1295,7 +1302,8 @@ def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q)
-    return chunked_map_multi(chunk, arrays)
+    return chunked_map_multi(chunk, arrays,
+                             chunk=(scan_chunk or QUERY_CHUNK))
 
 
 def _search_fused(
@@ -1504,12 +1512,13 @@ def _search_fused_quant_scan(state: ArenaState, q8a: jax.Array,
                              gate_on: jax.Array, boost_on: jax.Array,
                              super_gate: jax.Array, k: int, slack: int,
                              cap_take: int, max_nbr: int,
-                             k_q=None, cap_q=None):
+                             k_q=None, cap_q=None, scan_chunk: int = 0):
     """Quantized per-chunk compute phase: the int8 coarse-scan + exact
     rescore core, then the shared gate/CSR/boost tail. ``k_q``/``cap_q``
     make it ragged (see ``_search_fused_scan``): the coarse fetch and the
     exact rescore run to the static ceiling, the boundary mask is
-    per-query data."""
+    per-query data. ``scan_chunk`` is the planner's streaming-width
+    override (ISSUE 11; bit-identical, smaller score tile)."""
     ragged = k_q is not None
 
     def chunk(q_c, valid_c, tenant_c, gate_c, boost_c, *rag):
@@ -1530,7 +1539,8 @@ def _search_fused_quant_scan(state: ArenaState, q8a: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q)
-    return chunked_map_multi(chunk, arrays)
+    return chunked_map_multi(chunk, arrays,
+                             chunk=(scan_chunk or QUERY_CHUNK))
 
 
 def _search_fused_quant(
@@ -1707,7 +1717,8 @@ def _search_fused_tiered_scan(state: ArenaState, q8a: jax.Array,
                               tenant: jax.Array, gate_on: jax.Array,
                               boost_on: jax.Array, super_gate: jax.Array,
                               k: int, slack: int, cap_take: int,
-                              max_nbr: int, k_q=None, cap_q=None):
+                              max_nbr: int, k_q=None, cap_q=None,
+                              scan_chunk: int = 0):
     """Tiered per-chunk compute phase: the tier-aware two-stage core, then
     the shared gate/CSR/boost tail with cold-hit queries' boosts DEFERRED
     (suppressed exactly like the gate fast path — the host applies them in
@@ -1736,7 +1747,8 @@ def _search_fused_tiered_scan(state: ArenaState, q8a: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q)
-    return chunked_map_multi(chunk, arrays)
+    return chunked_map_multi(chunk, arrays,
+                             chunk=(scan_chunk or QUERY_CHUNK))
 
 
 def _search_fused_tiered(
@@ -1820,6 +1832,7 @@ def _search_fused_tiered_ragged(
     slack: int,
     cap_take: int,
     max_nbr: int,
+    scan_chunk: int = 0,
 ) -> Tuple[ArenaState, jax.Array]:
     """Tiered serving with the (k, cap) sidecar: each query's candidate
     window masks at its own k_i + slack boundary."""
@@ -1827,7 +1840,8 @@ def _search_fused_tiered_ragged(
         _search_fused_tiered_scan(state, q8a, scale_a, cold, csr_indptr,
                                   csr_nbr, q, q_valid, tenant, gate_on,
                                   boost_on, super_gate, k, slack, cap_take,
-                                  max_nbr, k_q=k_q, cap_q=cap_q)
+                                  max_nbr, k_q=k_q, cap_q=cap_q,
+                                  scan_chunk=scan_chunk)
     n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
     state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
                            nbr_boost)
@@ -1837,11 +1851,11 @@ def _search_fused_tiered_ragged(
 
 search_fused_tiered_ragged, search_fused_tiered_ragged_copy = _donated_pair(
     _search_fused_tiered_ragged,
-    static_argnames=("k", "slack", "cap_take", "max_nbr"))
+    static_argnames=("k", "slack", "cap_take", "max_nbr", "scan_chunk"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
-                                             "max_nbr"))
+                                             "max_nbr", "scan_chunk"))
 def search_fused_tiered_ragged_read(state: ArenaState, q8a: jax.Array,
                                     scale_a: jax.Array, cold: jax.Array,
                                     csr_indptr: jax.Array,
@@ -1850,13 +1864,14 @@ def search_fused_tiered_ragged_read(state: ArenaState, q8a: jax.Array,
                                     gate_on: jax.Array, k_q: jax.Array,
                                     super_gate: jax.Array, k: int,
                                     slack: int, cap_take: int,
-                                    max_nbr: int) -> jax.Array:
+                                    max_nbr: int,
+                                    scan_chunk: int = 0) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
     gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_tiered_scan(
         state, q8a, scale_a, cold, csr_indptr, csr_nbr, q, q_valid, tenant,
         gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr,
-        k_q=k_q, cap_q=cap_q)
+        k_q=k_q, cap_q=cap_q, scan_chunk=scan_chunk)
     return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
 
 
@@ -2100,7 +2115,7 @@ def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
                            boost_on: jax.Array, super_gate: jax.Array,
                            k: int, nprobe: int, slack: int, cap_take: int,
                            max_nbr: int, k_q=None, cap_q=None,
-                           nprobe_q=None):
+                           nprobe_q=None, scan_chunk: int = 0):
     """IVF per-chunk compute phase: the coarse-prefilter two-tier core,
     then the shared gate/CSR/boost tail. ``k_q``/``cap_q``/``nprobe_q``
     make it ragged: the gather and candidate scan run to the static
@@ -2128,7 +2143,9 @@ def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q, nprobe_q)
-    return chunked_map_multi(body, arrays, chunk=IVF_SERVE_CHUNK)
+    return chunked_map_multi(body, arrays,
+                             chunk=min(scan_chunk or IVF_SERVE_CHUNK,
+                                       IVF_SERVE_CHUNK))
 
 
 def _search_fused_ivf(
@@ -2235,13 +2252,15 @@ def _search_fused_ragged(
     k: int,                  # STATIC k ceiling (serve_k_max)
     cap_take: int,           # STATIC cap ceiling
     max_nbr: int,
+    scan_chunk: int = 0,     # planner streaming-width override (ISSUE 11)
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused`` with the per-query (k, cap) sidecar: ONE donated
     dispatch + ONE packed readback for a mixed-shape batch."""
     (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
         _search_fused_scan(state, csr_indptr, csr_nbr, q, q_valid, tenant,
                            gate_on, boost_on, super_gate, k, cap_take,
-                           max_nbr, k_q=k_q, cap_q=cap_q)
+                           max_nbr, k_q=k_q, cap_q=cap_q,
+                           scan_chunk=scan_chunk)
     n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
     state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
                            nbr_boost)
@@ -2250,23 +2269,27 @@ def _search_fused_ragged(
 
 
 search_fused_ragged, search_fused_ragged_copy = _donated_pair(
-    _search_fused_ragged, static_argnames=("k", "cap_take", "max_nbr"))
+    _search_fused_ragged, static_argnames=("k", "cap_take", "max_nbr",
+                                           "scan_chunk"))
 
 
-@functools.partial(jax.jit, static_argnames=("k", "cap_take", "max_nbr"))
+@functools.partial(jax.jit, static_argnames=("k", "cap_take", "max_nbr",
+                                             "scan_chunk"))
 def search_fused_ragged_read(state: ArenaState, csr_indptr: jax.Array,
                              csr_nbr: jax.Array, q: jax.Array,
                              q_valid: jax.Array, tenant: jax.Array,
                              gate_on: jax.Array, k_q: jax.Array,
                              super_gate: jax.Array, k: int, cap_take: int,
-                             max_nbr: int) -> jax.Array:
+                             max_nbr: int,
+                             scan_chunk: int = 0) -> jax.Array:
     """Read-only ragged twin (pure ``search_memories`` fleets): per-query
     k as data, no state mutation."""
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
     gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_scan(
         state, csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
-        super_gate, k, cap_take, max_nbr, k_q=k_q, cap_q=cap_q)
+        super_gate, k, cap_take, max_nbr, k_q=k_q, cap_q=cap_q,
+        scan_chunk=scan_chunk)
     return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
 
 
@@ -2291,6 +2314,7 @@ def _search_fused_quant_ragged(
     slack: int,
     cap_take: int,
     max_nbr: int,
+    scan_chunk: int = 0,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused_quant`` with the (k, cap) sidecar: the int8 coarse
     fetch and exact rescore run to the k ceiling, the boundary is data."""
@@ -2298,7 +2322,8 @@ def _search_fused_quant_ragged(
         _search_fused_quant_scan(state, q8a, scale_a, csr_indptr, csr_nbr,
                                  q, q_valid, tenant, gate_on, boost_on,
                                  super_gate, k, slack, cap_take, max_nbr,
-                                 k_q=k_q, cap_q=cap_q)
+                                 k_q=k_q, cap_q=cap_q,
+                                 scan_chunk=scan_chunk)
     n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
     state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
                            nbr_boost)
@@ -2308,11 +2333,11 @@ def _search_fused_quant_ragged(
 
 search_fused_quant_ragged, search_fused_quant_ragged_copy = _donated_pair(
     _search_fused_quant_ragged,
-    static_argnames=("k", "slack", "cap_take", "max_nbr"))
+    static_argnames=("k", "slack", "cap_take", "max_nbr", "scan_chunk"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
-                                             "max_nbr"))
+                                             "max_nbr", "scan_chunk"))
 def search_fused_quant_ragged_read(state: ArenaState, q8a: jax.Array,
                                    scale_a: jax.Array,
                                    csr_indptr: jax.Array,
@@ -2321,13 +2346,14 @@ def search_fused_quant_ragged_read(state: ArenaState, q8a: jax.Array,
                                    gate_on: jax.Array, k_q: jax.Array,
                                    super_gate: jax.Array, k: int,
                                    slack: int, cap_take: int,
-                                   max_nbr: int) -> jax.Array:
+                                   max_nbr: int,
+                                   scan_chunk: int = 0) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
     gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_quant_scan(
         state, q8a, scale_a, csr_indptr, csr_nbr, q, q_valid, tenant,
         gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr,
-        k_q=k_q, cap_q=cap_q)
+        k_q=k_q, cap_q=cap_q, scan_chunk=scan_chunk)
     return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
 
 
@@ -2356,6 +2382,7 @@ def _search_fused_ivf_ragged(
     slack: int,
     cap_take: int,
     max_nbr: int,
+    scan_chunk: int = 0,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused_ivf`` with the (k, cap, nprobe) sidecar: the member
     gather visits the ceiling probe width, each query masks candidates
@@ -2365,7 +2392,8 @@ def _search_fused_ivf_ragged(
                                csr_indptr, csr_nbr, q, q_valid, tenant,
                                gate_on, boost_on, super_gate, k, nprobe,
                                slack, cap_take, max_nbr, k_q=k_q,
-                               cap_q=cap_q, nprobe_q=nprobe_q)
+                               cap_q=cap_q, nprobe_q=nprobe_q,
+                               scan_chunk=scan_chunk)
     n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
     state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
                            nbr_boost)
@@ -2375,11 +2403,13 @@ def _search_fused_ivf_ragged(
 
 search_fused_ivf_ragged, search_fused_ivf_ragged_copy = _donated_pair(
     _search_fused_ivf_ragged,
-    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr"))
+    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr",
+                     "scan_chunk"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
-                                             "cap_take", "max_nbr"))
+                                             "cap_take", "max_nbr",
+                                             "scan_chunk"))
 def search_fused_ivf_ragged_read(state: ArenaState, shadow,
                                  centroids: jax.Array, members: jax.Array,
                                  extras: jax.Array, csr_indptr: jax.Array,
@@ -2388,14 +2418,15 @@ def search_fused_ivf_ragged_read(state: ArenaState, shadow,
                                  gate_on: jax.Array, k_q: jax.Array,
                                  nprobe_q: jax.Array,
                                  super_gate: jax.Array, k: int, nprobe: int,
-                                 slack: int, cap_take: int, max_nbr: int
-                                 ) -> jax.Array:
+                                 slack: int, cap_take: int, max_nbr: int,
+                                 scan_chunk: int = 0) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
     gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = _search_fused_ivf_scan(
         state, shadow, centroids, members, extras, csr_indptr, csr_nbr, q,
         q_valid, tenant, gate_on, boost_off, super_gate, k, nprobe, slack,
-        cap_take, max_nbr, k_q=k_q, cap_q=cap_q, nprobe_q=nprobe_q)
+        cap_take, max_nbr, k_q=k_q, cap_q=cap_q, nprobe_q=nprobe_q,
+        scan_chunk=scan_chunk)
     return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
 
 

@@ -51,8 +51,13 @@ from lazzaro_tpu.core.index import (build_host_csr, link_pool_dev,
                                     link_pool_size, split_csr)
 from lazzaro_tpu.ops.topk import make_sharded_topk
 from lazzaro_tpu.parallel.mesh import shard_stacked
-from lazzaro_tpu.reliability.errors import ArenaPoisoned
-from lazzaro_tpu.reliability.guard import check_not_poisoned, run_guarded
+from lazzaro_tpu.plan import Geometry, HbmPlanner
+from lazzaro_tpu.reliability import faults
+from lazzaro_tpu.reliability.errors import (ArenaPoisoned, DeviceOom,
+                                            PlanInfeasible)
+from lazzaro_tpu.reliability.guard import (check_not_poisoned,
+                                           is_resource_exhausted,
+                                           run_guarded)
 from lazzaro_tpu.utils.batching import (LRUKernelCache, bucket_size,
                                         decode_topk, empty_results,
                                         fetch_packed, next_pow2,
@@ -93,7 +98,12 @@ class ShardedMemoryIndex:
                  serve_k_max: int = 128, serve_pad_granularity: int = 8,
                  serve_kernel_cache_max: int = 8,
                  edge_capacity: int = 1 << 17,
-                 ingest_fused: bool = True):
+                 ingest_fused: bool = True,
+                 hbm_budget_bytes: int = 0,
+                 hbm_headroom_fraction: float = 0.1,
+                 plan_max_splits: int = 16,
+                 plan_calibration_path: Optional[str] = None,
+                 planner: Optional[HbmPlanner] = None):
         self.mesh = mesh
         # Serving telemetry (ISSUE 6): same registry contract as
         # MemoryIndex — spans per dispatch, device counters decoded from
@@ -102,6 +112,18 @@ class ShardedMemoryIndex:
             else default_registry()
         self.telemetry_hbm = bool(telemetry_hbm)
         self._hbm_recorded: set = set()
+        # Admission-time HBM planner (ISSUE 11): same contract as
+        # MemoryIndex — the pod path admits fused, splits the query batch
+        # into planned sub-dispatches, or rejects typed. (The distributed
+        # kernels keep their built-in chunk structure; the scan-chunk
+        # override is a single-chip degradation rung.)
+        self.planner = planner if planner is not None else HbmPlanner(
+            budget_bytes=hbm_budget_bytes,
+            headroom_fraction=hbm_headroom_fraction,
+            telemetry=self.telemetry,
+            granularity=max(1, int(serve_pad_granularity)),
+            max_splits=plan_max_splits,
+            calibration_path=plan_calibration_path)
         self.dispatch_count = 0
         self.axis = axis
         self.dim = dim
@@ -431,6 +453,12 @@ class ShardedMemoryIndex:
                      "chains": [], "counters": {}}
         if n == 0:
             return out_empty
+        if self.planner is not None and self.planner.active:
+            # admission gate (ISSUE 11): typed rejection BEFORE rows or
+            # edge slots are allocated; mega-batch splitting happens at
+            # the coalescer drain via ``plan_ingest``
+            self.planner.check_feasible(
+                self._ingest_geometry(n, link_k), chunkable=False)
         for node_id in ids:
             if node_id in self.id_to_row:
                 raise ValueError(f"ingest() requires fresh ids: {node_id!r}")
@@ -784,6 +812,22 @@ class ShardedMemoryIndex:
                          "pool_slots_used": 0, "overflow": False},
         }
 
+    def _ingest_geometry(self, n: int, link_k: int = 3) -> Geometry:
+        return Geometry(
+            kind="ingest", mode="ingest", batch=max(1, int(n)),
+            rows=self.capacity + 1, dim=self.dim,
+            k=max(1, int(link_k)),
+            dtype_bytes=int(np.dtype(self.dtype).itemsize),
+            mesh_parts=self.n_parts, edge_cap=self.edge_capacity,
+            link_k=max(1, int(link_k)))
+
+    def plan_ingest(self, n: int, link_k: int = 3):
+        """Pod twin of ``MemoryIndex.plan_ingest`` (ISSUE 11): admission
+        decision for an ``n``-fact distributed ingest mega-batch; raises
+        the typed :class:`PlanInfeasible` when no split fits."""
+        return self.planner.check_feasible(
+            self._ingest_geometry(n, link_k), chunkable=False)
+
     def _maybe_record_ingest_hbm(self, kern, dev_args, with_shadow: bool,
                                  b: int) -> None:
         """Opt-in peak-HBM gauge for one pod ingest-kernel geometry
@@ -812,6 +856,7 @@ class ShardedMemoryIndex:
                 labels={"path": "ingest", "batch": str(b),
                         "rows": str(self.capacity + 1),
                         "mesh": f"{self.n_parts}x{self.axis}"})
+            self.planner.observe_gauge(self._ingest_geometry(b), peak)
 
     def warmup_ingest(self, geometries=(256,), *, dedup_gate: float = 0.95,
                       link_k: int = 3) -> Dict[int, float]:
@@ -828,6 +873,17 @@ class ShardedMemoryIndex:
                                          self.capacity))
                           for g in geometries if g > 0})
         for g in buckets:
+            if self.planner is not None and self.planner.active:
+                # planner compile gate (ISSUE 11): skip geometries the
+                # admission path would refuse; warm the planned sub-batch
+                try:
+                    d = self.plan_ingest(g, link_k=link_k)
+                except PlanInfeasible:
+                    tel.bump("plan.warmup_skipped",
+                             labels={"path": "ingest"})
+                    continue
+                if d.splits > 1:
+                    g = max(1, -(-g // d.splits))
             t0 = time.perf_counter()
             prev = tel.enabled
             tel.enabled = False
@@ -1146,7 +1202,113 @@ class ShardedMemoryIndex:
                                  labels={"surface": "pod_fused"})
         return kern
 
+    def _serve_mode_hint(self, reqs) -> Tuple[str, int]:
+        """Cheap (mode, k-ceiling) prediction of the pod dispatch's
+        routing — the planner's geometry key (mirror of
+        ``MemoryIndex._serve_mode_hint``)."""
+        ragged = self.serve_ragged and self.serve_fused
+        if ragged:
+            k_bucket = int(min(max(self.serve_k_max, self.cap_take, 1),
+                               self.capacity))
+        else:
+            k_req = max((min(int(r.k), self.capacity) for r in reqs),
+                        default=1)
+            k_bucket = min(max(next_pow2(max(self.cap_take, k_req, 1)), 1),
+                           self.capacity)
+        tm = self.tiering
+        if tm is not None and tm.cold_count > 0:
+            return "sharded_tiered", k_bucket
+        if self._ivf is not None and self.serve_fused:
+            return "sharded_ivf", k_bucket
+        if self.int8_serving:
+            return "sharded_quant", k_bucket
+        return "sharded_exact", k_bucket
+
+    def _serve_geometry(self, nq: int, mode: str, k_bucket: int) -> Geometry:
+        ragged = self.serve_ragged and self.serve_fused
+        pad_n = (bucket_size(nq, self.serve_pad_granularity) if ragged
+                 else next_pow2(nq))
+        return Geometry(
+            kind="serve", mode=mode, batch=pad_n, rows=self.capacity + 1,
+            dim=self.dim, k=k_bucket,
+            dtype_bytes=int(np.dtype(self.dtype).itemsize),
+            mesh_parts=self.n_parts, edge_cap=self.edge_capacity,
+            nprobe=int(self._ivf[3] if self._ivf is not None else 0))
+
     def serve_requests(self, reqs) -> List:
+        """Memory-safe entry point of the pod serving path (ISSUE 11):
+        the distributed geometry is ADMITTED against the HBM planner
+        before anything compiles — fused single distributed dispatch when
+        the prediction fits, PLANNED sub-dispatches riding the linear pad
+        buckets when it doesn't, typed :class:`PlanInfeasible` when no
+        split fits; a runtime ``RESOURCE_EXHAUSTED`` gets ONE replan
+        through the copy twins. Planner disabled (default) = zero-overhead
+        passthrough. See :meth:`_serve_requests_once` for the dispatch."""
+        nq = len(reqs)
+        planner = self.planner
+        if (nq == 0 or planner is None or not planner.active
+                or not self.id_to_row):
+            try:
+                return self._serve_requests_once(reqs)
+            except DeviceOom:
+                raise
+            except Exception as e:  # noqa: BLE001 — typed OOM, uniform
+                if not is_resource_exhausted(e):
+                    raise
+                self.telemetry.bump("reliability.oom",
+                                    labels={"mode": "serve_pod"})
+                raise DeviceOom(
+                    f"pod serving dispatch exhausted device memory and "
+                    f"no planner budget is configured to replan it: {e}"
+                ) from e
+        check_not_poisoned(self._poisoned)
+        mode, k_bucket = self._serve_mode_hint(reqs)
+        geom = self._serve_geometry(nq, mode, k_bucket)
+        decision = planner.check_feasible(geom, chunkable=False)
+        return self._serve_planned(reqs, geom, decision, replanned=False)
+
+    def _serve_planned(self, reqs, geom, decision,
+                       replanned: bool) -> List:
+        tel = self.telemetry
+        n = len(reqs)
+        splits = max(1, min(decision.splits, n))
+        per = -(-n // splits)
+        groups = [reqs[i:i + per] for i in range(0, n, per)]
+        if len(groups) > 1:
+            tel.bump("plan.planned_turns", labels={"path": "serve"})
+            tel.bump("plan.split_dispatches", len(groups),
+                     labels={"path": "serve"})
+        out: List = []
+        done = 0
+        try:
+            for g in groups:
+                out.extend(self._serve_requests_once(
+                    g, force_copy=replanned))
+                done += len(g)
+        except Exception as e:      # noqa: BLE001 — OOM-only replan below
+            if not is_resource_exhausted(e):
+                raise
+            if replanned:
+                tel.bump("plan.infeasible", labels={"path": "serve"})
+                raise PlanInfeasible(
+                    f"replanned pod dispatch still exhausted device "
+                    f"memory (mode={geom.mode}, batch={geom.batch}): "
+                    f"{e}") from e
+            self.planner.note_oom(geom)
+            harder = self.planner.replan_after_oom(geom, decision,
+                                                   chunkable=False)
+            if harder is None:
+                tel.bump("plan.infeasible", labels={"path": "serve"})
+                raise PlanInfeasible(
+                    f"pod dispatch exhausted device memory and no harder "
+                    f"split fits (mode={geom.mode}, batch={geom.batch})"
+                ) from e
+            tel.bump("plan.oom_replans", labels={"path": "serve"})
+            out.extend(self._serve_planned(reqs[done:], geom, harder,
+                                           replanned=True))
+        return out
+
+    def _serve_requests_once(self, reqs, force_copy: bool = False) -> List:
         """``serve.QueryScheduler`` executor for the pod-sharded path: one
         coalesced batch of :class:`serve.RetrievalRequest`s becomes ONE
         distributed dispatch + ONE packed readback running the FULL
@@ -1273,13 +1435,17 @@ class ShardedMemoryIndex:
             read_extra = (jnp.float32(self.super_gate),)
         self._maybe_record_hbm(mode, kern, args, k_bucket,
                                read_extra=read_extra, ragged=ragged)
+        # Fault point "plan.oom" (ISSUE 11): an HBM allocation failure the
+        # admission plan missed; serve_requests answers with one replan.
+        faults.fire("plan.oom", mode=f"pod_{mode}", batch=pad_n)
         t0 = time.perf_counter()
         with trace_annotation(f"lz.serve.pod_{mode}"):
             if boost_on.any():
                 now_rel = time.time() - self.epoch
                 with self._state_lock:
                     cur = self._arena
-                    sole = sys.getrefcount(cur) <= self._SOLE_REFS
+                    sole = (not force_copy
+                            and sys.getrefcount(cur) <= self._SOLE_REFS)
                     boost_extra = ((jnp.asarray(padb(boost_on)), k_dev,
                                     capq_dev, npq_dev) if ragged
                                    else (jnp.asarray(padb(boost_on)),))
@@ -1356,7 +1522,17 @@ class ShardedMemoryIndex:
                 "kernel.peak_hbm_bytes", peak,
                 labels={"mode": f"pod_{mode}", "k": str(k_bucket),
                         "rows": str(self.capacity + 1),
+                        "batch": str(int(args[3].shape[0])),
                         "mesh": f"{self.n_parts}x{self.axis}"})
+            self.planner.observe_gauge(
+                Geometry(kind="serve", mode=f"pod_{mode}",
+                         batch=int(args[3].shape[0]),
+                         rows=self.capacity + 1, dim=self.dim,
+                         k=int(k_bucket),
+                         dtype_bytes=int(np.dtype(self.dtype).itemsize),
+                         mesh_parts=self.n_parts,
+                         edge_cap=self.edge_capacity),
+                peak)
 
     def warmup_serving(self, geometries=(8, 64),
                        k: Optional[int] = None) -> Dict[tuple, float]:
@@ -1388,6 +1564,9 @@ class ShardedMemoryIndex:
             prev = tel.enabled
             tel.enabled = False
             try:
+                # routed through the planner-gated entry (ISSUE 11): a
+                # planned-split geometry warms its sub-dispatch kernels,
+                # an infeasible one is skipped typed
                 self.serve_requests(
                     [RetrievalRequest(query=zero_q, tenant="~warmup", k=kk,
                                       gate_enabled=True, boost=(i == 0))
@@ -1396,6 +1575,10 @@ class ShardedMemoryIndex:
                     [RetrievalRequest(query=zero_q, tenant="~warmup", k=kk,
                                       gate_enabled=True)
                      for i in range(g)])
+            except PlanInfeasible:
+                tel.enabled = prev
+                tel.bump("plan.warmup_skipped", labels={"path": "serve"})
+                continue
             finally:
                 tel.enabled = prev
             ms = (time.perf_counter() - t0) * 1e3

@@ -1,0 +1,29 @@
+"""Admission-time HBM planning for the fused serving/ingest stack
+(ISSUE 11, ROADMAP item 9).
+
+``scripts/check_hbm_budget.py`` used to *observe* compiled geometries and
+fail CI after the fact; a novel (mode × batch × rows × mesh) request
+still OOM'd at runtime with no recovery path. This package makes the
+bound a guarantee instead ("Memory Safe Computations with XLA",
+PAPERS.md):
+
+- :mod:`~lazzaro_tpu.plan.model` — analytic peak-HBM cost model,
+  calibrated against the AOT ``memory_analysis()`` gauges so predictions
+  over-bound every recorded measurement (residuals persisted beside the
+  kernel-cache artifacts for the CI soundness sweep). Pure stdlib, so
+  the CI gate imports it without jax.
+- :mod:`~lazzaro_tpu.plan.planner` — the live
+  :class:`~lazzaro_tpu.plan.planner.HbmPlanner` every compile gate
+  consults: admit fused, chunk the arena scan in-dispatch, split the
+  query batch into PLANNED sub-dispatches (``plan.split_dispatches``
+  counted — never silent), or reject typed (``PlanInfeasible``). Runtime
+  ``RESOURCE_EXHAUSTED`` (reclassified by ``guard.run_guarded``) feeds
+  back through ``note_oom`` → one replan through the copy twins.
+"""
+
+from lazzaro_tpu.plan.model import (CostModel, Geometry, PlanDecision,
+                                    plan_geometry)
+from lazzaro_tpu.plan.planner import HbmPlanner
+
+__all__ = ["CostModel", "Geometry", "PlanDecision", "plan_geometry",
+           "HbmPlanner"]

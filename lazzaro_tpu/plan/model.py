@@ -1,0 +1,327 @@
+"""Analytic peak-HBM cost model for the fused serving/ingest geometries.
+
+"Memory Safe Computations with XLA" (PAPERS.md) argues the memory bound
+should be *guaranteed* before compilation, not discovered as a runtime
+``RESOURCE_EXHAUSTED``. This module is the prediction half of that
+guarantee: given a geometry — (kind × mode × batch × rows × k × mesh) —
+it computes an analytic upper bound on the compiled program's peak HBM
+from buffer accounting of what the fused kernels actually allocate:
+
+- the RESIDENT live set every dispatch carries (arena columns + int8
+  shadow + IVF tables + edge arena + CSR),
+- the TRANSIENT high-water mark of the scan itself, dominated by the
+  ``[min(batch, scan_chunk), rows]`` f32 score tile the chunked-map
+  structure bounds (``ops/chunking.py``), plus query/readback/top-k
+  workspace terms linear in the batch.
+
+The model is deliberately conservative and then CALIBRATED against the
+measured truth: every AOT ``memory_analysis()`` gauge the PR 6/PR 9
+machinery records (``kernel.peak_hbm_bytes{...}``) is fed back through
+:meth:`CostModel.observe`, which inflates the per-(kind, mode) safety
+multiplier until the prediction over-bounds every recorded gauge. The
+multipliers and the residual log persist as JSON beside the kernel-cache
+artifacts (``bench_artifacts/plan_calibration.json`` by default), so CI
+(``scripts/check_hbm_budget.py``) re-checks model soundness — a gauge
+exceeding its prediction fails the gate — without recompiling anything.
+
+Pure stdlib on purpose: the CI gate loads this file directly
+(``importlib`` by path) so the budget sweep never pays a jax import.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import asdict, dataclass
+from typing import Dict, Optional
+
+# Mirrors ops/chunking.QUERY_CHUNK and core/state.IVF_SERVE_CHUNK; kept as
+# literals so this module stays importable without jax. A drift here only
+# loosens/tightens the bound — soundness is restored by calibration.
+QUERY_CHUNK = 512
+IVF_SERVE_CHUNK = 32
+
+# Per-row bytes of the non-embedding arena columns (salience, timestamp,
+# last_accessed f32; access_count, type_id, shard_id, tenant_id i32;
+# alive, is_super bool — padded to 4 for alignment conservatism).
+ARENA_META_BYTES = 7 * 4 + 2 * 4
+# Per-slot bytes of the edge arena (src, tgt i32; weight f32; co i32;
+# last_updated f32; alive bool→4; tenant_id i32).
+EDGE_SLOT_BYTES = 7 * 4
+
+# Default safety multipliers per (kind, mode-family). XLA's compiled peak
+# includes fusion temporaries and layout padding the analytic terms can't
+# see; these start conservative and only ever grow under calibration.
+_DEFAULT_MULTIPLIER = 1.25
+
+
+@dataclass(frozen=True)
+class Geometry:
+    """One fused-dispatch geometry the planner reasons about.
+
+    ``rows`` is the GLOBAL padded arena length (capacity + sentinel);
+    ``mesh_parts`` divides it into the per-chip slice the shard-local
+    cores scan. ``batch`` is the PADDED query (or fact) batch.
+    ``scan_chunk = 0`` means the kernel's default chunk structure
+    (``QUERY_CHUNK``, or ``IVF_SERVE_CHUNK`` for the IVF gather)."""
+
+    kind: str = "serve"          # "serve" | "ingest"
+    mode: str = "exact"          # exact | quant | ivf | tiered
+    batch: int = 8
+    rows: int = 1024
+    dim: int = 768
+    k: int = 128
+    dtype_bytes: int = 4         # master-arena embedding dtype
+    mesh_parts: int = 1
+    edge_cap: int = 0
+    nprobe: int = 0
+    scan_chunk: int = 0
+    link_k: int = 3              # ingest link-scan width per shard mode
+
+    def with_(self, **kw) -> "Geometry":
+        d = asdict(self)
+        d.update(kw)
+        return Geometry(**d)
+
+
+def _mode_family(mode: str) -> str:
+    """Collapse pod/sharded prefixes onto the core scan family — the
+    calibration multiplier is per family, the rows-per-chip term already
+    carries the mesh geometry."""
+    m = mode.replace("sharded_", "").replace("pod_", "")
+    if m.startswith("ivf"):
+        return "ivf"
+    return m if m in ("exact", "quant", "tiered", "ingest") else "exact"
+
+
+class CostModel:
+    """Analytic buffer accounting + per-(kind, family) calibrated
+    multipliers. ``predict`` returns an over-bounding byte estimate;
+    ``observe`` folds a measured AOT gauge back in, growing the
+    multiplier whenever the measurement beats the analytic bound."""
+
+    def __init__(self, multipliers: Optional[Dict[str, float]] = None):
+        self.multipliers: Dict[str, float] = dict(multipliers or {})
+        # (geometry-ish key) -> {"predicted": .., "observed": ..} of every
+        # observe() call — the residual log CI checks and bench persists.
+        self.residuals: Dict[str, Dict[str, float]] = {}
+
+    # ------------------------------------------------------------- predict
+    def _mult(self, kind: str, mode: str) -> float:
+        return self.multipliers.get(f"{kind}:{_mode_family(mode)}",
+                                    _DEFAULT_MULTIPLIER)
+
+    def resident_bytes(self, g: Geometry) -> int:
+        """Per-chip resident live set: every dispatch carries the whole
+        of it regardless of batch, so no split can shrink it — this is
+        the feasibility floor."""
+        rows_pc = -(-g.rows // max(1, g.mesh_parts))
+        fam = _mode_family(g.mode)
+        total = rows_pc * (g.dim * g.dtype_bytes + ARENA_META_BYTES)
+        if fam in ("quant", "tiered", "ivf") or g.kind == "ingest":
+            # int8 shadow codes + f32 scales (maintained in-kernel by the
+            # fused ingest; streamed by every coarse stage). The exact
+            # serve mode carries none, but ingest always may.
+            if fam != "exact" or g.kind == "ingest":
+                total += rows_pc * (g.dim + 4)
+        if fam == "tiered":
+            total += rows_pc            # residency mask (bool→byte)
+        if fam == "ivf":
+            # centroids (replicated) + member/extras tables ~ one int32
+            # routing entry per row plus the centroid block
+            n_cent = max(1, int(math.sqrt(g.rows)))
+            total += n_cent * g.dim * 4 + rows_pc * 8
+        total += g.edge_cap * EDGE_SLOT_BYTES
+        # CSR shadow (indptr + neighbor pool ≈ 2 entries/edge, i32)
+        total += (rows_pc + 2) * 4 + 2 * g.edge_cap * 4
+        return int(total)
+
+    def transient_bytes(self, g: Geometry) -> int:
+        """Scan high-water mark: the chunk-bounded score tile plus the
+        batch-linear query/readback/top-k terms. THIS is what batch
+        splitting and scan chunking shrink."""
+        rows_pc = -(-g.rows // max(1, g.mesh_parts))
+        fam = _mode_family(g.mode)
+        default_chunk = IVF_SERVE_CHUNK if fam == "ivf" else QUERY_CHUNK
+        chunk = min(g.batch, g.scan_chunk or default_chunk)
+        chunk = max(1, chunk)
+        if fam == "ivf":
+            # the gather footprint: [chunk, nprobe·M + extras, d] f32
+            # candidate block; M ≈ rows/√rows member slots per cluster
+            n_cent = max(1, int(math.sqrt(g.rows)))
+            m = -(-g.rows // n_cent)
+            cands = max(1, g.nprobe or 4) * m + g.k
+            tile = chunk * cands * (g.dim + 2) * 4
+        elif fam == "ingest":
+            # the multi-mode link/dedup scan streams [chunk, rows] f32
+            # once (PR 9 single-stream refactor) + candidate triples
+            tile = chunk * (rows_pc + 1) * 4 \
+                + chunk * max(1, g.link_k) * 3 * 4 * 2
+        else:
+            # dense scan: [chunk, rows] f32 scores + the two mask tiles
+            # and the top-k workspace XLA materializes beside them
+            tile = chunk * (rows_pc + 1) * 4 * 3
+        q_bytes = g.batch * g.dim * 4 * 2              # query + normalized
+        readback = g.batch * (3 + 2 * g.k + 4) * 4 * 2
+        sidecars = g.batch * 4 * 6                     # k/cap/nprobe/flags
+        return int(tile + q_bytes + readback + sidecars)
+
+    def predict(self, g: Geometry) -> int:
+        """Calibrated upper bound on the compiled program's peak HBM."""
+        raw = self.resident_bytes(g) + self.transient_bytes(g)
+        return int(raw * self._mult(g.kind, g.mode))
+
+    # ----------------------------------------------------------- calibrate
+    @staticmethod
+    def _res_key(g: Geometry) -> str:
+        return (f"{g.kind}:{g.mode}:b{g.batch}:r{g.rows}:k{g.k}"
+                f":m{g.mesh_parts}")
+
+    def observe(self, g: Geometry, measured_bytes: float) -> bool:
+        """Fold one measured AOT ``memory_analysis()`` peak back in.
+        Returns True when the prediction already over-bounded it; False
+        means the multiplier was GROWN so it does now (with 5% margin) —
+        predictions must over-bound every recorded gauge."""
+        measured = float(measured_bytes)
+        predicted = self.predict(g)
+        self.residuals[self._res_key(g)] = {
+            "predicted": float(predicted), "observed": measured,
+            "ratio": round(measured / max(predicted, 1.0), 4)}
+        if measured <= predicted:
+            return True
+        raw = self.resident_bytes(g) + self.transient_bytes(g)
+        key = f"{g.kind}:{_mode_family(g.mode)}"
+        self.multipliers[key] = max(
+            self.multipliers.get(key, _DEFAULT_MULTIPLIER),
+            measured / max(raw, 1.0) * 1.05)
+        return False
+
+    def inflate(self, g: Geometry, factor: float = 2.0) -> None:
+        """Post-OOM learning: the geometry OOM'd although the prediction
+        said it fit, so the analytic bound under-estimated — grow the
+        family multiplier until this geometry predicts ≥ factor × its
+        previous estimate. The next plan for the same family will split
+        harder (or declare infeasibility) instead of re-OOMing."""
+        key = f"{g.kind}:{_mode_family(g.mode)}"
+        self.multipliers[key] = \
+            self.multipliers.get(key, _DEFAULT_MULTIPLIER) * float(factor)
+
+    # -------------------------------------------------------------- persist
+    def to_dict(self) -> dict:
+        return {"multipliers": dict(self.multipliers),
+                "residuals": dict(self.residuals)}
+
+    def save(self, path: str) -> None:
+        """Persist calibration beside the kernel-cache artifacts (atomic
+        replace; the CI sweep and the next process both load it)."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "CostModel":
+        with open(path) as f:
+            data = json.load(f)
+        model = cls(multipliers=data.get("multipliers") or {})
+        model.residuals = dict(data.get("residuals") or {})
+        return model
+
+    @classmethod
+    def load_or_default(cls, path: Optional[str]) -> "CostModel":
+        if path:
+            try:
+                return cls.load(path)
+            except (OSError, ValueError):
+                pass
+        return cls()
+
+
+@dataclass(frozen=True)
+class PlanDecision:
+    """What the planner decided for one geometry: run it fused
+    (``splits == 1, scan_chunk == 0``), chunk the arena scan inside the
+    ONE dispatch, split the batch into ``splits`` planned sub-dispatches,
+    or reject it (``feasible == False``)."""
+
+    feasible: bool
+    splits: int = 1
+    scan_chunk: int = 0
+    predicted_bytes: int = 0
+    budget_bytes: int = 0
+    reason: str = "fits"
+
+    @property
+    def fused(self) -> bool:
+        return self.feasible and self.splits == 1 and self.scan_chunk == 0
+
+
+def _bucket(n: int, granularity: int) -> int:
+    g = max(1, granularity)
+    return max(g, -(-n // g) * g)
+
+
+def plan_geometry(model: CostModel, g: Geometry, budget_bytes: int,
+                  headroom_fraction: float = 0.1, *,
+                  chunkable: bool = True, granularity: int = 8,
+                  max_splits: int = 16, min_scan_chunk: int = 8
+                  ) -> PlanDecision:
+    """The split decision tree (shared by the live planner and the CI
+    sweep), cheapest-degradation-first:
+
+    1. **fused** — the geometry fits as-is: ONE dispatch, default chunks.
+    2. **chunk the scan** — halve the in-kernel query chunk (the
+       ``[chunk, rows]`` score tile is the dominant transient) until the
+       prediction fits: STILL one dispatch, ``dispatches_per_turn`` stays
+       1, only the streaming granularity changes (bit-identical results).
+    3. **split the batch** — sub-dispatches riding the existing linear
+       pad buckets (each sub-batch re-buckets to ``granularity``),
+       combined with the best scan chunk; a planned multi-dispatch turn,
+       recorded as such.
+    4. **infeasible** — the per-chip RESIDENT set alone (which no split
+       can shrink) or even the maximally-split geometry exceeds the
+       budget: typed rejection, shed like LoadShed.
+    """
+    if budget_bytes <= 0:
+        return PlanDecision(True, 1, 0, model.predict(g), 0,
+                            "planner disabled")
+    eff = int(budget_bytes * (1.0 - max(0.0, headroom_fraction)))
+    pred = model.predict(g)
+    if pred <= eff:
+        return PlanDecision(True, 1, 0, pred, eff, "fits")
+    # The resident floor bounds what ANY split can reach.
+    floor = int(model.resident_bytes(g) * model._mult(g.kind, g.mode))
+    if floor > eff:
+        return PlanDecision(False, 0, 0, floor, eff,
+                            "resident live set alone exceeds the budget")
+    fam = _mode_family(g.mode)
+    default_chunk = IVF_SERVE_CHUNK if fam == "ivf" else QUERY_CHUNK
+    best_chunk = 0
+    if chunkable:
+        c = min(g.batch, default_chunk)
+        while c >= min_scan_chunk:
+            p = model.predict(g.with_(scan_chunk=c))
+            if p <= eff:
+                return PlanDecision(True, 1, c, p, eff, "scan chunked")
+            best_chunk = c
+            c //= 2
+        best_chunk = max(min_scan_chunk, best_chunk // 2 or min_scan_chunk)
+    for s in range(2, max_splits + 1):
+        sub = _bucket(-(-g.batch // s), granularity)
+        sg = g.with_(batch=sub,
+                     scan_chunk=(min(best_chunk, sub) if chunkable else 0))
+        p = model.predict(sg)
+        if p <= eff:
+            return PlanDecision(True, s, sg.scan_chunk, p, eff,
+                                f"batch split {s}-way")
+        if sub <= granularity:
+            break                       # can't split finer than one bucket
+    return PlanDecision(False, 0, 0, pred, eff,
+                        "no batch split or scan chunk fits the budget")
+
+
+__all__ = ["Geometry", "CostModel", "PlanDecision", "plan_geometry",
+           "QUERY_CHUNK", "IVF_SERVE_CHUNK", "ARENA_META_BYTES",
+           "EDGE_SLOT_BYTES"]

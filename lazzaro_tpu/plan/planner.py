@@ -1,0 +1,183 @@
+"""Admission-time HBM planner: every fused geometry clears it BEFORE a
+kernel compiles or a dispatch launches.
+
+The planner owns three decisions (``plan/model.plan_geometry`` is the
+shared decision tree; this class adds telemetry, calibration plumbing,
+and the OOM-replan protocol):
+
+- **admit** — predict the geometry's peak HBM; if it fits the budget
+  minus headroom, the turn stays the usual ONE fused dispatch.
+- **degrade planned** — otherwise chunk the arena scan inside the one
+  dispatch (cheapest: still ``dispatches_per_turn == 1``), or split the
+  query batch into planned sub-dispatches riding the existing linear pad
+  buckets (``plan.split_dispatches`` counts them — a planned
+  multi-dispatch turn is recorded, never silent).
+- **reject typed** — a geometry no split can fit raises
+  :class:`~lazzaro_tpu.reliability.errors.PlanInfeasible` (shed like
+  ``LoadShed``; futures resolve with it, never hang).
+
+When a dispatch still dies with ``RESOURCE_EXHAUSTED`` (the model
+under-bounded — ``guard.run_guarded`` reclassifies it into the typed
+``DeviceOom`` instead of burning retries), :meth:`note_oom` inflates the
+model's family multiplier so the same geometry now predicts over budget,
+and :meth:`replan_after_oom` hands the caller ONE harder decision (more
+splits / smaller chunk) to retry through the copy twins.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from lazzaro_tpu.plan.model import (CostModel, Geometry, PlanDecision,
+                                    plan_geometry)
+
+
+class HbmPlanner:
+    """One planner per index (single-chip or pod), sharing the index's
+    telemetry registry. ``budget_bytes == 0`` disables it — every
+    geometry admits fused, zero overhead on the hot path."""
+
+    def __init__(self, budget_bytes: int = 0,
+                 headroom_fraction: float = 0.1,
+                 model: Optional[CostModel] = None,
+                 telemetry=None, granularity: int = 8,
+                 max_splits: int = 16, min_scan_chunk: int = 8,
+                 calibration_path: Optional[str] = None):
+        self.budget_bytes = max(0, int(budget_bytes))
+        self.headroom_fraction = min(0.9, max(0.0,
+                                              float(headroom_fraction)))
+        self.calibration_path = calibration_path
+        self.model = model if model is not None \
+            else CostModel.load_or_default(calibration_path)
+        self.telemetry = telemetry
+        self.granularity = max(1, int(granularity))
+        self.max_splits = max(1, int(max_splits))
+        self.min_scan_chunk = max(1, int(min_scan_chunk))
+        self._lock = threading.Lock()
+        self._cache: Dict[tuple, PlanDecision] = {}
+        self.decisions = 0
+        self.oom_noted = 0
+
+    # ----------------------------------------------------------- plumbing
+    @property
+    def active(self) -> bool:
+        return self.budget_bytes > 0
+
+    def _bump(self, name: str, n: int = 1, **labels) -> None:
+        if self.telemetry is not None:
+            self.telemetry.bump(name, n, labels=labels or None)
+
+    def _invalidate(self) -> None:
+        with self._lock:
+            self._cache.clear()
+
+    # -------------------------------------------------------------- plan
+    def plan(self, g: Geometry, *, chunkable: bool = True) -> PlanDecision:
+        """Plan one geometry (memoized — geometries repeat every turn;
+        the cache drops whenever the model learns). Telemetry records the
+        decision class and the predicted footprint."""
+        if not self.active:
+            return PlanDecision(True, 1, 0, 0, 0, "planner disabled")
+        key = (g, chunkable)
+        with self._lock:
+            hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        d = plan_geometry(self.model, g, self.budget_bytes,
+                          self.headroom_fraction, chunkable=chunkable,
+                          granularity=self.granularity,
+                          max_splits=self.max_splits,
+                          min_scan_chunk=self.min_scan_chunk)
+        with self._lock:
+            if len(self._cache) >= 64:
+                self._cache.clear()
+            self._cache[key] = d
+            self.decisions += 1
+        verdict = ("fused" if d.fused
+                   else "chunked" if d.feasible and d.splits == 1
+                   else "split" if d.feasible else "infeasible")
+        self._bump("plan.decisions", verdict=verdict, path=g.kind)
+        if self.telemetry is not None:
+            self.telemetry.gauge(
+                "plan.predicted_bytes", d.predicted_bytes,
+                labels={"mode": g.mode, "batch": str(g.batch),
+                        "rows": str(g.rows), "mesh": str(g.mesh_parts)})
+        return d
+
+    def check_feasible(self, g: Geometry, *,
+                       chunkable: bool = True) -> PlanDecision:
+        """Admission guard (scheduler / warmup / kernel-cache gates):
+        returns the decision, raising the typed ``PlanInfeasible`` when
+        no split fits. Import deferred so plan/model stays jax-free for
+        the CI sweep."""
+        d = self.plan(g, chunkable=chunkable)
+        if not d.feasible:
+            from lazzaro_tpu.reliability.errors import PlanInfeasible
+            self._bump("plan.infeasible", path=g.kind)
+            raise PlanInfeasible(
+                f"{g.kind} geometry (mode={g.mode}, batch={g.batch}, "
+                f"rows={g.rows}, k={g.k}, mesh={g.mesh_parts}) predicts "
+                f"{d.predicted_bytes / (1 << 20):.0f} MiB — over the "
+                f"{self.budget_bytes / (1 << 20):.0f} MiB budget minus "
+                f"headroom, and {d.reason}")
+        return d
+
+    # ---------------------------------------------------------- calibrate
+    def observe_gauge(self, g: Geometry, measured_bytes: float) -> bool:
+        """Feed one AOT ``memory_analysis()`` gauge back into the model
+        (called next to the ``kernel.peak_hbm_bytes`` recorders). Grows
+        the multiplier when the measurement beats the prediction, drops
+        the decision cache, and persists the calibration when a path was
+        configured."""
+        sound = self.model.observe(g, measured_bytes)
+        if not sound:
+            self._bump("plan.calibration_growths", path=g.kind)
+            self._invalidate()
+        if self.calibration_path:
+            try:
+                self.model.save(self.calibration_path)
+            except OSError:
+                pass                    # observability must never fail a serve
+        return sound
+
+    def note_oom(self, g: Geometry) -> None:
+        """A dispatch the plan admitted still OOM'd: the analytic bound
+        under-estimated this family. Inflate it so the SAME geometry now
+        predicts over budget, and forget cached decisions."""
+        self.model.inflate(g)
+        self.oom_noted += 1
+        self._bump("plan.oom_noted", path=g.kind)
+        self._invalidate()
+        if self.calibration_path:
+            try:
+                self.model.save(self.calibration_path)
+            except OSError:
+                pass
+
+    def replan_after_oom(self, g: Geometry, prev: PlanDecision, *,
+                         chunkable: bool = True
+                         ) -> Optional[PlanDecision]:
+        """ONE harder decision for the replan pass (the caller re-runs it
+        through the copy twins): whatever the grown model now says, but
+        never laxer than doubling the previous split count. None when
+        even the maximal split no longer fits."""
+        d = self.plan(g, chunkable=chunkable)
+        floor_splits = max(2, prev.splits * 2 if prev.splits else 2)
+        if d.feasible and d.splits < floor_splits:
+            d = PlanDecision(True, min(floor_splits, self.max_splits),
+                             d.scan_chunk, d.predicted_bytes,
+                             d.budget_bytes, "post-OOM forced split")
+        return d if d.feasible else None
+
+    def stats(self) -> dict:
+        return {"active": self.active,
+                "budget_bytes": self.budget_bytes,
+                "headroom_fraction": self.headroom_fraction,
+                "decisions": self.decisions,
+                "oom_noted": self.oom_noted,
+                "multipliers": dict(self.model.multipliers)}
+
+
+__all__ = ["HbmPlanner", "Geometry", "PlanDecision", "CostModel",
+           "plan_geometry"]

@@ -21,18 +21,22 @@ that fails does so with one of them, never by hanging a future.
 
 from lazzaro_tpu.reliability.errors import (ArenaPoisoned,
                                             CheckpointCorrupt,
-                                            ColdReadError, DispatchTimeout,
-                                            LoadShed, ReliabilityError,
+                                            ColdReadError, DeviceOom,
+                                            DispatchTimeout, LoadShed,
+                                            PlanInfeasible,
+                                            ReliabilityError,
                                             WorkerCrashed)
 from lazzaro_tpu.reliability import faults
 from lazzaro_tpu.reliability.guard import (check_not_poisoned, is_poisoned,
+                                           is_resource_exhausted,
                                            run_guarded)
 from lazzaro_tpu.reliability.journal import IngestJournal
 from lazzaro_tpu.reliability.watchdog import CircuitBreaker
 
 __all__ = [
     "ReliabilityError", "ArenaPoisoned", "DispatchTimeout", "LoadShed",
-    "WorkerCrashed", "CheckpointCorrupt", "ColdReadError",
-    "run_guarded", "is_poisoned", "check_not_poisoned",
-    "IngestJournal", "CircuitBreaker", "faults",
+    "WorkerCrashed", "CheckpointCorrupt", "ColdReadError", "DeviceOom",
+    "PlanInfeasible",
+    "run_guarded", "is_poisoned", "is_resource_exhausted",
+    "check_not_poisoned", "IngestJournal", "CircuitBreaker", "faults",
 ]

@@ -26,6 +26,17 @@ model in README "Failure model & recovery":
   loading garbage.
 - :class:`ColdReadError` — the host cold tier could not produce bytes
   for a row the residency column says it owns.
+- :class:`DeviceOom` — a dispatch failed with ``RESOURCE_EXHAUSTED``
+  (HBM allocation). NON-transient by definition: retrying the identical
+  geometry re-fails identically, so the guard raises this instead of
+  burning the retry budget; the serving/ingest wrappers answer with ONE
+  replan (smaller sub-dispatches / a chunked arena scan, through the
+  copy twins) before giving up typed.
+- :class:`PlanInfeasible` — the admission-time HBM planner
+  (``lazzaro_tpu.plan``) found NO split of the requested geometry that
+  fits ``hbm_budget_bytes`` minus headroom (or a post-OOM replan
+  re-failed). Shed like :class:`LoadShed`: raised at admission or
+  resolved into the request futures, never by hanging them.
 """
 
 from __future__ import annotations
@@ -60,3 +71,15 @@ class CheckpointCorrupt(ReliabilityError):
 
 class ColdReadError(ReliabilityError):
     """The cold tier failed to produce a row it is marked as owning."""
+
+
+class DeviceOom(ReliabilityError):
+    """A dispatch failed allocating HBM (``RESOURCE_EXHAUSTED``). Not a
+    transient: the same geometry re-fails identically, so the response is
+    a replan (split/chunk through the planner), never a backoff retry."""
+
+
+class PlanInfeasible(ReliabilityError):
+    """No batch split or scan chunking fits this geometry inside the HBM
+    budget (``hbm_budget_bytes`` minus headroom) — the request is shed
+    before (or instead of) compiling a program that would OOM."""

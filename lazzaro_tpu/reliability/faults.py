@@ -25,6 +25,13 @@ Injection points (grep for ``faults.fire`` to find the exact sites):
                       flip — the hook corrupts the committed payload to
                       model a torn write the filesystem lied about
 ``coldstore.read``    ColdStore.gather, before copying rows out
+``plan.oom``          the fused serving dispatch region (single-chip and
+                      pod index), just before the device call — arm with
+                      ``exc=oom_error`` to model an HBM allocation
+                      failure (``RESOURCE_EXHAUSTED``) the admission
+                      planner's prediction missed; recovery is ONE
+                      replan into split sub-dispatches via the copy
+                      twins (ISSUE 11)
 ====================  =====================================================
 
 Arming is process-global (the injected sites live on background threads),
@@ -146,6 +153,17 @@ def fire(point: str, **ctx) -> None:
 
 
 # --------------------------------------------------------------------- hooks
+def oom_error() -> BaseException:
+    """Exception factory for ``plan.oom`` / ``index.dispatch`` arming: a
+    plain RuntimeError carrying the XLA allocator's RESOURCE_EXHAUSTED
+    marker, so ``guard.is_resource_exhausted`` classifies it exactly like
+    a real HBM allocation failure (jaxlib's XlaRuntimeError cannot be
+    constructed portably from Python)."""
+    return RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 bytes "
+        "(injected by reliability.faults.oom_error)")
+
+
 def poison_states_hook(ctx: dict) -> None:
     """Hook for ``index.dispatch``: delete the donated state's device
     buffers before raising, so the failure models a dispatch that died

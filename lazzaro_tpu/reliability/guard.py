@@ -15,6 +15,13 @@ state loss:
   make things worse, and a success leaves the index exactly where the
   donated dispatch would have. Each retry bumps
   ``serve.dispatch_retries{mode,reason}``.
+- **Input intact but RESOURCE_EXHAUSTED** → not a transient at all: the
+  identical geometry re-fails identically, so retrying is pure waste.
+  Reclassified (ISSUE 11) into the typed
+  :class:`~lazzaro_tpu.reliability.errors.DeviceOom` immediately — the
+  serving/ingest wrappers answer with ONE planner replan (smaller
+  sub-dispatches / chunked scan, through the copy twins) and give up
+  typed (``PlanInfeasible``) if that fails too.
 - **Input consumed ("poisoned")** → there is nothing left to retry with.
   Raise :class:`~lazzaro_tpu.reliability.errors.ArenaPoisoned` so the
   caller marks the index poisoned and every later touch fails typed and
@@ -34,7 +41,27 @@ import time
 from typing import Callable, Optional, Sequence
 
 from lazzaro_tpu.reliability import faults
-from lazzaro_tpu.reliability.errors import ArenaPoisoned, ReliabilityError
+from lazzaro_tpu.reliability.errors import (ArenaPoisoned, DeviceOom,
+                                            ReliabilityError)
+
+# Substrings that identify an HBM allocation failure across backends: the
+# gRPC/XLA status name, the PJRT message text, and the CUDA/TPU allocator
+# phrasing. Matching on text is deliberate — jaxlib's XlaRuntimeError does
+# not subclass per-status, and the fault injector raises plain RuntimeErrors
+# carrying the same marker.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM when allocating", "Resource exhausted")
+
+
+def is_resource_exhausted(e: BaseException) -> bool:
+    """True when ``e`` is an HBM allocation failure (or the typed
+    :class:`DeviceOom` it gets reclassified into). These are NON-transient:
+    the identical geometry re-fails identically, so they route to the
+    planner (split/chunk) instead of the retry ladder."""
+    if isinstance(e, DeviceOom):
+        return True
+    msg = f"{type(e).__name__}: {e}"
+    return any(m in msg for m in _OOM_MARKERS)
 
 
 def is_poisoned(states: Sequence) -> bool:
@@ -88,6 +115,20 @@ def run_guarded(call: Callable, donated: Callable, copying: Callable,
                     f"donated {mode} dispatch failed after consuming its "
                     f"input ({type(e).__name__}: {e}); restore from "
                     f"checkpoint and replay the ingest journal") from e
+            if is_resource_exhausted(e):
+                # ISSUE 11: RESOURCE_EXHAUSTED is NOT a transient — the
+                # identical geometry re-fails identically, so retry-with-
+                # backoff just burns the budget re-failing. Reclassify
+                # typed so the serving/ingest wrappers can plan-and-
+                # rechunk (one replan through the copy twins) instead.
+                if telemetry is not None:
+                    telemetry.bump("reliability.oom",
+                                   labels={"mode": mode})
+                raise DeviceOom(
+                    f"{mode} dispatch exhausted device memory "
+                    f"({type(e).__name__}: {e}); replan the geometry "
+                    f"(split the batch / chunk the scan) instead of "
+                    f"retrying it") from e
             if attempt >= retries:
                 raise
             if telemetry is not None:
@@ -108,5 +149,6 @@ def check_not_poisoned(flag: bool, what: str = "index") -> None:
             f"journal")
 
 
-__all__ = ["is_poisoned", "run_guarded", "check_not_poisoned",
-           "ArenaPoisoned", "ReliabilityError"]
+__all__ = ["is_poisoned", "is_resource_exhausted", "run_guarded",
+           "check_not_poisoned", "ArenaPoisoned", "DeviceOom",
+           "ReliabilityError"]

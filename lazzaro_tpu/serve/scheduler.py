@@ -55,7 +55,14 @@ TYPED ERROR; it never blocks forever:
   half-open probe at full quality decides re-close vs re-open;
 - **admission overload** (``shed_depth``/``shed_bytes`` exceeded) fails
   new submissions immediately with :class:`LoadShed`
-  (``reliability.load_shed``) — the device never sees them.
+  (``reliability.load_shed``) — the device never sees them;
+- **memory-infeasible geometry** (ISSUE 11): with an ``admission_check``
+  wired (the HBM planner's minimum-geometry probe), a submission whose
+  geometry no split can fit fails immediately with the typed
+  :class:`PlanInfeasible` — shed exactly like ``LoadShed``, before the
+  queue, so a request that could never dispatch is never admitted. The
+  executor raising ``PlanInfeasible`` mid-batch demuxes to the batch's
+  futures like any other typed error (futures never hang either way).
 """
 
 from __future__ import annotations
@@ -72,7 +79,7 @@ import numpy as np
 
 from lazzaro_tpu.reliability import faults
 from lazzaro_tpu.reliability.errors import (DispatchTimeout, LoadShed,
-                                            WorkerCrashed)
+                                            PlanInfeasible, WorkerCrashed)
 from lazzaro_tpu.reliability.watchdog import CircuitBreaker
 from lazzaro_tpu.utils.batching import FlushPolicy
 from lazzaro_tpu.utils.compat import step_trace_annotation
@@ -175,8 +182,14 @@ class QueryScheduler:
                  breaker_threshold: int = 5,
                  breaker_cooldown_s: float = 5.0,
                  shed_depth: int = 0, shed_bytes: int = 0,
-                 degrade_cap_take: int = 1, degrade_nprobe: int = 1):
+                 degrade_cap_take: int = 1, degrade_nprobe: int = 1,
+                 admission_check: Optional[Callable] = None):
         self._executor = executor
+        # Memory-safe admission (ISSUE 11): an optional callable invoked
+        # with the submitted request group BEFORE it queues; raising
+        # PlanInfeasible fails the group's futures typed right here —
+        # shed like LoadShed, the device never sees them.
+        self.admission_check = admission_check
         # Serving telemetry (ISSUE 6): every request records its
         # enqueue→flush queue wait (per-tenant label), every flushed batch
         # one batch-size sample — N coalesced requests therefore yield N
@@ -231,6 +244,17 @@ class QueryScheduler:
         failure."""
         futures = [Future() for _ in requests]
         now = time.time()
+        if self.admission_check is not None and requests:
+            try:
+                self.admission_check(list(requests))
+            except PlanInfeasible as err:
+                # memory-infeasible geometry: shed typed, like LoadShed —
+                # the futures resolve immediately, the queue never grows
+                self.requests_shed += len(requests)
+                self.telemetry.bump("plan.infeasible_shed", len(requests))
+                for fut in futures:
+                    _fail_future(fut, err)
+                return futures
         nbytes = (sum(np.asarray(r.query).nbytes for r in requests)
                   if self.shed_bytes else 0)
         with self._cond:

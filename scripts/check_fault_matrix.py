@@ -23,7 +23,12 @@ exits nonzero when an artifact flagged ``"reliability": true``
     (the two headline numbers the stage exists to record), or
   - records a ``shed`` block whose ``hung_futures`` != 0 — a future
     that resolves with neither a result nor a typed error is the one
-    outcome the failure model forbids.
+    outcome the failure model forbids, or
+  - (ISSUE 11) carries any ``plan.oom:*`` replan-recovery cell (an
+    injected ``RESOURCE_EXHAUSTED`` recovered by the planner splitting
+    the dispatch through the copy twins) but omits the ``oom_replans``
+    counter that proves the replan machinery — not a silent retry —
+    did the recovering.
 
 Usage:
     python scripts/check_fault_matrix.py [artifact.json ...]
@@ -105,6 +110,11 @@ def check_artifact(path: str, bad: list) -> int:
             for key in _REQUIRED_COUNTERS:
                 if key not in counters:
                     bad.append((loc, f"recovery counters omit '{key}'"))
+            has_replan_cells = isinstance(matrix, dict) and any(
+                str(cell).startswith("plan.oom") for cell in matrix)
+            if has_replan_cells and "oom_replans" not in counters:
+                bad.append((loc, "matrix has plan.oom replan cells but "
+                                 "counters omit 'oom_replans'"))
         for key in _REQUIRED_HEADLINES:
             if _find(root, key) is None:
                 bad.append((loc, f"reliability artifact omits '{key}'"))

@@ -1,44 +1,146 @@
 #!/usr/bin/env python3
-"""CI gate: every compiled serving geometry must fit the per-chip HBM budget.
+"""CI gate: every serving geometry must fit the per-chip HBM budget —
+measured AND predicted.
 
 Each fusion round grows the live set of the one big dispatch (arena +
-shadow + IVF tables + edge arena + packed readback), and before this gate
-the only OOM signal was a runtime crash at a new (size × mode × mesh)
-combination. "Memory Safe Computations with XLA" (PAPERS.md) argues the
-fix is compile-time enforcement — and PR 6 already records the measured
-half: ``MemoryIndex._maybe_record_hbm`` AOT-lowers every fused serving
-geometry's read twin once and lands its ``memory_analysis()`` peak in the
-``kernel.peak_hbm_bytes{mode,k,rows,mesh}`` gauge, which every bench
-artifact embeds in its telemetry block. This script (ROADMAP item 8 seed,
-ISSUE 8 satellite) walks the checked-in artifacts and
+shadow + IVF tables + edge arena + packed readback). Before ISSUE 11 this
+gate only *observed* geometries a bench happened to compile — the
+``kernel.peak_hbm_bytes{...}`` AOT gauges PR 6/PR 9 record — so a novel
+(mode × batch × rows × mesh) request could still OOM at runtime. "Memory
+Safe Computations with XLA" (PAPERS.md) argues the bound should be
+*guaranteed* before compilation; the admission-time planner
+(``lazzaro_tpu/plan``) now does that live, and this script closes the CI
+loop around it. It walks the checked-in artifacts and
 
-- FAILS (exit 1) when any recorded kernel's peak exceeds the budget
-  (``--budget-gb``, default 16 — a v5e chip), so a geometry that will OOM
-  in production turns red in CI instead; since ISSUE 9 the ingest path
-  records ``kernel.peak_hbm_bytes{path="ingest",batch,rows,mesh}`` via
-  the same AOT read-twin lowering, so WRITE-path geometries (the fused
-  ingest program's arena + edge arena + shadow + link-scan tiles) are
-  gated here too — the summary line reports serve/ingest coverage
-  separately;
-- RECORDS the headroom back into each artifact (an ``hbm_budget`` block:
-  max peak, worst kernel, headroom bytes and fraction), so the next
-  size-doubling PR knows how much room the current programs leave.
-  ``--no-write`` skips the write-back (plain verification mode).
+- FAILS (exit 1) when any recorded kernel's MEASURED peak exceeds the
+  budget (``--budget-gb``, default 16 — a v5e chip); write-path
+  (``path="ingest"``) gauges included, summary reports coverage;
+- FAILS when any recorded AOT gauge exceeds the cost model's PREDICTION
+  for its geometry (model-soundness: the planner's admission decisions
+  are only a guarantee while predictions over-bound every measurement).
+  ``--calibrate`` instead grows the persisted multipliers
+  (``bench_artifacts/plan_calibration.json`` — the residual log beside
+  the kernel-cache artifacts) until they do, for maintainer runs;
+- SWEEPS the planner's prediction over every geometry the benches
+  *exercised* (gauge labels + any ``geometries_exercised`` list an
+  artifact embeds — not just ones that compiled) and FAILS on any
+  predicted-over-budget geometry for which ``plan_geometry`` finds NO
+  feasible split (batch sub-dispatches riding the pad buckets, or the
+  chunked arena scan): a geometry that would OOM with no planned
+  degradation path turns red here instead of in production;
+- GATES ``"hbm_plan": true`` artifacts (the BENCH_HBM_PLAN stage): they
+  must record a ``plan`` block whose ``split_dispatches`` show the
+  planner actually split something, a measured
+  ``resource_exhausted_crashes == 0``, and a
+  ``planned_dispatches_per_turn`` matching the measured count — a
+  planned multi-dispatch turn is recorded, never silent;
+- RECORDS the headroom back into each artifact (an ``hbm_budget``
+  block). ``--no-write`` skips the write-back.
 
 Usage:
-    python scripts/check_hbm_budget.py [--budget-gb G] [--no-write] \
-        [artifact.json ...]
+    python scripts/check_hbm_budget.py [--budget-gb G] [--no-write]
+        [--calibrate] [--calibration PATH] [artifact.json ...]
 """
 
 from __future__ import annotations
 
 import argparse
 import glob
+import importlib.util
 import json
 import os
 import sys
 
 GAUGE_PREFIX = "kernel.peak_hbm_bytes"
+_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+_DEFAULT_CALIBRATION = os.path.join(_ROOT, "bench_artifacts",
+                                    "plan_calibration.json")
+
+
+def _load_plan_model():
+    """Load ``lazzaro_tpu/plan/model.py`` by file path — pure stdlib, so
+    the CI sweep never pays a jax import."""
+    path = os.path.join(_ROOT, "lazzaro_tpu", "plan", "model.py")
+    spec = importlib.util.spec_from_file_location("_lz_plan_model", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_lz_plan_model"] = mod   # dataclasses resolves __module__
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _parse_labels(key: str) -> dict:
+    if "{" not in key:
+        return {}
+    inner = key[key.index("{") + 1:key.rindex("}")]
+    out = {}
+    for part in inner.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def _mesh_parts(label: str) -> int:
+    try:
+        return max(1, int(str(label).split("x")[0]))
+    except (ValueError, AttributeError):
+        return 1
+
+
+def _find(obj, key):
+    if isinstance(obj, dict):
+        if key in obj:
+            return obj[key]
+        for v in obj.values():
+            hit = _find(v, key)
+            if hit is not None:
+                return hit
+    elif isinstance(obj, list):
+        for v in obj:
+            hit = _find(v, key)
+            if hit is not None:
+                return hit
+    return None
+
+
+def _geometry_from_gauge(plan_mod, key: str, artifact: dict):
+    """Reconstruct the planner geometry one gauge key describes; labels
+    carry (mode|path, k, rows, batch, mesh), the artifact supplies dim
+    and dtype. Older gauges without a batch label sweep at a
+    conservative default."""
+    lab = _parse_labels(key)
+    dim = _find(artifact, "dim") or 768
+    dtype = str(_find(artifact, "dtype") or "float32")
+    dtype_bytes = 2 if "16" in dtype else 4
+    rows = int(lab.get("rows") or 0)
+    if rows <= 0:
+        return None
+    if lab.get("path") == "ingest":
+        return plan_mod.Geometry(
+            kind="ingest", mode="ingest",
+            batch=int(lab.get("batch") or 256), rows=rows, dim=int(dim),
+            k=3, dtype_bytes=dtype_bytes,
+            mesh_parts=_mesh_parts(lab.get("mesh", "1")))
+    return plan_mod.Geometry(
+        kind="serve", mode=lab.get("mode", "exact"),
+        batch=int(lab.get("batch") or 128), rows=rows, dim=int(dim),
+        k=int(lab.get("k") or 128), dtype_bytes=dtype_bytes,
+        mesh_parts=_mesh_parts(lab.get("mesh", "1")))
+
+
+def _geometry_from_dict(plan_mod, d: dict):
+    try:
+        return plan_mod.Geometry(
+            kind=str(d.get("kind", "serve")),
+            mode=str(d.get("mode", "exact")),
+            batch=int(d.get("batch", 8)), rows=int(d.get("rows", 1024)),
+            dim=int(d.get("dim", 768)), k=int(d.get("k", 128)),
+            dtype_bytes=int(d.get("dtype_bytes", 4)),
+            mesh_parts=int(d.get("mesh_parts", 1)),
+            edge_cap=int(d.get("edge_cap", 0)),
+            nprobe=int(d.get("nprobe", 0)))
+    except (TypeError, ValueError):
+        return None
 
 
 def _collect(obj, found):
@@ -56,21 +158,92 @@ def _collect(obj, found):
             _collect(v, found)
 
 
+def _collect_sweeps(obj, sweeps):
+    """Every ``geometries_exercised`` list anywhere in the artifact —
+    the geometries a bench stage SERVED, compiled or not."""
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if k == "geometries_exercised" and isinstance(v, list):
+                sweeps.extend(x for x in v if isinstance(x, dict))
+            else:
+                _collect_sweeps(v, sweeps)
+    elif isinstance(obj, list):
+        for v in obj:
+            _collect_sweeps(v, sweeps)
+
+
+def _hbm_plan_roots(obj, path, roots):
+    if isinstance(obj, dict):
+        if obj.get("hbm_plan") is True:
+            roots.append((path, obj))
+        for k, v in obj.items():
+            _hbm_plan_roots(v, f"{path}.{k}", roots)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _hbm_plan_roots(v, f"{path}[{i}]", roots)
+
+
+def _check_hbm_plan_root(loc, root, bad):
+    """The ISSUE 11 gate on one ``"hbm_plan": true`` dict."""
+    plan = root.get("plan")
+    if not isinstance(plan, dict):
+        bad.append((loc, "hbm_plan artifact records no 'plan' block"))
+        return
+    try:
+        splits_ok = float(plan.get("split_dispatches", 0)) >= 1
+    except (TypeError, ValueError):
+        splits_ok = False
+    if not splits_ok:
+        bad.append((loc, "plan block records no split_dispatches — the "
+                         "budget ladder never forced a planned split"))
+    if plan.get("resource_exhausted_crashes") != 0:
+        bad.append((loc, f"resource_exhausted_crashes == "
+                         f"{plan.get('resource_exhausted_crashes')!r} "
+                         f"(must be a measured 0)"))
+    measured = _find(root, "dispatches_per_turn")
+    planned = root.get("planned_dispatches_per_turn")
+    if planned is None:
+        bad.append((loc, "hbm_plan artifact must record "
+                         "'planned_dispatches_per_turn' next to the "
+                         "measured count"))
+    elif measured is not None and float(measured) != float(planned):
+        bad.append((loc, f"measured dispatches_per_turn {measured!r} != "
+                         f"planned_dispatches_per_turn {planned!r} — an "
+                         f"UNplanned split happened"))
+    probe = root.get("fused_probe")
+    if not isinstance(probe, dict):
+        bad.append((loc, "hbm_plan artifact must record a 'fused_probe' "
+                         "(an under-budget ladder point)"))
+    else:
+        got = probe.get("measured_dispatches_per_turn")
+        try:
+            ok = float(got) == 1.0
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            bad.append((loc, f"fused_probe measured_dispatches_per_turn "
+                             f"== {got!r} (an UNDER-budget geometry must "
+                             f"still cost exactly ONE dispatch)"))
+    sweeps: list = []
+    _collect_sweeps(root, sweeps)
+    if not sweeps:
+        bad.append((loc, "hbm_plan artifact must embed the "
+                         "'geometries_exercised' sweep list"))
+
+
 def check_artifact(path: str, budget_bytes: float, write: bool):
     try:
         with open(path) as f:
             data = json.load(f)
     except (OSError, ValueError) as e:
         print(f"[hbm] skipping unreadable {path}: {e}", file=sys.stderr)
-        return 0, []
+        return None, {}, []
     found: dict = {}
     _collect(data, found)
-    if not found:
-        return 0, []
-    worst_key = max(found, key=found.get)
-    worst = found[worst_key]
     over = [(k, v) for k, v in sorted(found.items()) if v > budget_bytes]
-    if write:
+    if found and write:
+        worst_key = max(found, key=found.get)
+        worst = found[worst_key]
         data["hbm_budget"] = {
             "budget_bytes": budget_bytes,
             "kernels_checked": len(found),
@@ -84,7 +257,7 @@ def check_artifact(path: str, budget_bytes: float, write: bool):
         with open(tmp, "w") as f:
             json.dump(data, f, indent=1)
         os.replace(tmp, path)
-    return len(found), [(path, k, v) for k, v in over]
+    return data, found, [(path, k, v) for k, v in over]
 
 
 def main(argv):
@@ -95,39 +268,107 @@ def main(argv):
                     help="per-chip HBM budget in GiB (default 16)")
     ap.add_argument("--no-write", action="store_true",
                     help="verify only; do not record headroom back")
+    ap.add_argument("--calibration", default=_DEFAULT_CALIBRATION,
+                    help="cost-model calibration JSON (multipliers + "
+                         "residual log)")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="maintainer mode: GROW the calibration until "
+                         "every gauge is over-bounded and persist it, "
+                         "instead of failing on unsound predictions")
     args = ap.parse_args(argv)
     if args.paths:
         paths = args.paths
     else:
-        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            os.pardir, "bench_artifacts")
-        paths = sorted(glob.glob(os.path.join(root, "*.json")))
+        root = os.path.join(_ROOT, "bench_artifacts")
+        paths = sorted(p for p in glob.glob(os.path.join(root, "*.json"))
+                       if os.path.basename(p) != "plan_calibration.json")
     budget = args.budget_gb * (1 << 30)
+    plan_mod = _load_plan_model()
+    model = plan_mod.CostModel.load_or_default(
+        args.calibration if os.path.exists(args.calibration) else None)
     checked = 0
     checked_ingest = 0
+    checked_sound = 0
+    checked_swept = 0
+    checked_plan_roots = 0
     breaches = []
+    unsound = []
+    infeasible = []
+    bad_plan: list = []
     with_gauges = 0
     for p in paths:
-        n, over = check_artifact(p, budget, write=not args.no_write)
-        checked += n
-        if n:
+        data, found, over = check_artifact(p, budget,
+                                           write=not args.no_write)
+        if data is None:
+            continue
+        base = os.path.basename(p)
+        checked += len(found)
+        if found:
             with_gauges += 1
-            try:
-                with open(p) as f:
-                    found: dict = {}
-                    _collect(json.load(f), found)
-                checked_ingest += sum(1 for k in found
-                                      if 'path="ingest"' in k)
-            except (OSError, ValueError):
-                pass
+            checked_ingest += sum(1 for k in found
+                                  if 'path="ingest"' in k)
         breaches.extend(over)
+        geoms = []
+        for key, measured in sorted(found.items()):
+            g = _geometry_from_gauge(plan_mod, key, data)
+            if g is None:
+                continue
+            geoms.append((f"{base}:{key}", g))
+            # model soundness: the prediction must over-bound the
+            # measured AOT peak, or the admission guarantee is hollow
+            checked_sound += 1
+            if args.calibrate:
+                model.observe(g, measured)
+            elif model.predict(g) < measured:
+                unsound.append((base, key, measured, model.predict(g)))
+        sweeps: list = []
+        _collect_sweeps(data, sweeps)
+        for d in sweeps:
+            g = _geometry_from_dict(plan_mod, d)
+            if g is not None:
+                geoms.append((f"{base}:geometries_exercised", g))
+        # the planner sweep: every exercised geometry must either fit or
+        # have a feasible planned split
+        for loc, g in geoms:
+            checked_swept += 1
+            d = plan_mod.plan_geometry(
+                model, g, int(budget),
+                chunkable=(g.kind == "serve" and g.mesh_parts == 1))
+            if not d.feasible:
+                infeasible.append((loc, g, d))
+        roots: list = []
+        _hbm_plan_roots(data, base, roots)
+        for loc, rootd in roots:
+            checked_plan_roots += 1
+            _check_hbm_plan_root(loc, rootd, bad_plan)
+    if args.calibrate:
+        model.save(args.calibration)
+        print(f"[hbm] calibration persisted to {args.calibration} "
+              f"({len(model.residuals)} residual(s), multipliers "
+              f"{model.multipliers})")
     for path, key, val in breaches:
         print(f"HBM-BUDGET-EXCEEDED: {os.path.basename(path)}: {key} = "
               f"{val / (1 << 30):.2f} GiB > {args.budget_gb} GiB")
+    for base, key, measured, predicted in unsound:
+        print(f"MODEL-UNSOUND: {base}: {key} measured "
+              f"{measured / (1 << 20):.1f} MiB > predicted "
+              f"{predicted / (1 << 20):.1f} MiB — recalibrate with "
+              f"--calibrate")
+    for loc, g, d in infeasible:
+        print(f"PLAN-INFEASIBLE: {loc}: {g.kind}/{g.mode} batch={g.batch}"
+              f" rows={g.rows} k={g.k} mesh={g.mesh_parts} predicts "
+              f"{d.predicted_bytes / (1 << 30):.2f} GiB and no split "
+              f"fits {args.budget_gb} GiB")
+    for loc, msg in bad_plan:
+        print(f"HBM-PLAN-REGRESSION: {loc}: {msg}")
+    n_bad = (len(breaches) + len(unsound) + len(infeasible)
+             + len(bad_plan))
     print(f"[hbm] {checked} kernel gauge(s) ({checked_ingest} write-path) "
           f"across {with_gauges}/{len(paths)} artifact(s) checked against "
-          f"{args.budget_gb} GiB; {len(breaches)} breach(es)")
-    return 1 if breaches else 0
+          f"{args.budget_gb} GiB; {checked_sound} soundness check(s), "
+          f"{checked_swept} geometry sweep(s), {checked_plan_roots} "
+          f"hbm_plan gate(s); {n_bad} failure(s)")
+    return 1 if n_bad else 0
 
 
 if __name__ == "__main__":

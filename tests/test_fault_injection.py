@@ -27,10 +27,11 @@ from lazzaro_tpu.core import state as S
 from lazzaro_tpu.core.index import MemoryIndex
 from lazzaro_tpu.reliability import (ArenaPoisoned, CheckpointCorrupt,
                                      CircuitBreaker, ColdReadError,
-                                     DispatchTimeout, IngestJournal,
-                                     LoadShed, WorkerCrashed)
+                                     DeviceOom, DispatchTimeout,
+                                     IngestJournal, LoadShed,
+                                     WorkerCrashed)
 from lazzaro_tpu.reliability.faults import (INJECTOR, InjectedFault,
-                                            poison_states_hook,
+                                            oom_error, poison_states_hook,
                                             torn_write_hook)
 from lazzaro_tpu.serve.scheduler import (QueryScheduler, RetrievalRequest,
                                          RetrievalResult)
@@ -83,14 +84,15 @@ def _reqs(emb, nq=8, k=10, boost=True, seed=9):
             for i in range(nq)]
 
 
-def _build_mode(mode):
+def _build_mode(mode, **extra):
     """One (index, emb) fixture per matrix column, deterministic and
-    epoch-pinned so two builds are bit-identical."""
+    epoch-pinned so two builds are bit-identical. ``extra`` forwards
+    ctor kwargs (the replan cells pass an HBM-planner budget)."""
     if mode == "ivf":
         n = 4500
         idx = MemoryIndex(dim=D, capacity=5000, int8_serving=True,
                           coarse_slack=5001, ivf_nprobe=4096, epoch=EPOCH,
-                          telemetry=Telemetry())
+                          telemetry=Telemetry(), **extra)
         emb = _vecs(n, 0)
         ids = [f"n{i}" for i in range(n)]
         idx.add(ids, emb, [0.5] * n, [0.0] * n, ["semantic"] * n,
@@ -106,7 +108,7 @@ def _build_mode(mode):
     idx = MemoryIndex(dim=D, capacity=255, epoch=EPOCH, mesh=mesh,
                       int8_serving=(mode in ("quant", "tiered", "mesh2")),
                       coarse_slack=(8 if mode == "exact" else 512),
-                      telemetry=Telemetry())
+                      telemetry=Telemetry(), **extra)
     emb = _fill(idx)
     if mode == "tiered":
         tm = idx.enable_tiering(hot_budget_rows=64, hysteresis_s=0.0)
@@ -184,6 +186,56 @@ def test_mutation_dispatch_raise_recovers():
     idx_f.update_access(["n0", "n3"], now=2000.0)
     idx_c.update_access(["n0", "n3"], now=2000.0)
     _assert_state_parity(idx_f, idx_c)
+
+
+# =====================================================================
+# typed OOM (ISSUE 11): non-transient classification + replan recovery
+# =====================================================================
+def test_oom_dispatch_not_retried_as_transient():
+    """REPRO (ISSUE 11 satellite): the guard used to retry
+    RESOURCE_EXHAUSTED with backoff as if transient — re-failing
+    identically until the retry budget burned. It now reclassifies the
+    FIRST allocation failure into the typed DeviceOom (routing it to the
+    planner), so the armed fault fires exactly once and no copy-twin
+    retry ever launches."""
+    idx, emb = _build_mode("exact")
+    INJECTOR.arm("index.dispatch", times=3, exc=oom_error)
+    with pytest.raises(DeviceOom):
+        idx.update_access(["n0"], now=2000.0)
+    assert INJECTOR.fired("index.dispatch") == 1   # ONE attempt, no burn
+    assert idx.telemetry.counter_total("serve.dispatch_retries") == 0
+    assert idx.telemetry.counter_total("reliability.oom") == 1
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_plan_oom_replan_recovers_to_parity(mode):
+    """The replan-recovery matrix cells (ISSUE 11): an injected
+    RESOURCE_EXHAUSTED at the fused dispatch on a planner-active index
+    recovers by ONE replan into split sub-dispatches through the copy
+    twins — results and state bit-identical to an uninjected unsplit
+    run, across every serving mode."""
+    idx_f, emb = _build_mode(mode, hbm_budget_bytes=1 << 34)
+    idx_c, _ = _build_mode(mode)
+    INJECTOR.arm("plan.oom", times=1, exc=oom_error)
+    r_f = idx_f.search_fused_requests(_reqs(emb, boost=False), **KW)
+    r_c = idx_c.search_fused_requests(_reqs(emb, boost=False), **KW)
+    assert INJECTOR.fired("plan.oom") == 1
+    assert idx_f.telemetry.counter_total("plan.oom_replans") == 1
+    assert idx_f.telemetry.counter_total("plan.split_dispatches") >= 2
+    _assert_results_equal(r_f, r_c)
+    _assert_state_parity(idx_f, idx_c)
+
+
+def test_plan_oom_without_planner_stays_typed():
+    """With no planner budget configured there is nothing to replan
+    with: the reclassified DeviceOom surfaces typed (never a backoff
+    retry loop, never a hang)."""
+    idx, emb = _build_mode("exact")
+    INJECTOR.arm("plan.oom", times=1, exc=oom_error)
+    with pytest.raises(DeviceOom):
+        idx.search_fused_requests(_reqs(emb, boost=False), **KW)
+    r = idx.search_fused_requests(_reqs(emb, boost=False), **KW)
+    assert all(x.ids for x in r)                   # next serve is clean
 
 
 # =====================================================================

@@ -1,0 +1,412 @@
+"""Memory-safe serving: the admission-time HBM planner (ISSUE 11).
+
+Covers the cost model (analytic over-bounding, calibration growth,
+persistence), the split decision tree (fused → chunked scan → planned
+batch split → typed infeasible), and the split-parity contract: a
+planner-forced 2-way and 4-way batch split and a chunked-scan dispatch
+all return BIT-IDENTICAL top-k, gate verdicts, and boost columns vs the
+single-dispatch kernel; under-budget geometries still cost exactly ONE
+dispatch (jit-counter pinned); infeasible geometries shed typed at the
+scheduler, never hang; warmups skip what admission would refuse; the
+ingest mega-batch splits planned.
+"""
+
+import numpy as np
+import pytest
+
+from lazzaro_tpu.core import state as S
+from lazzaro_tpu.core.index import MemoryIndex
+from lazzaro_tpu.plan import (CostModel, Geometry, HbmPlanner,
+                              PlanDecision, plan_geometry)
+from lazzaro_tpu.reliability import DeviceOom, PlanInfeasible
+from lazzaro_tpu.reliability.faults import INJECTOR, oom_error
+from lazzaro_tpu.serve.scheduler import (QueryScheduler, RetrievalRequest,
+                                         RetrievalResult)
+from lazzaro_tpu.utils.telemetry import Telemetry
+
+D = 32
+EPOCH = 1000.0
+KW = dict(cap_take=5, max_nbr=8, super_gate=0.4, acc_boost=0.05,
+          nbr_boost=0.02, now=1234.5)
+
+_ARENA_COLS = ("emb", "salience", "timestamp", "last_accessed",
+               "access_count", "type_id", "shard_id", "tenant_id", "alive",
+               "is_super")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+
+
+def _vecs(n, seed):
+    r = np.random.default_rng(seed)
+    v = r.standard_normal((n, D)).astype(np.float32)
+    return v / np.linalg.norm(v, axis=1, keepdims=True)
+
+
+def _build_multitenant(n_tenants=4, per=32, **extra):
+    """Disjoint per-tenant row sets: a contiguous split of tenant-major
+    queries can never boost one row from two sub-dispatches, so the
+    boost columns of a split turn are bit-identical to the fused one."""
+    n = n_tenants * per
+    idx = MemoryIndex(dim=D, capacity=255, epoch=EPOCH,
+                      telemetry=Telemetry(), **extra)
+    emb = _vecs(n, 0)
+    for t in range(n_tenants):
+        ids = [f"t{t}n{i}" for i in range(per)]
+        idx.add(ids, emb[t * per:(t + 1) * per], [0.5] * per, [0.0] * per,
+                ["semantic"] * per, ["default"] * per, f"u{t}",
+                is_super=[i % 13 == 0 for i in range(per)])
+        idx.add_edges([(f"t{t}n{i}", f"t{t}n{i + 1}", 0.7)
+                       for i in range(per - 1)], f"u{t}", now=EPOCH)
+    return idx, emb
+
+
+def _mt_reqs(emb, n_tenants=4, per=32, per_tenant=2, k=10, boost=True):
+    """Tenant-major query order: a q-way contiguous split (q ≤ tenants)
+    keeps every tenant's queries inside one sub-dispatch."""
+    out = []
+    r = np.random.default_rng(7)
+    for t in range(n_tenants):
+        for j in range(per_tenant):
+            q = emb[t * per + j] + 0.01 * r.standard_normal(D).astype(
+                np.float32)
+            out.append(RetrievalRequest(query=q, tenant=f"u{t}", k=k,
+                                        gate_enabled=True, boost=boost))
+    return out
+
+
+def _assert_results_equal(a_list, b_list):
+    for a, b in zip(a_list, b_list):
+        assert a.ids == b.ids
+        assert a.scores == b.scores            # bit-identical, not close
+        assert a.fast == b.fast
+        assert a.gate_id == b.gate_id
+
+
+def _assert_state_bitwise(ia, ib):
+    for col in _ARENA_COLS:
+        a = np.asarray(getattr(ia.state, col))
+        b = np.asarray(getattr(ib.state, col))
+        assert np.array_equal(a, b), f"arena.{col} diverged"
+
+
+# =====================================================================
+# cost model
+# =====================================================================
+def test_predict_monotonic_in_batch_rows_and_mesh():
+    m = CostModel()
+    g = Geometry(batch=32, rows=1 << 16, dim=256, k=64)
+    assert m.predict(g.with_(batch=64)) > m.predict(g)
+    assert m.predict(g.with_(rows=1 << 17)) > m.predict(g)
+    assert m.predict(g.with_(mesh_parts=4)) < m.predict(g)
+    assert m.predict(g.with_(scan_chunk=8)) < m.predict(g)
+    assert m.resident_bytes(g) < m.predict(g)
+
+
+def test_observe_grows_multiplier_until_sound(tmp_path):
+    m = CostModel()
+    g = Geometry(batch=16, rows=4096, dim=128, k=32)
+    base = m.predict(g)
+    assert m.observe(g, base * 0.5)                # already over-bounded
+    assert not m.observe(g, base * 3.0)            # beat the bound → grow
+    assert m.predict(g) >= base * 3.0              # now over-bounds it
+    assert m.residuals                             # residual log recorded
+    path = str(tmp_path / "calib.json")
+    m.save(path)
+    m2 = CostModel.load(path)
+    assert m2.predict(g) == m.predict(g)
+    assert m2.residuals == {k: pytest.approx(v, abs=0)
+                            for k, v in m.residuals.items()} or \
+        m2.residuals.keys() == m.residuals.keys()
+
+
+def test_decision_tree_rungs():
+    m = CostModel()
+    g = Geometry(mode="exact", batch=64, rows=1 << 15, dim=256, k=64,
+                 mesh_parts=1)
+    full = m.predict(g)
+    # 1. fits → fused
+    d = plan_geometry(m, g, int(full / 0.9) + 1)
+    assert d.fused and d.splits == 1 and d.scan_chunk == 0
+    # 2. budget between chunked and unchunked → scan chunked, ONE dispatch
+    chunked = m.predict(g.with_(scan_chunk=8))
+    d = plan_geometry(m, g, int((full + chunked) / 2 / 0.9))
+    assert d.feasible and d.splits == 1 and d.scan_chunk > 0
+    # 3. below even the maximally chunked prediction → batch split
+    sub = m.predict(g.with_(batch=8, scan_chunk=8))
+    d = plan_geometry(m, g, int(sub / 0.9) + 1)
+    assert d.feasible and d.splits > 1
+    # 4. below the resident floor → typed infeasible
+    d = plan_geometry(m, g, int(m.resident_bytes(g) * 0.5))
+    assert not d.feasible
+
+
+def test_planner_disabled_and_oom_learning():
+    p = HbmPlanner(budget_bytes=0)
+    assert not p.active
+    assert p.plan(Geometry()).fused
+    g = Geometry(batch=64, rows=1 << 14, dim=128, k=64)
+    p2 = HbmPlanner(budget_bytes=1 << 30)
+    d = p2.plan(g)
+    assert d.fused
+    before = p2.model.predict(g)
+    p2.note_oom(g)                      # the model under-estimated
+    assert p2.model.predict(g) > before
+    harder = p2.replan_after_oom(g, d)
+    assert harder is not None and harder.splits >= 2
+
+
+# =====================================================================
+# split parity: planner-forced 2-way / 4-way vs the single dispatch
+# =====================================================================
+@pytest.mark.parametrize("splits", [2, 4])
+def test_planned_batch_split_bit_parity(splits):
+    """A planner-forced batch split returns bit-identical top-k, gate
+    verdicts, AND boost columns vs the single-dispatch kernel (disjoint
+    per-tenant row sets: no cross-sub-dispatch float reassociation)."""
+    idx_c, emb = _build_multitenant()
+    idx_s, _ = _build_multitenant()
+    reqs = _mt_reqs(emb)
+    r_c = idx_c.search_fused_requests(list(reqs), **KW)
+    geom = idx_s._serve_geometry(len(reqs), "exact", idx_s.serve_k_max)
+    forced = PlanDecision(True, splits, 0, 0, 0, "test-forced")
+    r_s = idx_s._serve_planned(list(reqs), geom, forced,
+                               dict(KW), replanned=False)
+    _assert_results_equal(r_c, r_s)
+    _assert_state_bitwise(idx_c, idx_s)
+    assert idx_s.telemetry.counter_total("plan.split_dispatches") == splits
+
+
+def test_scan_chunked_dispatch_bit_parity_and_one_dispatch(monkeypatch):
+    """The cheapest degradation rung: a planner-chunked arena scan stays
+    ONE dispatch (jit-counter pinned) and is bit-identical — only the
+    streaming tile width changes."""
+    calls = {"n": 0}
+    orig = S.search_fused_ragged
+
+    def wrapped(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(S, "search_fused_ragged", wrapped)
+    idx_c, emb = _build_multitenant()
+    idx_s, _ = _build_multitenant()
+    reqs = _mt_reqs(emb)
+    r_c = idx_c.search_fused_requests(list(reqs), **KW)
+    geom = idx_s._serve_geometry(len(reqs), "exact", idx_s.serve_k_max)
+    forced = PlanDecision(True, 1, 4, 0, 0, "test-chunked")
+    before = calls["n"]
+    r_s = idx_s._serve_planned(list(reqs), geom, forced,
+                               dict(KW), replanned=False)
+    assert calls["n"] == before + 1                # still ONE dispatch
+    _assert_results_equal(r_c, r_s)
+    _assert_state_bitwise(idx_c, idx_s)
+    assert idx_s.telemetry.counter_total("plan.scan_chunked") == 1
+
+
+def test_under_budget_geometry_still_one_dispatch(monkeypatch):
+    """Planner ACTIVE with a generous budget: the admitted fused path
+    costs exactly ONE donated dispatch — planning adds arithmetic, never
+    dispatches."""
+    counted = ("search_fused_ragged", "search_fused_ragged_copy",
+               "search_fused_ragged_read")
+    calls = {name: 0 for name in counted}
+    for name in counted:
+        orig = getattr(S, name)
+
+        def wrapped(*a, __orig=orig, __name=name, **kw):
+            calls[__name] += 1
+            return __orig(*a, **kw)
+
+        monkeypatch.setattr(S, name, wrapped)
+    idx, emb = _build_multitenant(hbm_budget_bytes=1 << 34)
+    idx.search_fused_requests(_mt_reqs(emb), **KW)
+    assert calls["search_fused_ragged"] == 1
+    assert calls["search_fused_ragged_copy"] == 0
+    assert calls["search_fused_ragged_read"] == 0
+    assert idx.telemetry.counter_total("plan.split_dispatches") == 0
+
+
+def test_throttled_budget_splits_with_bit_parity():
+    """End-to-end through the real admission path: a budget sized
+    between the one-bucket and full-batch predictions forces a planned
+    split whose results are bit-identical."""
+    idx_c, emb = _build_multitenant()
+    reqs = _mt_reqs(emb, per_tenant=8, boost=False)
+    r_c = idx_c.search_fused_requests(list(reqs), **KW)
+    m = idx_c.planner.model
+    g = idx_c._serve_geometry(len(reqs), "exact", idx_c.serve_k_max)
+    budget = int(m.predict(g.with_(batch=8, scan_chunk=8)) / 0.9) + 4096
+    idx_s, _ = _build_multitenant(hbm_budget_bytes=budget)
+    r_s = idx_s.search_fused_requests(list(reqs), **KW)
+    _assert_results_equal(r_c, r_s)
+    assert idx_s.telemetry.counter_total("plan.split_dispatches") >= 2
+    assert idx_s.telemetry.counter_total("plan.planned_turns") == 1
+
+
+# =====================================================================
+# typed rejection: PlanInfeasible at every admission surface
+# =====================================================================
+def test_infeasible_geometry_raises_typed():
+    idx, emb = _build_multitenant(hbm_budget_bytes=4096)  # < resident set
+    with pytest.raises(PlanInfeasible):
+        idx.search_fused_requests(_mt_reqs(emb), **KW)
+    assert idx.telemetry.counter_total("plan.infeasible") >= 1
+    with pytest.raises(PlanInfeasible):
+        idx.ingest_batch_dedup(_vecs(8, 3), [0.5] * 8, [EPOCH] * 8,
+                               ["semantic"] * 8, ["default"] * 8,
+                               "u0", dedup_gate=0.95)
+
+
+def test_scheduler_admission_sheds_infeasible_typed():
+    """The scheduler admission path (ISSUE 11): an infeasible geometry
+    fails the futures with the typed PlanInfeasible at submit — shed
+    like LoadShed, the queue and the device never see it."""
+    def never(reqs):                   # executor must never run
+        raise AssertionError("admitted an infeasible request")
+
+    def check(reqs):
+        raise PlanInfeasible("no split fits")
+
+    tel = Telemetry()
+    sched = QueryScheduler(never, telemetry=tel, admission_check=check)
+    futs = sched.submit_many([RetrievalRequest(
+        query=np.zeros(D, np.float32), tenant="t") for _ in range(3)])
+    for f in futs:
+        with pytest.raises(PlanInfeasible):
+            f.result(timeout=30)       # typed, immediate, never a hang
+    sched.close()
+    assert sched.requests_shed == 3
+    assert tel.counter_total("plan.infeasible_shed") == 3
+
+
+def test_scheduler_executor_planinfeasible_demuxes():
+    """Backstop: PlanInfeasible raised mid-batch by the executor demuxes
+    to every future of the batch like any typed error."""
+    def ex(reqs):
+        raise PlanInfeasible("grew past the budget after admission")
+
+    sched = QueryScheduler(ex, telemetry=Telemetry())
+    f = sched.submit(RetrievalRequest(query=np.zeros(D, np.float32),
+                                      tenant="t"))
+    with pytest.raises(PlanInfeasible):
+        f.result(timeout=30)
+    sched.close()
+
+
+def test_warmup_skips_infeasible_geometries():
+    idx, _ = _build_multitenant(hbm_budget_bytes=4096)
+    out = idx.warmup_serving(geometries=(8,))
+    assert out == {}                   # skipped typed, not crashed
+    assert idx.telemetry.counter_total("plan.warmup_skipped") >= 1
+    out_i = idx.warmup_ingest(geometries=(32,))
+    assert out_i == {}
+
+
+# =====================================================================
+# OOM replan: one replan through the copy twins, then typed failure
+# =====================================================================
+def test_oom_replan_uses_copy_twin(monkeypatch):
+    """The replan pass dispatches through the NON-donating twins — a
+    post-OOM retry can never consume the only copy of the arena."""
+    calls = {"donated": 0, "copy": 0}
+    orig_d, orig_c = S.search_fused_ragged, S.search_fused_ragged_copy
+
+    def wd(*a, **kw):
+        calls["donated"] += 1
+        return orig_d(*a, **kw)
+
+    def wc(*a, **kw):
+        calls["copy"] += 1
+        return orig_c(*a, **kw)
+
+    monkeypatch.setattr(S, "search_fused_ragged", wd)
+    monkeypatch.setattr(S, "search_fused_ragged_copy", wc)
+    idx_c, emb = _build_multitenant()
+    idx_f, _ = _build_multitenant(hbm_budget_bytes=1 << 34)
+    reqs = _mt_reqs(emb)
+    r_c = idx_c.search_fused_requests(list(reqs), **KW)
+    INJECTOR.arm("plan.oom", times=1, exc=oom_error)
+    r_f = idx_f.search_fused_requests(list(reqs), **KW)
+    assert calls["copy"] >= 2          # the replan's split sub-dispatches
+    _assert_results_equal(r_c, r_f)
+    _assert_state_bitwise(idx_c, idx_f)
+    assert idx_f.telemetry.counter_total("plan.oom_replans") == 1
+
+
+def test_oom_replan_exhausted_raises_planinfeasible():
+    """A second OOM on the replanned pass gives up typed — never an
+    unbounded replan loop."""
+    idx, emb = _build_multitenant(hbm_budget_bytes=1 << 34)
+    INJECTOR.arm("plan.oom", times=10, exc=oom_error)
+    with pytest.raises(PlanInfeasible):
+        idx.search_fused_requests(_mt_reqs(emb), **KW)
+    # bounded: one original pass + one replan pass, never 10 fires
+    assert INJECTOR.fired("plan.oom") <= 3
+    INJECTOR.clear()
+    r = idx.search_fused_requests(_mt_reqs(emb, boost=False), **KW)
+    assert all(x.ids for x in r)       # the index survived it all
+
+
+# =====================================================================
+# planned ingest split (mega-batch → sub-dispatches)
+# =====================================================================
+def test_ingest_plan_decision_and_calibration_feedback():
+    idx, _ = _build_multitenant(hbm_budget_bytes=1 << 34)
+    d = idx.plan_ingest(64)
+    assert d.fused
+    m = idx.planner.model
+    g = idx._ingest_geometry(64)
+    tight = int(m.predict(g.with_(batch=16)) / 0.9) + 4096
+    idx2, _ = _build_multitenant(hbm_budget_bytes=tight)
+    d2 = idx2.plan_ingest(64)
+    assert d2.splits > 1               # the drain will sub-batch
+    with pytest.raises(PlanInfeasible):
+        idx2.planner.check_feasible(
+            idx2._ingest_geometry(64).with_(rows=1 << 24),
+            chunkable=False)
+
+
+def test_memory_system_ingest_split_lands_all_facts(tmp_db, monkeypatch):
+    """A planner-split consolidation mega-batch lands every fact exactly
+    once (the in-dispatch dedup probe keeps sub-batches idempotent) and
+    records the planned ingest dispatches."""
+    from lazzaro_tpu.config import MemoryConfig
+    from lazzaro_tpu.core.memory_system import MemorySystem
+    from tests.test_fused_ingest import ClusteredEmb, QueueLLM
+
+    ms = MemorySystem(
+        enable_async=False, db_dir=tmp_db, verbose=False,
+        load_from_disk=False, llm_provider=QueueLLM(4),
+        embedding_provider=ClusteredEmb(), auto_prune=False,
+        max_buffer_size=10_000,
+        config=MemoryConfig(journal=True, auto_consolidate=False,
+                            decay_rate=0.0,
+                            hbm_budget_bytes=1 << 34))
+    monkeypatch.setattr(
+        type(ms.index), "plan_ingest",
+        lambda self, n, link_k=3: PlanDecision(True, 2, 0, 0, 0,
+                                               "test-forced"))
+    ms.start_conversation()
+    ms.add_to_short_term("turn one", "semantic", 0.6)
+    ms.end_conversation()
+    found = sum(1 for shard in ms.shards.values()
+                for n in shard.nodes.values()
+                if n.content.startswith("fact "))
+    assert found == 4                  # all facts landed exactly once
+    assert ms.telemetry.counter_total("plan.split_dispatches") >= 2
+    ms.close()
+
+
+def test_planner_stats_and_geometry_roundtrip():
+    idx, _ = _build_multitenant(hbm_budget_bytes=1 << 30)
+    idx.search_fused_requests(
+        _mt_reqs(_vecs(128, 0), per_tenant=1, boost=False), **KW)
+    st = idx.planner.stats()
+    assert st["active"] and st["decisions"] >= 1
+    g = idx._serve_geometry(8, "exact", 128)
+    assert g.kind == "serve" and g.rows == 256 and g.mesh_parts == 1
