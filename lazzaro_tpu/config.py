@@ -34,6 +34,30 @@ class MemoryConfig:
     # recall is controlled by nprobe (== n_clusters is exact). Consolidation
     # gates always use the exact master. Single-chip only.
     ivf_serving: int = 0
+    # Online IVF maintenance (ISSUE 12): with ivf_serving > 0 and a seeded
+    # build, cluster assignments are maintained INSIDE the fused ingest
+    # dispatch — the accepted batch is scored against the centroids in the
+    # same program that already computes the dedup/link score matrix, rows
+    # append to per-cluster member tables in-kernel (prefix-sum compacted,
+    # overflow rides the packed-readback flag and re-inserts host-side
+    # into the exact-scan extras), and a bounded mini-batch spherical
+    # k-means update amortizes centroid refinement over ingest batches.
+    # ``ivf_maintenance`` then demotes to a rare host-driven re-seed
+    # (centroid-count changes / heavy delete churn) — no stop-the-world
+    # k-means on the write path, assignments never stale behind a rebuild.
+    # Off = the PR 4 sealed/fresh split (every fresh row serves from the
+    # exact residual until the next offline rebuild).
+    ivf_online: bool = True
+    # Per-cluster member capacity of the online tables: capacity =
+    # factor · N/C (pow2-rounded) — the same knob build_ivf takes. Rows
+    # past a cluster's capacity overflow into the exact-scan extras
+    # (counted in ivf.member_overflows), never dropped.
+    ivf_member_cap_factor: int = 4
+    # Scale on the mini-batch centroid learning rate (eta_c =
+    # scale · b_c / (count_c + b_c)): 1.0 is the classic mini-batch
+    # k-means step; smaller values trade adaptation speed for assignment
+    # stability (lower ivf.assignment_staleness under drift).
+    ivf_online_eta: float = 1.0
     # Coarse-stage over-fetch slack shared by every two-stage serving path
     # (MemoryIndex.coarse_slack): the IVF member scan and the int8 fused
     # kernel both fetch k + slack coarse candidates before exact

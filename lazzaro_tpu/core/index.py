@@ -135,6 +135,8 @@ class MemoryIndex:
                  dtype=jnp.float32, epoch: Optional[float] = None,
                  mesh=None, shard_axis: str = "data",
                  int8_serving: bool = False, ivf_nprobe: int = 0,
+                 ivf_online: bool = True, ivf_member_cap_factor: int = 4,
+                 ivf_online_eta: float = 1.0,
                  pq_serving: bool = False, coarse_slack: int = 8,
                  telemetry=None, telemetry_hbm: bool = False,
                  serve_ragged: bool = True, serve_k_max: int = 128,
@@ -230,6 +232,18 @@ class MemoryIndex:
         self._ivf_in_residual = None       # np bool [rows]: in SEALED residual
         self._ivf_stale = 0                # member slots invalidated by delete
         self._ivf_res_cache = None         # (ivf, fresh, residual buf, dev)
+        # Online IVF maintenance (ISSUE 12): with a seeded build published,
+        # the LIVE coarse tables — ``(cent [C,d] f32, members [C,M] i32,
+        # counts [C] i32)`` — are device state the fused ingest kernels
+        # donate and update in the SAME dispatch that scores the batch
+        # (assignment, member append, mini-batch centroid step). Serving
+        # reads these live tables directly; the sealed residual shrinks to
+        # build-overflow + add()-path rows + member-capacity spills.
+        # ``ivf_maintenance`` demotes to a rare re-seed.
+        self.ivf_online = bool(ivf_online) and self.ivf_nprobe > 0
+        self.ivf_member_cap_factor = max(1, int(ivf_member_cap_factor))
+        self.ivf_online_eta = float(ivf_online_eta)
+        self._ivf_dev: Optional[tuple] = None  # (cent, members, counts)
         # Fused IVF serving tables (search_fused_requests): the exact-scan
         # extras array (sealed residual + fresh rows + super rows) cached
         # by snapshot identity like the residual cache.
@@ -353,9 +367,28 @@ class MemoryIndex:
             self._ivf_routed = None
             self._ivf_in_residual = None
             self._ivf_pack = None
+            self._ivf_dev = None
             return
         self._ivf_routed, self._ivf_in_residual = self._routed_bitmaps(v)
         self._ivf_pack = (v, ())
+        self._publish_online_tables(v)
+
+    def _publish_online_tables(self, ivf) -> None:
+        """Seed the LIVE device coarse tables from a build (ISSUE 12): the
+        build's centroids/members become the arrays the fused ingest
+        kernels append through and serving gathers from; ``counts`` is the
+        per-cluster append cursor (builds pack members as a dense
+        prefix)."""
+        if not self.ivf_online:
+            self._ivf_dev = None
+            return
+        from lazzaro_tpu.ops.ivf import online_counts
+        # jnp.array COPIES: the live tables must be solely owned so the
+        # fused ingest can donate them — aliasing the build's arrays would
+        # trip the refcount gate onto the copying twin forever
+        self._ivf_dev = (jnp.array(ivf.centroids, jnp.float32),
+                         jnp.array(ivf.members, jnp.int32),
+                         online_counts(ivf.members))
 
     def _routed_bitmaps(self, ivf) -> Tuple[np.ndarray, np.ndarray]:
         """(routed, in_sealed_residual) bool bitmaps over arena rows for a
@@ -539,30 +572,82 @@ class MemoryIndex:
                 or (sys.getrefcount(shadow[0]) <= self._SOLE_SHADOW_REFS
                     and sys.getrefcount(shadow[1]) <= self._SOLE_SHADOW_REFS))
 
+    def _ivf_online_arg(self):
+        """The live ``(cent, members, counts)`` coarse tables to thread
+        through the fused ingest program for in-dispatch maintenance, or
+        None when there is nothing to maintain (online IVF off, no seeded
+        build yet, or the pod-index mesh path — ``ivf_serving`` is
+        single-chip). Caller holds ``_state_lock``."""
+        if not self.ivf_online or self.mesh is not None:
+            return None
+        return self._ivf_dev
+
+    def _ivf_sole(self, ivf) -> bool:
+        # the _ivf_dev tuple's slot + getrefcount's argument; a serving
+        # dispatch holding the members/centroids forces the copying twin
+        # (indexing, not iteration — a loop variable would inflate the
+        # count and pin the gate on the copying twin forever)
+        return (ivf is None
+                or (sys.getrefcount(ivf[0]) <= self._SOLE_SHADOW_REFS
+                    and sys.getrefcount(ivf[1]) <= self._SOLE_SHADOW_REFS
+                    and sys.getrefcount(ivf[2]) <= self._SOLE_SHADOW_REFS))
+
+    def _store_ivf_dev(self, new_ivf) -> None:
+        if new_ivf is not None:
+            self._ivf_dev = tuple(new_ivf)
+
     def _apply_fused(self, *args, **kwargs):
         """Dispatch ``S.ingest_fused`` over BOTH states (plus the int8
-        shadow when it is being incrementally maintained), donating only
-        when this index holds the sole reference to each; returns
-        ``(link_flat, shadow_maintained)`` — the kernel's non-state
-        outputs and whether the shadow stayed fresh in-kernel (the caller
-        skips the dirty mark then)."""
+        shadow when it is being incrementally maintained, plus the live
+        online-IVF coarse tables), donating only when this index holds
+        the sole reference to each; returns ``(link_flat,
+        shadow_maintained, ivf_maintained)`` — the kernel's non-state
+        outputs and which sidecars stayed fresh in-kernel."""
+        sharded = self.ingest_sharded and self.mesh is not None
         with self._state_lock:
             arena, edges = self._state, self._edge_state
-            shadow = self._ingest_shadow_arg()
+            shadow = self._ingest_shadow_arg(sharded_ok=sharded)
+            ivf = self._ivf_online_arg()
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                     and sys.getrefcount(edges) <= self._SOLE_REFS
-                    and self._shadow_sole(shadow))
-            new_arena, new_edges, new_shadow, link_flat = self._guarded(
-                lambda fn: self._ingest_dispatch(fn, arena, edges, shadow,
-                                                 *args, **kwargs),
-                S.ingest_fused, S.ingest_fused_copy, sole,
-                (arena, edges, shadow), "ingest")
-            del arena, edges, shadow
+                    and self._shadow_sole(shadow) and self._ivf_sole(ivf))
+            if sharded:
+                # Non-dedup ingest under a mesh (ISSUE 12 satellite): the
+                # distributed plain-ingest program replaces the GSPMD
+                # fallback — ONE distributed dispatch, owner-chip writes.
+                k = kwargs.pop("k")
+                shard_modes = tuple(kwargs.pop("shard_modes"))
+                kern = self._ingest_sharded_kernels(
+                    k, shard_modes, shadow is not None, dedup=False)
+                state_args = (arena, edges) + (
+                    shadow if shadow is not None else ())
+                got = self._guarded(
+                    lambda fn: self._ingest_dispatch(fn, *state_args,
+                                                     *args),
+                    kern.ingest, kern.ingest_copy, sole,
+                    (arena, edges, shadow), "ingest_sharded")
+                if shadow is not None:
+                    new_arena, new_edges, q8n, sn, link_flat = got
+                    new_shadow = (q8n, sn)
+                else:
+                    new_arena, new_edges, link_flat = got
+                    new_shadow = None
+                new_ivf = None
+            else:
+                (new_arena, new_edges, new_shadow, new_ivf,
+                 link_flat) = self._guarded(
+                    lambda fn: self._ingest_dispatch(fn, arena, edges,
+                                                     shadow, ivf, *args,
+                                                     **kwargs),
+                    S.ingest_fused, S.ingest_fused_copy, sole,
+                    (arena, edges, shadow, ivf), "ingest")
+            del arena, edges, shadow, ivf
             self.state = new_arena
             self.edge_state = new_edges
             if new_shadow is not None:
                 self._int8_shadow = new_shadow
-        return link_flat, new_shadow is not None
+            self._store_ivf_dev(new_ivf)
+        return link_flat, new_shadow is not None, new_ivf is not None
 
     # ------------------------------------------------------------------ ids
     def tenant_id(self, name: str) -> int:
@@ -597,6 +682,8 @@ class MemoryIndex:
             "int8_serving": self.int8_serving,
             "ivf": (f"nprobe={self.ivf_nprobe}, "
                     f"{'built' if self._ivf is not None else 'pending'}"
+                    + (", online" if self.ivf_online
+                       and self._ivf_dev is not None else "")
                     + (", pq" if self.pq_serving else "")
                     if self.ivf_nprobe else None),
             "mesh": (f"{self._n_parts}x {self.shard_axis}"
@@ -764,6 +851,92 @@ class MemoryIndex:
             # or the new (build, fresh) pair, never a torn mix
             self._ivf_pack = (ivf, ivf_fresh + tuple(appended))
 
+    def _ivf_note_online(self, rows: Sequence[int], live: Sequence[bool],
+                         ivf_host) -> None:
+        """Host bookkeeping after an in-dispatch online-IVF update
+        (ISSUE 12): rows the kernel appended to their cluster's member
+        table are marked routed (they serve from the coarse tables
+        immediately — never stale, no residual growth); rows whose
+        cluster was FULL (readback position -1) re-insert host-side into
+        the exact-scan extras, exactly like link-pool overflow. The
+        trailing counters ride the same readback — zero added
+        dispatches."""
+        pos_w = ivf_host[1]
+        appended, spilled = [], []
+        for i, (r, lv) in enumerate(zip(rows, live)):
+            if not lv:
+                continue
+            (appended if int(pos_w[i, 0]) >= 0 else spilled).append(r)
+        if appended:
+            routed = self._ivf_routed
+            if routed is not None:
+                if len(routed) < self.state.emb.shape[0]:
+                    grown = np.zeros((self.state.emb.shape[0],), bool)
+                    grown[:len(routed)] = routed
+                    self._ivf_routed = routed = grown
+                routed[appended] = True
+        if spilled:
+            self.telemetry.bump("ivf.member_overflows", len(spilled))
+            self._ivf_note_added(spilled)
+        tel = self.telemetry
+        dev = self._ivf_dev
+        if dev is not None:
+            slots = int(dev[1].shape[0]) * int(dev[1].shape[1])
+            tel.gauge("ivf.member_pool_occupancy",
+                      float(ivf_host[3][0, 0]) / max(slots, 1))
+        tel.bump("ivf.appends", int(ivf_host[4][0, 0]))
+        tel.bump("ivf.centroid_shift_ppm", int(ivf_host[5][0, 0]))
+
+    def _ivf_on_demoted(self, rows: Sequence[int]) -> None:
+        """Tier-demotion hook (ISSUE 12): demoted rows DROP out of the
+        live member tables — their master embedding was just zeroed by
+        the commit-then-zero demote, so a member slot pointing at them
+        must never feed the exact in-kernel rescore again (the ivf_tiered
+        kernel also masks members by the residency column, so this device
+        scrub is capacity hygiene plus defense in depth, on the
+        background demote path — never a serving dispatch). Member-routed
+        rows count toward the re-seed trigger like delete churn; rows
+        living in the extras (fresh / sealed residual) stay routed —
+        their entries are residency-masked while cold and become valid
+        again the moment a promote restores the master row."""
+        pack = self._ivf_pack
+        if (not self.ivf_online or self._ivf_dev is None or pack is None
+                or not rows):
+            return
+        with self._state_lock:
+            dev = self._ivf_dev
+            drop = np.zeros((self.state.emb.shape[0],), bool)
+            drop[[r for r in rows if r < len(drop)]] = True
+            members = dev[1]
+            fn = (S.ivf_members_drop
+                  if sys.getrefcount(members) <= 3
+                  else S.ivf_members_drop_copy)
+            new_members = fn(members, jnp.asarray(drop))
+            del members
+            self._ivf_dev = (dev[0], new_members, dev[2])
+        routed = self._ivf_routed
+        fresh_set = set(pack[1])
+        in_res = self._ivf_in_residual
+        for r in rows:
+            if routed is None or r >= len(routed) or not routed[r]:
+                continue
+            if r in fresh_set:
+                continue
+            if in_res is not None and r < len(in_res) and in_res[r]:
+                continue
+            routed[r] = False
+            self._ivf_stale += 1
+
+    def _ivf_on_promoted(self, rows: Sequence[int]) -> None:
+        """Tier-promotion hook (ISSUE 12): a promoted row's exact master
+        embedding is back, but its member slot was scrubbed on demotion —
+        it re-enters coverage through the exact-scan extras (the next
+        ingest-time re-seed folds it back into a cluster). Rows that were
+        never scrubbed (extras-resident) are already routed — no-op."""
+        if not self.ivf_online or self._ivf_dev is None:
+            return
+        self._ivf_note_added(rows)
+
     def ingest_batch(self, ids: Sequence[str], embeddings: np.ndarray,
                      saliences: Sequence[float], timestamps: Sequence[float],
                      types: Sequence[str], shard_keys: Sequence[str],
@@ -884,9 +1057,12 @@ class MemoryIndex:
                                         ecap)
 
         now_rel = (now if now is not None else time.time()) - self.epoch
+        kind = ("sharded_fused"
+                if self.ingest_sharded and self.mesh is not None
+                else "fused")
         t0 = time.perf_counter()
-        with trace_annotation("lz.ingest.fused"):
-            link_flat, shadow_fresh = self._apply_fused(
+        with trace_annotation(f"lz.ingest.{kind}"):
+            link_flat, shadow_fresh, ivf_fresh = self._apply_fused(
                 jnp.asarray(padded), jnp.asarray(emb),
                 jnp.asarray(pad([float(s) for s in saliences])),
                 jnp.asarray(pad([float(t) - self.epoch
@@ -903,27 +1079,32 @@ class MemoryIndex:
                 jnp.asarray(c_w), link_pool, jnp.int32(len(link_pool_list)),
                 jnp.float32(now_rel), jnp.int32(tid),
                 jnp.float32(link_gate), jnp.float32(link_scale),
+                jnp.float32(self.ivf_online_eta),
                 k=k_eff, shard_modes=shard_modes)
             if not shadow_fresh:
                 self._int8_dirty = True
             self._pq_dirty = True
             self._emb_gen += 1
             self._note_super(rows, [bool(x) for x in is_super])
-            self._ivf_note_added(rows)
             if self.tiering is not None:   # a re-added cold row is hot again
                 self.tiering.on_rows_written(rows)
 
             host = fetch_packed(*link_flat)    # the ONE readback
         self.telemetry.record("ingest.dispatch_ms",
                               (time.perf_counter() - t0) * 1e3,
-                              labels={"kind": "fused"})
+                              labels={"kind": kind})
         # Device-side ingest counters riding the same readback (ISSUE 6):
         # overflow flag + accepted-link count + pool-slot occupancy are the
-        # trailing broadcast leaves after the per-mode triples.
+        # trailing broadcast leaves after the per-mode triples (the online
+        # IVF leaves, when maintained, trail those — ISSUE 12).
         ctr = host[3 * n_modes:]
-        self.telemetry.bump("ingest.dispatches", labels={"kind": "fused"})
+        self.telemetry.bump("ingest.dispatches", labels={"kind": kind})
         self.telemetry.bump("ingest.links_accepted", int(ctr[1][0, 0]))
         self.telemetry.bump("ingest.pool_slots_used", int(ctr[2][0, 0]))
+        if ivf_fresh:
+            self._ivf_note_online(rows, [True] * n, ctr[3:])
+        else:
+            self._ivf_note_added(rows)
         pool_real = len(link_pool_list)
         candidates: Dict[int, Dict[str, List[Tuple[str, float]]]] = {}
         created: Dict[int, List[Tuple[str, str, float]]] = {}
@@ -1005,16 +1186,18 @@ class MemoryIndex:
         return fn(*args, **kwargs)
 
     def _ingest_sharded_kernels(self, k: int, shard_modes: Tuple[int, ...],
-                                with_shadow: bool) -> S.IngestShardedKernels:
+                                with_shadow: bool, dedup: bool = True
+                                ) -> S.IngestShardedKernels:
         """Cached distributed fused-ingest programs per (k, shard-mode
-        tuple, shadow-maintained) key — batch geometry is a jit retrace
-        within one program, exactly like the single-chip kernels."""
-        key = (k, shard_modes, with_shadow)
+        tuple, shadow-maintained, dedup) key — batch geometry is a jit
+        retrace within one program, exactly like the single-chip
+        kernels."""
+        key = (k, shard_modes, with_shadow, dedup)
         kern = self._ingest_sharded_cache.get(key)
         if kern is None:
             kern = S.make_ingest_fused_sharded(
                 self.mesh, self.shard_axis, k=k, shard_modes=shard_modes,
-                with_shadow=with_shadow)
+                with_shadow=with_shadow, dedup=dedup)
             self._ingest_sharded_cache.put(key, kern)
             self.telemetry.gauge("kernel.cache_entries",
                                  len(self._ingest_sharded_cache),
@@ -1033,9 +1216,10 @@ class MemoryIndex:
         with self._state_lock:
             arena, edges = self._state, self._edge_state
             shadow = self._ingest_shadow_arg(sharded_ok=sharded)
+            ivf = self._ivf_online_arg()
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                     and sys.getrefcount(edges) <= self._SOLE_REFS
-                    and self._shadow_sole(shadow))
+                    and self._shadow_sole(shadow) and self._ivf_sole(ivf))
             if sharded:
                 kern = self._ingest_sharded_kernels(k, tuple(shard_modes),
                                                     shadow is not None)
@@ -1053,19 +1237,22 @@ class MemoryIndex:
                         kern.ingest, kern.ingest_copy, sole,
                         (arena, edges), "ingest_sharded")
                     new_shadow = None
+                new_ivf = None
             else:
-                new_arena, new_edges, new_shadow, flat = self._guarded(
+                (new_arena, new_edges, new_shadow, new_ivf,
+                 flat) = self._guarded(
                     lambda fn: self._ingest_dispatch(
-                        fn, arena, edges, shadow, *args, k=k,
+                        fn, arena, edges, shadow, ivf, *args, k=k,
                         shard_modes=shard_modes),
                     S.ingest_dedup_fused, S.ingest_dedup_fused_copy, sole,
-                    (arena, edges, shadow), "ingest")
-            del arena, edges, shadow
+                    (arena, edges, shadow, ivf), "ingest")
+            del arena, edges, shadow, ivf
             self.state = new_arena
             self.edge_state = new_edges
             if new_shadow is not None:
                 self._int8_shadow = new_shadow
-        return flat, new_shadow is not None
+            self._store_ivf_dev(new_ivf)
+        return flat, new_shadow is not None, new_ivf is not None
 
     def _ingest_geometry(self, n: int, link_k: int = 3) -> Geometry:
         return Geometry(
@@ -1074,7 +1261,8 @@ class MemoryIndex:
             k=max(1, int(link_k)),
             dtype_bytes=int(np.dtype(self.dtype).itemsize),
             mesh_parts=self._n_parts, edge_cap=self.edge_state.capacity,
-            link_k=max(1, int(link_k)))
+            link_k=max(1, int(link_k)),
+            ivf=1 if self._ivf_online_arg() is not None else 0)
 
     def plan_ingest(self, n: int, link_k: int = 3):
         """Admission decision for an ``n``-fact fused ingest mega-batch
@@ -1173,14 +1361,15 @@ class MemoryIndex:
             jnp.int32(len(link_pool_list)),
             jnp.float32(now_abs - self.epoch), jnp.int32(tid),
             jnp.float32(dedup_gate), jnp.float32(chain_weight),
-            jnp.float32(link_gate), jnp.float32(link_scale))
+            jnp.float32(link_gate), jnp.float32(link_scale),
+            jnp.float32(self.ivf_online_eta))
         kind = ("sharded_dedup_fused"
                 if self.ingest_sharded and self.mesh is not None
                 else "dedup_fused")
         self._maybe_record_ingest_hbm(dev_args, k_eff, shard_modes, b)
         t0 = time.perf_counter()
         with trace_annotation(f"lz.ingest.{kind}"):
-            flat, shadow_fresh = self._apply_dedup_fused(
+            flat, shadow_fresh, ivf_fresh = self._apply_dedup_fused(
                 *dev_args, k=k_eff, shard_modes=shard_modes)
             if not shadow_fresh:
                 self._int8_dirty = True
@@ -1191,7 +1380,9 @@ class MemoryIndex:
                               (time.perf_counter() - t0) * 1e3,
                               labels={"kind": kind})
         # Device counters riding the same readback: dedup verdicts are the
-        # first wide leaf; the link counters trail the per-mode triples.
+        # first wide leaf; the link counters trail the per-mode triples,
+        # and the online-IVF leaves (assign, member pos, 4 counters —
+        # ISSUE 12) trail those when the coarse tables were maintained.
         ctr = host[3 + 3 * n_modes:]
         self.telemetry.bump("ingest.dispatches",
                             labels={"kind": kind})
@@ -1209,6 +1400,7 @@ class MemoryIndex:
             "chain_slots": chain_slot_list,
             "link_pool": link_pool_list,
             "link_host": host[3:],
+            "ivf_host": (ctr[3:] if ivf_fresh else None),
         }
 
     def commit_ingest_dedup(self, pending: dict, ids: Sequence[Optional[str]]
@@ -1304,7 +1496,13 @@ class MemoryIndex:
         self._free_edge_slots.extend(link_pool[consumed:])
         self._free_edge_slots.extend(reclaim)
         self._csr_dirty = True
-        self._ivf_note_added(live_rows)
+        if pending.get("ivf_host") is not None:
+            # in-dispatch member appends: routed immediately, spills to
+            # the exact-scan extras (ISSUE 12)
+            self._ivf_note_online(rows, [not d for d in dup],
+                                  pending["ivf_host"])
+        else:
+            self._ivf_note_added(live_rows)
         if overflowed:
             self.link_pool_overflows += 1
             self.telemetry.bump("ingest.link_pool_overflows")
@@ -1323,8 +1521,9 @@ class MemoryIndex:
         One extra compile, zero extra dispatches."""
         if not self.telemetry_hbm or not self.telemetry.enabled:
             return    # never consume the once-key while warmup mutes the registry
+        ivf_on = self._ivf_online_arg() is not None
         key = ("ingest", b, k_eff, tuple(shard_modes),
-               self.state.emb.shape[0])
+               self.state.emb.shape[0], ivf_on)
         if key in self._hbm_recorded:
             return
         self._hbm_recorded.add(key)
@@ -1333,6 +1532,7 @@ class MemoryIndex:
                 arena, edges = self._state, self._edge_state
                 sharded = self.ingest_sharded and self.mesh is not None
                 shadow = self._ingest_shadow_arg(sharded_ok=sharded)
+                ivf = self._ivf_online_arg()
                 if sharded:
                     kern = self._ingest_sharded_kernels(
                         k_eff, tuple(shard_modes), shadow is not None)
@@ -1341,18 +1541,22 @@ class MemoryIndex:
                                                      *dev_args)
                 else:
                     lowered = S.ingest_dedup_fused_copy.lower(
-                        arena, edges, shadow, *dev_args, k=k_eff,
+                        arena, edges, shadow, ivf, *dev_args, k=k_eff,
                         shard_modes=tuple(shard_modes))
             peak = peak_bytes(lowered.compile().memory_analysis())
         except Exception:   # noqa: BLE001 — observability must never block ingest
             return
         if peak is not None:
-            self.telemetry.gauge(
-                "kernel.peak_hbm_bytes", peak,
-                labels={"path": "ingest", "batch": str(b),
-                        "rows": str(self.state.emb.shape[0]),
-                        "mesh": (f"{self._n_parts}x{self.shard_axis}"
-                                 if self.mesh is not None else "1")})
+            labels = {"path": "ingest", "batch": str(b),
+                      "rows": str(self.state.emb.shape[0]),
+                      "mesh": (f"{self._n_parts}x{self.shard_axis}"
+                               if self.mesh is not None else "1")}
+            if ivf_on:
+                # the AOT gauge the ivf-aware ingest cost model (ISSUE 12
+                # satellite) calibrates against
+                labels["ivf"] = "true"
+            self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
+                                 labels=labels)
             self.planner.observe_gauge(
                 self._ingest_geometry(b, k_eff), peak)
 
@@ -1590,45 +1794,75 @@ class MemoryIndex:
         k_fetch = min(k_eff + self.coarse_slack, n_cand)
         mask = S.arena_mask(st, jnp.int32(tid), super_filter)
         pq_pack = self._pq_pack
+        cent, members = self._ivf_live_tables(ivf)
         if self.pq_serving and pq_pack is not None:
             from lazzaro_tpu.ops.pq import ivf_pq_search
 
             codes = self._pq_codes_for(st, pq_pack)
             scores, rows = ivf_pq_search(
-                ivf.centroids, ivf.members, residual, pq_pack[0].centroids,
+                cent, members, residual, pq_pack[0].centroids,
                 codes, st.emb, mask, S.normalize(q_pad), k_fetch,
                 nprobe=self.ivf_nprobe, r=max(4 * k_eff, 64))
         else:
-            scores, rows = ivf_search(ivf.centroids, ivf.members, residual,
+            scores, rows = ivf_search(cent, members, residual,
                                       st.emb, mask, S.normalize(q_pad),
                                       k_fetch, nprobe=self.ivf_nprobe)
         return fetch_packed(scores, rows)      # ONE readback RTT
 
     def ivf_maintenance(self, iters: int = 8) -> bool:
-        """Build or refresh the coarse index; returns True if a (re)build
-        ran. Rebuilds only when the fresh residual outgrows 25% of the
-        sealed build. This is the ONLY place the k-means runs — call it
-        from background maintenance (the consolidation worker does), never
+        """Build or re-seed the coarse index; returns True if a (re)build
+        ran. This is the ONLY place the k-means runs — call it from
+        background maintenance (the consolidation worker does), never
         from a serving query. ``iters`` caps the k-means refinement steps
         (bench/maintenance knob; centroids only steer the coarse routing,
-        so fewer iters trade a little recall-per-nprobe for build time)."""
+        so fewer iters trade a little recall-per-nprobe for build time).
+
+        With ``ivf_online`` OFF this is the classic periodic rebuild
+        (fresh residual outgrows 25% of the sealed build). With online
+        maintenance ON (ISSUE 12), assignments are kept by the fused
+        ingest dispatch itself, so this demotes to a RARE host-driven
+        re-seed that only fires when the cluster-count geometry changed
+        (the corpus grew/shrank enough that √N wants a different C —
+        something no incremental step can do) or delete/overflow churn
+        degraded the tables past the same 25% of the build (stale member
+        holes + residual spill — a re-seed also re-packs the tables).
+        Growth-by-ingest alone never trips it: appends routed rows, not
+        residual."""
         if not self.ivf_nprobe:
             return False
         n_alive = len(self.id_to_row)
         if n_alive < self._IVF_MIN_ROWS:
             return False
         pack = self._ivf_pack
-        if (pack is not None
-                and len(pack[1]) + self._ivf_stale <= pack[0].built_rows // 4):
-            # staleness = rows awaiting a member slot PLUS member slots
-            # invalidated by delete — churn at stable row count still trips
-            # the trigger (advisor r4)
-            return False
+        if pack is not None:
+            churn = len(pack[1]) + self._ivf_stale
+            if self.ivf_online and self._ivf_dev is not None:
+                # re-seed only when the IDEAL cluster count (raw √N, not
+                # the pow2 rounding — which would double the instant a
+                # corpus sitting exactly at 2^k grows by one row) drifted
+                # ≥2× from the live table, or churn degraded the tables
+                cur_c = max(1, int(self._ivf_dev[0].shape[0]))
+                want_raw = max(4, int(np.sqrt(n_alive)))
+                count_changed = (want_raw >= 2 * cur_c
+                                 or 4 * want_raw <= cur_c)
+                if not count_changed and churn <= pack[0].built_rows // 4:
+                    return False
+            elif churn <= pack[0].built_rows // 4:
+                # staleness = rows awaiting a member slot PLUS member
+                # slots invalidated by delete — churn at stable row count
+                # still trips the trigger (advisor r4)
+                return False
         from lazzaro_tpu.ops.ivf import build_ivf
 
         st = self.state
         mask_np = np.asarray(st.alive)
-        ivf = build_ivf(st.emb, mask_np, iters=iters)
+        if self.tiering is not None and self.tiering.cold_count:
+            # Cold rows' master embeddings are zeroed (commit-then-zero
+            # demotion) — never cluster them on garbage; the residency-
+            # masked shadow coarse path serves them (ISSUE 12).
+            mask_np = mask_np & ~self.tiering.cold_np[:len(mask_np)]
+        ivf = build_ivf(st.emb, mask_np, iters=iters,
+                        member_cap_factor=self.ivf_member_cap_factor)
         routed, in_res = self._routed_bitmaps(ivf)
         # writer-side bookkeeping first, the reader-visible pack LAST — a
         # reader can only ever observe a fully-initialized build
@@ -1638,6 +1872,7 @@ class MemoryIndex:
         self._ivf_res_cache = None
         self._ivf_serve_cache = None
         self._ivf_pack = (ivf, ())
+        self._publish_online_tables(ivf)
         if self.pq_serving:
             # (re)train the member codebook on the same build cadence; the
             # codes shadow re-encodes lazily on the serving path. ONE pack
@@ -1647,6 +1882,27 @@ class MemoryIndex:
             self._pq_dirty = True
             self._pq_pack = (train_pq(st.emb, mask_np), None)
         return True
+
+    def ivf_staleness_probe(self) -> Optional[float]:
+        """Measured ``assignment_staleness`` of the live coarse tables:
+        the fraction of member slots whose row would pick a DIFFERENT
+        centroid under the current centroids (mini-batch drift strands
+        old members; an offline rebuild measures 0.0 by construction).
+        O(N·C) — a bench/maintenance DIAGNOSTIC, never the serving path.
+        Records the ``ivf.assignment_staleness`` gauge and returns the
+        fraction, or None without live tables."""
+        dev = self._ivf_dev
+        if dev is None:
+            return None
+        from lazzaro_tpu.ops.ivf import assignment_staleness
+
+        st = self.state
+        mask = np.asarray(st.alive)
+        if self.tiering is not None and self.tiering.cold_count:
+            mask = mask & ~self.tiering.cold_np[:len(mask)]
+        frac = assignment_staleness(st.emb, mask, dev[0], dev[1])
+        self.telemetry.gauge("ivf.assignment_staleness", frac)
+        return frac
 
     def _pq_codes_for(self, st: S.ArenaState, pack):
         """Lazy re-encode of the PQ code shadow from ONE arena snapshot
@@ -1716,6 +1972,16 @@ class MemoryIndex:
         self._ivf_serve_cache = (ivf, fresh, ivf.residual, supers, dev)
         return dev
 
+    def _ivf_live_tables(self, ivf):
+        """(centroids, members) the serving scans gather through: the LIVE
+        online tables when in-dispatch maintenance is on (ISSUE 12 — the
+        serve always sees the last ingest's appends and centroid step, no
+        cache in between), the sealed build arrays otherwise."""
+        dev = self._ivf_dev
+        if self.ivf_online and dev is not None:
+            return dev[0], dev[1]
+        return ivf.centroids, ivf.members
+
     def _ivf_fused_pack(self, k_kernel: int):
         """(centroids, members, extras, nprobe) tables for the fused IVF
         serving kernel, or None to serve the dense fused path instead.
@@ -1723,7 +1989,10 @@ class MemoryIndex:
         active (that path keeps its own classic scan), no build exists yet
         (builds happen in ``ivf_maintenance``, NEVER on the query path),
         or the visited-cluster + extras candidate count can't fill the
-        kernel's k (the dense scan is trivially cheap there anyway)."""
+        kernel's k (the dense scan is trivially cheap there anyway).
+        With online IVF the centroid/member tables are the LIVE device
+        arrays the fused ingest maintains — the serve-table identity IS
+        the table, so there is nothing to invalidate."""
         if not self.ivf_nprobe or self.mesh is not None or self.pq_serving:
             return None
         pack = self._ivf_pack
@@ -1731,11 +2000,12 @@ class MemoryIndex:
             return None
         ivf, fresh = pack
         extras = self._ivf_extras_dev(ivf, fresh)
-        nprobe = min(self.ivf_nprobe, ivf.n_clusters)
-        n_cand = nprobe * ivf.members.shape[1] + extras.shape[0]
+        cent, members = self._ivf_live_tables(ivf)
+        nprobe = min(self.ivf_nprobe, int(cent.shape[0]))
+        n_cand = nprobe * members.shape[1] + extras.shape[0]
         if n_cand < k_kernel:
             return None
-        return ivf.centroids, ivf.members, extras, nprobe
+        return cent, members, extras, nprobe
 
     def _int8_shadow_for(self, st: S.ArenaState):
         """(Re)build the int8 shadow from ONE arena snapshot; under a mesh
@@ -1842,6 +2112,11 @@ class MemoryIndex:
                     else "quant" if self.int8_serving else "exact")
             return "sharded_" + base, k_bucket
         if tiered:
+            # IVF composes with tiering now (ISSUE 12): hot candidates
+            # from the member gather, cold rows from the shadow coarse
+            # scan — no dense fallback when a build is published.
+            if self._ivf_fused_pack(k_bucket) is not None:
+                return "ivf_tiered", k_bucket
             return "tiered", k_bucket
         if self._ivf_fused_pack(k_bucket) is not None:
             return "ivf", k_bucket
@@ -2131,16 +2406,21 @@ class MemoryIndex:
         # traffic ~(C + nprobe·N/C)·d per query — and ``ivf_nprobe > 0``
         # no longer opts out of fusion. With int8 ALSO on, the candidate
         # scan itself is two-stage (int8 gathered coarse + exact rescore).
-        # With cold rows present the tiered program takes precedence: its
-        # full-corpus int8 coarse scan is the only structure that still
-        # covers demoted rows (their master embedding is host-resident).
-        ivf_tabs = None if tiered else self._ivf_fused_pack(k_bucket)
+        # With cold rows present IVF now COMPOSES with tiering (ISSUE 12
+        # — the PR 8 dense-fallback is gone): hot candidates come from the
+        # member gather (demoted rows dropped from the tables and masked
+        # by residency), cold rows from the residency-masked int8 shadow
+        # coarse scan, merged at the k+slack window for the same bounded
+        # cold finish.
+        ivf_tabs = self._ivf_fused_pack(k_bucket)
+        ivf_tiered = tiered and ivf_tabs is not None
         if ivf_tabs is not None:
             statics["nprobe"] = ivf_tabs[3]
             statics["slack"] = self.coarse_slack
         elif use_quant or tiered:
             statics["slack"] = self.coarse_slack
-        mode = ("tiered" if tiered
+        mode = ("ivf_tiered" if ivf_tiered
+                else "tiered" if tiered
                 else "ivf" if ivf_tabs is not None
                 else "quant" if use_quant else "exact")
         # Ragged sidecar device columns (ISSUE 7): per-query k / cap /
@@ -2197,7 +2477,24 @@ class MemoryIndex:
                     # at the end executes it donation-safe (ISSUE 10):
                     # a transient failure retries through the copying
                     # twin, a consumed input raises typed ArenaPoisoned.
-                    if tiered:
+                    if ivf_tiered:
+                        # IVF × tiering (ISSUE 12): member gather for hot,
+                        # residency-masked shadow coarse for cold — all
+                        # taken against ``cur`` under the lock
+                        q8, scale = self._int8_shadow_for(cur)
+                        cold_dev = tm.cold_mask_dev()
+                        cent, members, extras, _ = ivf_tabs
+                        pre = (q8, scale, cold_dev, cent, members, extras)
+                        if ragged:
+                            twins = (S.search_fused_ivf_tiered_ragged,
+                                     S.search_fused_ivf_tiered_ragged_copy)
+                            boost_args = (boost_dev, k_dev, capq_dev,
+                                          npq_dev) + scalars
+                        else:
+                            twins = (S.search_fused_ivf_tiered,
+                                     S.search_fused_ivf_tiered_copy)
+                            boost_args = (boost_dev,) + scalars
+                    elif tiered:
                         # (arena, shadow, residency) all taken against
                         # ``cur`` under the lock — the triple never tears
                         q8, scale = self._int8_shadow_for(cur)
@@ -2262,6 +2559,19 @@ class MemoryIndex:
                         "serve_" + mode)
                     del cur
                     self.state = new_state
+            elif ivf_tiered:
+                q8, scale = self._int8_shadow_for(st)
+                cold_dev = tm.cold_mask_dev()
+                cent, members, extras, _ = ivf_tabs
+                if ragged:
+                    packed = S.search_fused_ivf_tiered_ragged_read(
+                        st, q8, scale, cold_dev, cent, members, extras,
+                        *args, k_dev, npq_dev, jnp.float32(super_gate),
+                        **statics)
+                else:
+                    packed = S.search_fused_ivf_tiered_read(
+                        st, q8, scale, cold_dev, cent, members, extras,
+                        *args, jnp.float32(super_gate), **statics)
             elif tiered:
                 q8, scale = self._int8_shadow_for(st)
                 cold_dev = tm.cold_mask_dev()
@@ -2451,7 +2761,19 @@ class MemoryIndex:
             return
         self._hbm_recorded.add(key)
         try:
-            if tier_pack is not None:
+            if tier_pack is not None and ivf_tabs is not None:
+                q8, scale, cold_dev = tier_pack
+                cent, members, extras, _ = ivf_tabs
+                if ragged:
+                    lowered = S.search_fused_ivf_tiered_ragged_read.lower(
+                        st, q8, scale, cold_dev, cent, members, extras,
+                        *args, k_dev, npq_dev, jnp.float32(super_gate),
+                        **statics)
+                else:
+                    lowered = S.search_fused_ivf_tiered_read.lower(
+                        st, q8, scale, cold_dev, cent, members, extras,
+                        *args, jnp.float32(super_gate), **statics)
+            elif tier_pack is not None:
                 q8, scale, cold_dev = tier_pack
                 if ragged:
                     lowered = S.search_fused_tiered_ragged_read.lower(

@@ -157,6 +157,10 @@ class MemorySystem:
                                  dtype=jnp.dtype(cfg.dtype), mesh=mesh,
                                  int8_serving=cfg.int8_serving,
                                  ivf_nprobe=cfg.ivf_serving,
+                                 ivf_online=cfg.ivf_online,
+                                 ivf_member_cap_factor=(
+                                     cfg.ivf_member_cap_factor),
+                                 ivf_online_eta=cfg.ivf_online_eta,
                                  pq_serving=cfg.pq_serving,
                                  coarse_slack=cfg.coarse_fetch_slack,
                                  telemetry=self.telemetry,

@@ -627,10 +627,112 @@ def _shadow_scatter(shadow, rows: jax.Array, emb_stored: jax.Array):
     return (q8.at[rows].set(q_new), scale.at[rows].set(s_new))
 
 
+def _ivf_online_assign(cent: jax.Array, qf: jax.Array, live: jax.Array
+                       ) -> jax.Array:
+    """Cluster assignment of the accepted batch against the CURRENT
+    centroids — the marginal [B, C] matmul the online-IVF tentpole rides
+    on (the same dispatch already streams the [B, rows] dedup/link score
+    matrix, so C ≈ √rows extra columns are noise). Ties resolve to the
+    lowest centroid id (``argmax``), matching ``ops.ivf._assign_device``.
+    Dead/padded facts route to bucket C (one past the end — every scatter
+    built on it drops)."""
+    cs = jnp.dot(qf, cent.T, preferred_element_type=jnp.float32)  # [B, C]
+    assign = jnp.argmax(cs, axis=1).astype(jnp.int32)
+    return jnp.where(live, assign, cent.shape[0])
+
+
+def _ivf_online_update(ivf, rows: jax.Array, qf: jax.Array,
+                       live: jax.Array, eta_scale: jax.Array):
+    """Online IVF maintenance INSIDE the fused ingest program (ISSUE 12):
+    score the accepted facts against the centroids, append each live row
+    to its cluster's member table via the same prefix-sum compaction idiom
+    as the gated link insert (an accepted append whose position lands past
+    the cluster capacity scatters out of bounds — dropped, never a phantom
+    write — and its readback position reports -1 so the host re-inserts
+    it into the exact-scan extras, exactly like link-pool overflow), then
+    blend a bounded mini-batch spherical k-means step into the centroids:
+    ``cent_c ← normalize((1 - η_c)·cent_c + η_c·mean(batch_c))`` with
+    ``η_c = eta_scale · b_c / (count_c + b_c)`` — the classic mini-batch
+    step, so a mature cluster barely moves per batch and the update term
+    is O(B·C·d), not O(rows).
+
+    ``ivf = (cent [C, d] f32 normalized, members [C, M] i32 -1-padded,
+    counts [C] i32 live-prefix occupancy)``; all three are donated state.
+    Returns ``(new_ivf, assign [B] (-1 = not live), pos [B] (member slot,
+    -1 = overflowed/not live), (overflow, occupancy, appends, shift_ppm)
+    int32 scalars for the readback tail)``."""
+    cent, members, counts = ivf
+    C, M = members.shape
+    b = rows.shape[0]
+    a = _ivf_online_assign(cent, qf, live)                 # [B], dead -> C
+    assign = jnp.where(live, a, -1)
+    # append position = cluster occupancy + rank among EARLIER live facts
+    # of the same cluster (intra-batch prefix sum, the PR 3 compaction
+    # idiom applied per cluster)
+    same = (a[:, None] == a[None, :]) & live[None, :]
+    rank = (same & jnp.tri(b, k=-1, dtype=bool)).sum(axis=1)
+    counts_pre = counts
+    pos = jnp.where(live, counts_pre[jnp.where(live, a, 0)]
+                    + rank.astype(jnp.int32), -1)
+    ok = live & (pos >= 0) & (pos < M)
+    a_s = jnp.where(ok, a, C)                              # OOB -> dropped
+    p_s = jnp.where(ok, pos, M)
+    members = members.at[a_s, p_s].set(rows.astype(jnp.int32))
+    counts = counts_pre.at[a_s].add(ok.astype(jnp.int32))
+    # mini-batch centroid step (overflowed facts still inform the mean —
+    # they are real cluster mass even though their member slot spilled)
+    sums = jnp.zeros((C, qf.shape[1]), jnp.float32
+                     ).at[a].add(jnp.where(live[:, None], qf, 0.0))
+    bc = jnp.zeros((C,), jnp.float32).at[a].add(live.astype(jnp.float32))
+    tot = counts_pre.astype(jnp.float32)
+    eta = jnp.clip(eta_scale * bc / jnp.maximum(tot + bc, 1.0), 0.0, 1.0)
+    mean = sums / jnp.maximum(bc[:, None], 1.0)
+    prop = cent * (1.0 - eta[:, None]) + mean * eta[:, None]
+    nrm = jnp.linalg.norm(prop, axis=1, keepdims=True)
+    moved = (bc[:, None] > 0) & (nrm > 1e-9)
+    new_cent = jnp.where(moved, prop / jnp.maximum(nrm, 1e-9), cent)
+    # staleness proxy riding the readback tail: total angular drift of the
+    # touched centroids this batch, in parts-per-million of cosine
+    shift = jnp.where(bc > 0, 1.0 - (new_cent * cent).sum(axis=1), 0.0)
+    tail = (
+        (live & ~ok).any().astype(jnp.int32),              # overflow flag
+        jnp.minimum(counts.sum(), jnp.int32(C * M)).astype(jnp.int32),
+        ok.sum().astype(jnp.int32),                        # appends
+        jnp.clip(jnp.round(shift.sum() * 1e6), 0,
+                 2 ** 30).astype(jnp.int32),               # shift ppm
+    )
+    pos_rb = jnp.where(ok, pos, -1)
+    return (new_cent, members, counts), assign, pos_rb, tail
+
+
+# Number of wide + tail readback leaves _ivf_online_update appends to the
+# fused ingest readback (assign, pos, overflow, occupancy, appends, shift).
+IVF_INGEST_TAIL = 6
+
+
+def _ivf_drop_rows(ivf_members: jax.Array, drop_map: jax.Array
+                   ) -> jax.Array:
+    """Scrub rows out of the member tables (tier demotion: a demoted row's
+    exact master embedding is zeroed, so its member slot must not feed the
+    exact in-kernel rescore — the full-corpus int8 shadow coarse path
+    covers it instead). Slots become -1 holes; occupancy counts are NOT
+    rewound (append positions stay monotone until the next re-seed packs
+    the table). O(C·M) elementwise — runs on the background demote path,
+    never a serving query."""
+    safe = jnp.maximum(ivf_members, 0)
+    hit = (ivf_members >= 0) & drop_map[safe]
+    return jnp.where(hit, -1, ivf_members)
+
+
+ivf_members_drop = jax.jit(_ivf_drop_rows, donate_argnums=(0,))
+ivf_members_drop_copy = jax.jit(_ivf_drop_rows)
+
+
 def _ingest_fused(
     arena: ArenaState,
     edges: EdgeState,
     shadow,                  # (q8 [cap+1, d] i8, scale [cap+1] f32) or None
+    ivf,                     # (cent [C,d], members [C,M], counts [C]) or None
     rows: jax.Array,         # [B] i32 new-node rows, sentinel-padded
     emb: jax.Array,          # [B, d]
     salience: jax.Array,     # [B] f32
@@ -651,9 +753,10 @@ def _ingest_fused(
     tenant: jax.Array,
     link_gate: jax.Array,
     link_scale: jax.Array,
+    ivf_eta: jax.Array,      # centroid learning-rate scale (inert w/o ivf)
     k: int,
     shard_modes: Tuple[int, ...] = (1, 0),
-) -> Tuple[ArenaState, EdgeState, object, Tuple[jax.Array, ...]]:
+) -> Tuple[ArenaState, EdgeState, object, object, Tuple[jax.Array, ...]]:
     """The per-conversation ingest sequence — ``arena_add`` →
     ``arena_merge_touch`` → ``arena_link_candidates_multi`` → gated
     ``edges_add`` — fused into ONE donated device program.
@@ -669,8 +772,14 @@ def _ingest_fused(
     cands, pos)`` triples (pos = pool position, -1 = rejected) are the
     single packed readback the host needs for id decode and edge
     bookkeeping. With int8 serving on, the shadow codes for the written
-    rows update in the same program (``_shadow_scatter``)."""
-    emb_stored = normalize(emb).astype(arena.emb.dtype)
+    rows update in the same program (``_shadow_scatter``). With online IVF
+    tables threaded (``ivf``), the written rows are scored against the
+    centroids, appended to their clusters' member tables, and the
+    mini-batch centroid step runs — all inside this same dispatch
+    (``_ivf_online_update``; the extra readback leaves trail the link
+    counters)."""
+    qf = normalize(emb)
+    emb_stored = qf.astype(arena.emb.dtype)
     arena = _arena_add(arena, rows, emb, salience, timestamp, type_id,
                        shard_id, tenant_id, is_super)
     shadow = _shadow_scatter(shadow, rows, emb_stored)
@@ -685,7 +794,14 @@ def _ingest_fused(
     edges, outs = _gated_link_insert(edges, link_flat, link_pool, pool_len,
                                      rows, valid_q, now, tenant, link_gate,
                                      link_scale, shard_modes)
-    return arena, edges, shadow, outs
+    if ivf is not None:
+        leaf = outs[0].shape
+        ivf, a_rb, p_rb, tail = _ivf_online_update(ivf, rows, qf, valid_q,
+                                                   ivf_eta)
+        outs = outs + tuple(
+            jnp.broadcast_to(x[:, None], leaf) for x in (a_rb, p_rb)
+        ) + tuple(jnp.broadcast_to(t, leaf) for t in tail)
+    return arena, edges, shadow, ivf, outs
 
 
 def _gated_link_insert(edges, link_flat, link_pool, pool_len, src_rows,
@@ -768,7 +884,7 @@ def _gated_link_insert(edges, link_flat, link_pool, pool_len, src_rows,
 
 
 ingest_fused, ingest_fused_copy = _donated_pair(
-    _ingest_fused, donate=(0, 1, 2), static_argnames=("k", "shard_modes"))
+    _ingest_fused, donate=(0, 1, 2, 3), static_argnames=("k", "shard_modes"))
 
 
 # ---------------------------------------------------------------------------
@@ -787,7 +903,8 @@ def _ingest_scan_core(state: ArenaState, qd: jax.Array, q_shard: jax.Array,
                       probe_excl: jax.Array, link_excl: jax.Array,
                       tenant: jax.Array, k: int,
                       shard_modes: Tuple[int, ...],
-                      chunk: int = QUERY_CHUNK):
+                      chunk: int = QUERY_CHUNK,
+                      with_probe: bool = True):
     """The whole-arena ingest scan: dedup-probe top-1 plus the per-mode
     link top-k over ONE score matrix — the probe and every link mode are
     just different masks, so the arena streams from HBM once per ingest
@@ -809,15 +926,19 @@ def _ingest_scan_core(state: ArenaState, qd: jax.Array, q_shard: jax.Array,
     a chip's slice is n× narrower, an n×-wider ``chunk`` at the SAME
     [chunk × rows] f32 tile budget (fewer, denser gemms; chunking never
     changes any per-row output, so parity is unaffected). Returns the
-    flat tuple ``(p_s [B,1], p_r [B,1], s_mode, r_mode, ...)``."""
+    flat tuple ``(p_s [B,1], p_r [B,1], s_mode, r_mode, ...)``;
+    ``with_probe=False`` (the non-dedup sharded program) skips the probe
+    group — the link modes alone, post-add semantics — and then
+    ``probe_excl`` only shapes the link mask."""
     pmask = (state.alive & (state.tenant_id == tenant)
              & ~state.is_super & ~probe_excl)
     lmask = pmask & ~link_excl
 
     def body(q_c, qs_c):
         scores = nt_dot(q_c, state.emb)               # [C, rows] f32
-        outs = list(jax.lax.top_k(
+        outs = (list(jax.lax.top_k(
             jnp.where(pmask[None, :], scores, NEG_INF), 1))
+            if with_probe else [])
         same = None
         for sm in shard_modes:
             m = lmask[None, :]
@@ -876,6 +997,7 @@ def _ingest_dedup_fused(
     arena: ArenaState,
     edges: EdgeState,
     shadow,                  # (q8 [cap+1, d] i8, scale [cap+1] f32) or None
+    ivf,                     # (cent [C,d], members [C,M], counts [C]) or None
     rows: jax.Array,         # [B] i32 candidate row per fact, sentinel-padded
     emb: jax.Array,          # [B, d]
     salience: jax.Array,     # [B] f32 (doubles as the merge-touch candidate)
@@ -894,9 +1016,10 @@ def _ingest_dedup_fused(
     chain_w: jax.Array,
     link_gate: jax.Array,
     link_scale: jax.Array,
+    ivf_eta: jax.Array,      # centroid learning-rate scale (inert w/o ivf)
     k: int,
     shard_modes: Tuple[int, ...] = (1, 0),
-) -> Tuple[ArenaState, EdgeState, object, Tuple[jax.Array, ...]]:
+) -> Tuple[ArenaState, EdgeState, object, object, Tuple[jax.Array, ...]]:
     """``_ingest_fused`` plus the dedup probe the classic pipeline pays a
     separate dispatch+readback for: masked top-1 against the PRE-add arena
     and an intra-batch gram resolve duplicate facts ON DEVICE, duplicate
@@ -943,15 +1066,27 @@ def _ingest_dedup_fused(
     edges, outs = _gated_link_insert(edges, link_flat, link_pool, pool_len,
                                      rows, live_new, now, tenant, link_gate,
                                      link_scale, shard_modes)
+    if ivf is not None:
+        # Online IVF maintenance (ISSUE 12): the SAME dispatch scores the
+        # surviving facts against the centroids, appends them to their
+        # clusters' member tables, and blends the mini-batch centroid
+        # step — assignments are never stale behind an offline rebuild.
+        # Duplicates never append (live_new gates them); merge targets
+        # already sit in their clusters.
+        ivf, a_rb, p_rb, tail = _ivf_online_update(ivf, rows, qf, live_new,
+                                                   ivf_eta)
+        outs = outs + tuple(
+            jnp.broadcast_to(x[:, None], (b, k)) for x in (a_rb, p_rb)
+        ) + tuple(jnp.broadcast_to(t, (b, k)) for t in tail)
     # [B] verdicts broadcast to [B, k] so every readback leaf has one shape
     # and the host fetches them all in ONE packed transfer
     wide = tuple(jnp.broadcast_to(a[:, None], (b, k))
                  for a in (dup.astype(jnp.int32), target, chain_src))
-    return arena, edges, shadow, wide + outs
+    return arena, edges, shadow, ivf, wide + outs
 
 
 ingest_dedup_fused, ingest_dedup_fused_copy = _donated_pair(
-    _ingest_dedup_fused, donate=(0, 1, 2),
+    _ingest_dedup_fused, donate=(0, 1, 2, 3),
     static_argnames=("k", "shard_modes"))
 
 
@@ -1001,17 +1136,19 @@ class IngestShardedKernels(NamedTuple):
 
 def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
                               shard_modes: Tuple[int, ...] = (1, 0),
-                              with_shadow: bool = False
+                              with_shadow: bool = False,
+                              with_ivf: bool = False,
+                              dedup: bool = True
                               ) -> IngestShardedKernels:
     """Build the distributed fused ingest program for ``mesh``.
 
-    Call signature (``with_shadow=False``)::
+    Call signature (``with_shadow=False``, ``dedup=True``)::
 
         ingest(arena, edges, rows [B], emb [B,d], salience [B],
                timestamp [B], type_id [B], shard_id [B], tenant_id [B],
                is_super [B], chain_gid [B], chain_slots [B],
                link_pool [P+1], pool_len, now, tenant, dedup_gate,
-               chain_w, link_gate, link_scale)
+               chain_w, link_gate, link_scale, ivf_eta)
             -> (arena, edges, outs)
 
     with ``arena``/``edges`` row-sharded over ``axis`` and every batch
@@ -1025,6 +1162,39 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
     ``with_shadow=True`` inserts ``(q8 [rows,d] i8, scale [rows] f32)``
     row-sharded args after ``edges`` and returns them updated — the
     incremental int8 shadow maintenance riding the same dispatch.
+
+    ``with_ivf=True`` (ISSUE 12) additionally threads the ONLINE IVF
+    tables: ``cent [C, d]`` replicated, ``members [n, C, M]`` stacked
+    per shard with LOCAL row indices (the same layout ``make_fused_
+    sharded`` mode="ivf" serves from, so the live ingest-maintained
+    tables feed the pod serving kernel directly), and ``counts [n, C]``
+    REPLICATED per-(shard, cluster) occupancy — replicated so every chip
+    computes identical append positions / overflow verdicts and the
+    readback stays replicated arithmetic without a second collective.
+    The centroid scores ride the existing grouped all_gather as one more
+    candidate group (each chip scores its ``C/n`` slice of the
+    replicated centroid block and contributes its local top-1; when
+    ``C % n != 0`` every chip scores the full block and the merge is a
+    no-op), member appends land owner-chip-local through the same OOB
+    scatter routing as every other write, and the mini-batch centroid
+    step is replicated arithmetic. Readback grows the same 6 trailing
+    leaves as the single-chip kernel (assign, member pos, overflow,
+    occupancy, appends, centroid shift).
+
+    ``dedup=False`` builds the NON-dedup program instead (ROADMAP
+    residual: ``ingest_batch`` under a mesh) — the ``_ingest_fused``
+    semantics composed with the mesh: explicit merge-touch rows and
+    chain triples, post-add link scan, no probe group in the merge::
+
+        ingest(arena, edges, rows [B], emb [B,d], salience, timestamp,
+               type_id, shard_id, tenant_id, is_super, touch_rows [M],
+               touch_sal [M], chain_slots [C], chain_src [C],
+               chain_tgt [C], chain_w [C], link_pool [P+1], pool_len,
+               now, tenant, link_gate, link_scale, ivf_eta)
+            -> (arena, edges, outs)
+
+    with ``outs`` bit-compatible with the single-chip ``ingest_fused``
+    readback (3 leaves per shard mode + 3 trailing counters).
 
     ``ingest`` donates the state arguments (zero-copy shard-local
     scatters); ``ingest_copy`` is the non-donating twin."""
@@ -1044,14 +1214,107 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
         loc = idx - base
         return jnp.where((loc >= 0) & (loc < n_local), loc, n_local)
 
-    def _local(arena, edges, *rest):
+    def _split_state(rest):
+        shadow = ivf = None
         if with_shadow:
             shadow, rest = (rest[0], rest[1]), rest[2:]
-        else:
-            shadow = None
+        if with_ivf:
+            # members arrive stacked [1, C, M] inside shard_map
+            ivf, rest = (rest[0], rest[1][0], rest[2]), rest[3:]
+        return shadow, ivf, rest
+
+    def _cent_group(ivf, qf, shard):
+        """This chip's centroid-slice top-1 as one more merge candidate
+        group: (score [B,1], GLOBAL centroid id [B,1])."""
+        cent = ivf[0]
+        C = cent.shape[0]
+        if C % n_shards == 0 and n_shards > 1:
+            c_loc = C // n_shards
+            cent_l = jax.lax.dynamic_slice_in_dim(
+                cent, shard * c_loc, c_loc, 0)
+            s1, i1 = jax.lax.top_k(
+                jnp.dot(qf, cent_l.T, preferred_element_type=jnp.float32),
+                1)
+            return s1, (i1 + shard * c_loc).astype(jnp.int32)
+        s1, i1 = jax.lax.top_k(
+            jnp.dot(qf, cent.T, preferred_element_type=jnp.float32), 1)
+        return s1, i1.astype(jnp.int32)
+
+    def _ivf_sharded_update(ivf, rows, qf, live, assign, ivf_eta, shard,
+                            local_n):
+        """The mesh twin of ``_ivf_online_update``: append positions,
+        overflow verdicts, occupancy counts and the centroid step are
+        REPLICATED arithmetic (counts carries every shard's occupancy);
+        only the member-table scatter is owner-chip-local. Member
+        positions are per-(shard, cluster) — each chip's table has its
+        own dense prefix, so single-chip and mesh positions differ while
+        the served candidate UNION stays identical (overflow aside)."""
+        cent, mem_l, counts = ivf
+        C = cent.shape[0]
+        M = mem_l.shape[1]
+        b = rows.shape[0]
+        owner = jnp.clip(rows // local_n, 0, n_shards - 1)
+        a = jnp.where(live, assign, C)
+        same = ((a[:, None] == a[None, :])
+                & (owner[:, None] == owner[None, :]) & live[None, :])
+        rank = (same & jnp.tri(b, k=-1, dtype=bool)).sum(axis=1)
+        counts_pre = counts
+        cnt = counts_pre[jnp.where(live, owner, 0),
+                         jnp.where(live, a, 0)]
+        pos = jnp.where(live, cnt + rank.astype(jnp.int32), -1)
+        ok = live & (pos >= 0) & (pos < M)
+        o_s = jnp.where(ok, owner, n_shards)
+        a_s = jnp.where(ok, a, C)
+        counts = counts_pre.at[o_s, a_s].add(ok.astype(jnp.int32))
+        mine = ok & (owner == shard)
+        a_m = jnp.where(mine, a, C)
+        p_m = jnp.where(mine, pos, M)
+        mem_l = mem_l.at[a_m, p_m].set(
+            (rows - shard * local_n).astype(jnp.int32))
+        # centroid step: replicated, with the GLOBAL per-cluster mass
+        # (sum over shards) as the learning-rate denominator — the same
+        # total the single-chip kernel uses
+        sums = jnp.zeros((C, qf.shape[1]), jnp.float32
+                         ).at[a].add(jnp.where(live[:, None], qf, 0.0))
+        bc = jnp.zeros((C,), jnp.float32).at[a].add(live.astype(
+            jnp.float32))
+        tot = counts_pre.sum(axis=0).astype(jnp.float32)
+        eta = jnp.clip(ivf_eta * bc / jnp.maximum(tot + bc, 1.0), 0.0, 1.0)
+        mean = sums / jnp.maximum(bc[:, None], 1.0)
+        prop = cent * (1.0 - eta[:, None]) + mean * eta[:, None]
+        nrm = jnp.linalg.norm(prop, axis=1, keepdims=True)
+        new_cent = jnp.where((bc[:, None] > 0) & (nrm > 1e-9),
+                             prop / jnp.maximum(nrm, 1e-9), cent)
+        shift = jnp.where(bc > 0, 1.0 - (new_cent * cent).sum(axis=1), 0.0)
+        tail = (
+            (live & ~ok).any().astype(jnp.int32),
+            jnp.minimum(counts.sum(), jnp.int32(n_shards * C * M)
+                        ).astype(jnp.int32),
+            ok.sum().astype(jnp.int32),
+            jnp.clip(jnp.round(shift.sum() * 1e6), 0,
+                     2 ** 30).astype(jnp.int32),
+        )
+        return ((new_cent, mem_l, counts), jnp.where(live, assign, -1),
+                jnp.where(ok, pos, -1), tail)
+
+    def _ivf_outs(ivf_new, a_rb, p_rb, tail, b):
+        return tuple(
+            jnp.broadcast_to(x[:, None], (b, k)) for x in (a_rb, p_rb)
+        ) + tuple(jnp.broadcast_to(t, (b, k)) for t in tail)
+
+    def _pack_state(arena, edges, shadow, ivf, outs):
+        out = (arena, edges)
+        if with_shadow:
+            out = out + (shadow[0], shadow[1])
+        if with_ivf:
+            out = out + (ivf[0], ivf[1][None, :, :], ivf[2])
+        return out + (outs,)
+
+    def _local(arena, edges, *rest):
+        shadow, ivf, rest = _split_state(rest)
         (rows, emb, salience, timestamp, type_id, shard_id_v, tenant_id_v,
          is_super, chain_gid, chain_slots, link_pool, pool_len, now, tenant,
-         dedup_gate, chain_w, link_gate, link_scale) = rest
+         dedup_gate, chain_w, link_gate, link_scale, ivf_eta) = rest
         shard = jax.lax.axis_index(axis)
         local_n = arena.emb.shape[0]
         cap = n_shards * local_n - 1           # GLOBAL capacity / sentinel
@@ -1078,18 +1341,29 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
                                  chunk=min(QUERY_CHUNK * n_shards, 4096))
         # ONE all_gather merges the probe AND every link mode's local
         # candidates (grouped combine; candidate ids globalized first, so
-        # masked/garbage entries route to the global sentinel row).
-        cat_s = jnp.concatenate([flat[2 * g] for g in range(1 + n_modes)],
-                                axis=1)
-        cat_i = jnp.concatenate(
-            [_globalize_rows(flat[2 * g + 1], flat[2 * g], shard, local_n,
-                             n_shards) for g in range(1 + n_modes)], axis=1)
+        # masked/garbage entries route to the global sentinel row) — and
+        # with online IVF the centroid scores ride the SAME collective as
+        # a fourth candidate group.
+        cat_s = [flat[2 * g] for g in range(1 + n_modes)]
+        cat_i = [_globalize_rows(flat[2 * g + 1], flat[2 * g], shard,
+                                 local_n, n_shards)
+                 for g in range(1 + n_modes)]
+        widths = [1] + [k_l] * n_modes
+        ks = [1] + [k] * n_modes
+        if ivf is not None:
+            c_s, c_i = _cent_group(ivf, qf, shard)
+            cat_s.append(c_s)
+            cat_i.append(c_i)
+            widths.append(1)
+            ks.append(1)
         merged = sharded_grouped_topk_merge(
-            axis, cat_s, cat_i, widths=[1] + [k_l] * n_modes,
-            ks=[1] + [k] * n_modes)
+            axis, jnp.concatenate(cat_s, axis=1),
+            jnp.concatenate(cat_i, axis=1), widths=widths, ks=ks)
         merged = jax.lax.optimization_barrier(merged)
         p_s, p_r = merged[0][0][:, 0], merged[0][1][:, 0]
-        link_flat = tuple(a for pair in merged[1:] for a in pair)
+        link_flat = tuple(a for pair in merged[1 + 0:1 + n_modes]
+                          for a in pair)
+        assign = merged[-1][1][:, 0] if ivf is not None else None
 
         # Dedup resolve + gate logic are replicated arithmetic from here —
         # every chip computes identical verdicts, then scatters ONLY the
@@ -1120,11 +1394,86 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
         edges, outs = _gated_link_insert(edges, link_flat, pool_l, pool_len,
                                          rows, live_new, now, tenant,
                                          link_gate, link_scale, shard_modes)
+        if ivf is not None:
+            ivf, a_rb, p_rb, tail = _ivf_sharded_update(
+                ivf, rows, qf, live_new, assign, ivf_eta, shard, local_n)
+            outs = outs + _ivf_outs(ivf, a_rb, p_rb, tail, b)
         wide = tuple(jnp.broadcast_to(a[:, None], (b, k))
                      for a in (dup.astype(jnp.int32), target, chain_src))
-        if with_shadow:
-            return arena, edges, shadow[0], shadow[1], wide + outs
-        return arena, edges, wide + outs
+        return _pack_state(arena, edges, shadow, ivf, wide + outs)
+
+    def _local_plain(arena, edges, *rest):
+        """The non-dedup program (``ingest_batch`` under a mesh): the
+        SAME semantics as the single-chip ``_ingest_fused`` — node
+        scatter, explicit merge touch, POST-add link scan per shard mode,
+        explicit chain triples, gated compacted link insert — shard-local
+        scans, one grouped all_gather, owner-chip writes."""
+        shadow, ivf, rest = _split_state(rest)
+        (rows, emb, salience, timestamp, type_id, shard_id_v, tenant_id_v,
+         is_super, touch_rows, touch_sal, chain_slots, chain_src,
+         chain_tgt, chain_w, link_pool, pool_len, now, tenant, link_gate,
+         link_scale, ivf_eta) = rest
+        shard = jax.lax.axis_index(axis)
+        local_n = arena.emb.shape[0]
+        cap = n_shards * local_n - 1
+        local_e = edges.src.shape[0]
+        b = rows.shape[0]
+        k_l = max(1, min(k, local_n))
+        qf = normalize(emb)
+        qd = qf.astype(arena.emb.dtype)
+        row_base = shard * local_n
+        rows_l = _localize(rows, row_base, local_n)
+        arena = _arena_add(arena, rows_l, emb, salience, timestamp,
+                           type_id, shard_id_v, tenant_id_v, is_super)
+        shadow = _shadow_scatter(shadow, rows_l, qd)
+        touch_l = _localize(touch_rows, row_base, local_n)
+        arena = _arena_merge_touch(arena, touch_l, touch_sal, now)
+        # post-add link scan, batch rows excluded as candidates — the
+        # single-chip kernel's _arena_link_candidates_multi semantics
+        # (no probe group, no sentinel exclusion: decode drops id-less
+        # hits host-side exactly like the single-chip path)
+        link_excl = jnp.zeros((local_n,), bool).at[rows_l].set(True)
+        flat = _ingest_scan_core(arena, qd, shard_id_v,
+                                 jnp.zeros((local_n,), bool), link_excl,
+                                 tenant, k_l, shard_modes,
+                                 chunk=min(QUERY_CHUNK * n_shards, 4096),
+                                 with_probe=False)
+        cat_s = [flat[2 * g] for g in range(n_modes)]
+        cat_i = [_globalize_rows(flat[2 * g + 1], flat[2 * g], shard,
+                                 local_n, n_shards)
+                 for g in range(n_modes)]
+        widths = [k_l] * n_modes
+        ks = [k] * n_modes
+        if ivf is not None:
+            c_s, c_i = _cent_group(ivf, qf, shard)
+            cat_s.append(c_s)
+            cat_i.append(c_i)
+            widths.append(1)
+            ks.append(1)
+        merged = sharded_grouped_topk_merge(
+            axis, jnp.concatenate(cat_s, axis=1),
+            jnp.concatenate(cat_i, axis=1), widths=widths, ks=ks)
+        merged = jax.lax.optimization_barrier(merged)
+        link_flat = tuple(a for pair in merged[:n_modes] for a in pair)
+        assign = merged[-1][1][:, 0] if ivf is not None else None
+
+        n_chain = chain_slots.shape[0]
+        slot_base = shard * local_e
+        chain_l = _localize(chain_slots, slot_base, local_e)
+        edges = _edges_add(edges, chain_l, chain_src, chain_tgt, chain_w,
+                           jnp.ones((n_chain,), jnp.int32), now, tenant,
+                           chain_src >= 0)
+        valid_q = rows < cap
+        pool_l = _localize(link_pool, slot_base, local_e)
+        edges, outs = _gated_link_insert(edges, link_flat, pool_l,
+                                         pool_len, rows, valid_q, now,
+                                         tenant, link_gate, link_scale,
+                                         shard_modes)
+        if ivf is not None:
+            ivf, a_rb, p_rb, tail = _ivf_sharded_update(
+                ivf, rows, qf, valid_q, assign, ivf_eta, shard, local_n)
+            outs = outs + _ivf_outs(ivf, a_rb, p_rb, tail, b)
+        return _pack_state(arena, edges, shadow, ivf, outs)
 
     arena_specs = ArenaState(
         emb=P(axis, None), salience=P(axis), timestamp=P(axis),
@@ -1135,23 +1484,41 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
         src=P(axis), tgt=P(axis), weight=P(axis), co=P(axis),
         last_updated=P(axis), alive=P(axis), tenant_id=P(axis))
     shadow_specs = (P(axis, None), P(axis)) if with_shadow else ()
-    batch_specs = (
-        P(None),        # rows
-        P(None, None),  # emb
-        P(None), P(None), P(None), P(None), P(None), P(None),  # per-fact
-        P(None),        # chain_gid
-        P(None),        # chain_slots
-        P(None),        # link_pool
-        P(), P(), P(), P(), P(), P(), P(),  # pool_len..link_scale scalars
-    )
-    n_out = 3 + 3 * n_modes + 3
-    out_state = (arena_specs, edge_specs) + shadow_specs
+    # cent replicated, members stacked per shard, counts replicated
+    ivf_specs = ((P(None, None), P(axis, None, None), P(None, None))
+                 if with_ivf else ())
+    if dedup:
+        batch_specs = (
+            P(None),        # rows
+            P(None, None),  # emb
+            P(None), P(None), P(None), P(None), P(None), P(None),  # per-fact
+            P(None),        # chain_gid
+            P(None),        # chain_slots
+            P(None),        # link_pool
+            P(), P(), P(), P(), P(), P(), P(), P(),  # pool_len..ivf_eta
+        )
+        n_out = 3 + 3 * n_modes + 3 + (IVF_INGEST_TAIL if with_ivf else 0)
+        fn = _local
+    else:
+        batch_specs = (
+            P(None),        # rows
+            P(None, None),  # emb
+            P(None), P(None), P(None), P(None), P(None), P(None),  # per-fact
+            P(None), P(None),                  # touch_rows, touch_sal
+            P(None), P(None), P(None), P(None),  # chain slot/src/tgt/w
+            P(None),        # link_pool
+            P(), P(), P(), P(), P(), P(),  # pool_len..ivf_eta scalars
+        )
+        n_out = 3 * n_modes + 3 + (IVF_INGEST_TAIL if with_ivf else 0)
+        fn = _local_plain
+    out_state = (arena_specs, edge_specs) + shadow_specs + ivf_specs
     mapped = shard_map(
-        _local, mesh=mesh,
-        in_specs=(arena_specs, edge_specs) + shadow_specs + batch_specs,
+        fn, mesh=mesh,
+        in_specs=(arena_specs, edge_specs) + shadow_specs + ivf_specs
+        + batch_specs,
         out_specs=out_state + (tuple(P(None, None) for _ in range(n_out)),),
         check_vma=False)
-    donate = tuple(range(2 + len(shadow_specs)))
+    donate = tuple(range(2 + len(shadow_specs) + len(ivf_specs)))
     return IngestShardedKernels(
         ingest=jax.jit(mapped, donate_argnums=donate),
         ingest_copy=jax.jit(mapped))
@@ -2427,6 +2794,266 @@ def search_fused_ivf_ragged_read(state: ArenaState, shadow,
         q_valid, tenant, gate_on, boost_off, super_gate, k, nprobe, slack,
         cap_take, max_nbr, k_q=k_q, cap_q=cap_q, nprobe_q=nprobe_q,
         scan_chunk=scan_chunk)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+
+
+# ---------------------------------------------------------------------------
+# IVF × tiering (ISSUE 12): the coarse stage when BOTH a published IVF build
+# and demoted rows exist — the dense-scan fallback PR 8 shipped with is gone.
+# Hot candidates come from the IVF member gather (exact in-kernel rescore
+# from the master, whose hot rows are intact), COLD rows come from the
+# full-corpus int8 shadow restricted to the cold residency mask (demoted
+# rows drop out of the member tables on demotion, and their master row is
+# zeroed, so the shadow coarse path is the one structure that still covers
+# them). The two candidate streams merge at the k+slack boundary with the
+# same in-kernel row dedup as the IVF kernel, cold survivors keep their
+# coarse score and ride the EXISTING bounded tier_cold_finish dispatch —
+# the packed readback is layout-identical to the tiered kernels, so the
+# host finish path is unchanged.
+# ---------------------------------------------------------------------------
+
+
+def _ivf_tiered_two_tier(state: ArenaState, q8a: jax.Array,
+                         scale_a: jax.Array, cold: jax.Array,
+                         centroids: jax.Array, members: jax.Array,
+                         extras: jax.Array, q_c: jax.Array,
+                         tenant_c: jax.Array, k: int, nprobe: int,
+                         slack: int, nprobe_c=None):
+    """Tier-aware IVF core: centroid prefilter + member gather for the hot
+    tier (exact master rescore — members hold hot rows only; a cold row
+    that slipped a member scrub is masked by the residency column, never
+    exactly rescored against its zeroed master row), int8 coarse scan over
+    the COLD rows only, blended top-(k+slack) with row dedup. The gate
+    tier stays IVF-gathered (supers are pinned hot and every super row
+    rides the extras). Returns ``(g_s, g_r, ann_s [C, k+slack], ann_r,
+    n_dup, cold_any)`` — the tiered candidate-window contract."""
+    from lazzaro_tpu.ops.ivf import gather_rows
+    from lazzaro_tpu.ops.quant import quantize_rows
+
+    cap = state.capacity
+    n = state.emb.shape[0]
+    L = nprobe * members.shape[1] + extras.shape[0]
+    k_fetch = min(k + slack, L + n)
+    k_hot = min(k + slack, L)
+    k_cold = min(k + slack, n)
+    qn = normalize(q_c)                                   # [C, d] f32
+    qd = qn.astype(state.emb.dtype)
+    cand, safe = gather_rows(centroids, members, extras, qn, nprobe)
+    valid = ((cand >= 0) & state.alive[safe] & ~cold[safe]
+             & (state.tenant_id[safe] == tenant_c[:, None]))
+    if nprobe_c is not None:
+        m_w = members.shape[1]
+        pos = jnp.arange(L)
+        in_members = pos < nprobe * m_w
+        rank = pos // max(m_w, 1)
+        valid = valid & (~in_members[None, :]
+                         | (rank[None, :] < nprobe_c[:, None]))
+    sup = state.is_super[safe]
+    vecs = state.emb[safe]                                # [C, L, d]
+    sc = jnp.einsum("cd,cld->cl", qd, vecs,
+                    preferred_element_type=jnp.float32)
+    h_s, h_pos = jax.lax.top_k(jnp.where(valid & ~sup, sc, NEG_INF), k_hot)
+    g_s0, g_pos = jax.lax.top_k(jnp.where(valid & sup, sc, NEG_INF), 1)
+    # cold tier: int8 coarse over the residency-masked full-corpus shadow
+    qq, qs = quantize_rows(qn)
+    dots = jax.lax.dot_general(
+        qq, q8a, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                 # [C, rows]
+    coarse = dots.astype(jnp.float32) * qs[:, None] * scale_a[None, :]
+    cold_m = (cold[None, :] & state.alive[None, :]
+              & ~state.is_super[None, :]
+              & (state.tenant_id[None, :] == tenant_c[:, None]))
+    c_s, c_r = jax.lax.top_k(jnp.where(cold_m, coarse, NEG_INF), k_cold)
+    h_s, h_pos, g_s0, g_pos, c_s, c_r = jax.lax.optimization_barrier(
+        (h_s, h_pos, g_s0, g_pos, c_s, c_r))
+    h_rows = jnp.take_along_axis(cand, h_pos, axis=1)
+    # blended window: hot exact ++ cold coarse, one more top-k + dedup
+    all_s = jnp.concatenate([h_s, c_s], axis=1)
+    all_r = jnp.concatenate([h_rows, c_r], axis=1)
+    ann_s, ann_r, n_dup = _dedup_topk(all_s, all_r, cap, k_fetch)
+    is_cold = cold[jnp.minimum(ann_r, n - 1)] & (ann_s > NEG_INF / 2)
+    cold_any = is_cold.any(axis=-1)
+    gate_s = g_s0[:, 0]
+    gate_r0 = jnp.take_along_axis(cand, g_pos, axis=1)[:, 0]
+    gate_r = jnp.where(gate_s > NEG_INF / 2, gate_r0, cap)
+    return gate_s, gate_r, ann_s, ann_r, n_dup, cold_any
+
+
+def _search_fused_ivf_tiered_scan(state: ArenaState, q8a: jax.Array,
+                                  scale_a: jax.Array, cold: jax.Array,
+                                  centroids: jax.Array, members: jax.Array,
+                                  extras: jax.Array, csr_indptr: jax.Array,
+                                  csr_nbr: jax.Array, q: jax.Array,
+                                  q_valid: jax.Array, tenant: jax.Array,
+                                  gate_on: jax.Array, boost_on: jax.Array,
+                                  super_gate: jax.Array, k: int,
+                                  nprobe: int, slack: int, cap_take: int,
+                                  max_nbr: int, k_q=None, cap_q=None,
+                                  nprobe_q=None, scan_chunk: int = 0):
+    """IVF×tiered per-chunk compute: the tier-aware IVF core, then the
+    shared gate/CSR/boost tail with cold-hit queries' boosts deferred to
+    the bounded finish dispatch — exactly the tiered scan's contract, so
+    ``tier.serve.tiered_decode_and_finish`` decodes this readback
+    unchanged."""
+    ragged = k_q is not None
+
+    def chunk(q_c, valid_c, tenant_c, gate_c, boost_c, *rag):
+        np_c = rag[2] if ragged else None
+        g_s, g_r, ann_s, ann_r, n_dup, cold_any = _ivf_tiered_two_tier(
+            state, q8a, scale_a, cold, centroids, members, extras, q_c,
+            tenant_c, k, nprobe, slack, nprobe_c=np_c)
+        cap_c = None
+        if ragged:
+            k_c, cap_c = rag[0], rag[1]
+            kf = jnp.minimum(k_c + slack, ann_s.shape[1])
+            ann_s, ann_r = _ragged_topk_mask(ann_s, ann_r, kf,
+                                             state.capacity)
+        fast, acc_rows, nbr_rows = _gate_and_boost_rows(
+            state, csr_indptr, csr_nbr, g_s, g_r, ann_s, ann_r,
+            valid_c, tenant_c, gate_c, boost_c & ~cold_any, super_gate,
+            cap_take, max_nbr, cap_c=cap_c)
+        return g_s, g_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup
+
+    arrays = (q, q_valid, tenant, gate_on, boost_on)
+    if ragged:
+        arrays = arrays + (k_q, cap_q, nprobe_q)
+    return chunked_map_multi(chunk, arrays,
+                             chunk=(scan_chunk or IVF_SERVE_CHUNK))
+
+
+def _search_fused_ivf_tiered(
+    state: ArenaState,
+    q8a: jax.Array,
+    scale_a: jax.Array,
+    cold: jax.Array,
+    centroids: jax.Array,
+    members: jax.Array,
+    extras: jax.Array,
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    nprobe: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """ONE donated dispatch + ONE packed readback: IVF coarse stage for the
+    hot tier, cold-masked int8 coarse for the demoted rows, tiered
+    candidate window (k+slack wide) for the bounded finish."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
+        _search_fused_ivf_tiered_scan(
+            state, q8a, scale_a, cold, centroids, members, extras,
+            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
+            super_gate, k, nprobe, slack, cap_take, max_nbr)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+
+
+search_fused_ivf_tiered, search_fused_ivf_tiered_copy = _donated_pair(
+    _search_fused_ivf_tiered,
+    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
+                                             "cap_take", "max_nbr"))
+def search_fused_ivf_tiered_read(state: ArenaState, q8a: jax.Array,
+                                 scale_a: jax.Array, cold: jax.Array,
+                                 centroids: jax.Array, members: jax.Array,
+                                 extras: jax.Array, csr_indptr: jax.Array,
+                                 csr_nbr: jax.Array, q: jax.Array,
+                                 q_valid: jax.Array, tenant: jax.Array,
+                                 gate_on: jax.Array, super_gate: jax.Array,
+                                 k: int, nprobe: int, slack: int,
+                                 cap_take: int, max_nbr: int) -> jax.Array:
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = \
+        _search_fused_ivf_tiered_scan(
+            state, q8a, scale_a, cold, centroids, members, extras,
+            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+            super_gate, k, nprobe, slack, cap_take, max_nbr)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+
+
+def _search_fused_ivf_tiered_ragged(
+    state: ArenaState,
+    q8a: jax.Array,
+    scale_a: jax.Array,
+    cold: jax.Array,
+    centroids: jax.Array,
+    members: jax.Array,
+    extras: jax.Array,
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    k_q: jax.Array,
+    cap_q: jax.Array,
+    nprobe_q: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    nprobe: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+    scan_chunk: int = 0,
+) -> Tuple[ArenaState, jax.Array]:
+    """IVF×tiered serving with the (k, cap, nprobe) sidecar."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
+        _search_fused_ivf_tiered_scan(
+            state, q8a, scale_a, cold, centroids, members, extras,
+            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
+            super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
+            cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+
+
+search_fused_ivf_tiered_ragged, search_fused_ivf_tiered_ragged_copy = \
+    _donated_pair(_search_fused_ivf_tiered_ragged,
+                  static_argnames=("k", "nprobe", "slack", "cap_take",
+                                   "max_nbr", "scan_chunk"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
+                                             "cap_take", "max_nbr",
+                                             "scan_chunk"))
+def search_fused_ivf_tiered_ragged_read(
+        state: ArenaState, q8a: jax.Array, scale_a: jax.Array,
+        cold: jax.Array, centroids: jax.Array, members: jax.Array,
+        extras: jax.Array, csr_indptr: jax.Array, csr_nbr: jax.Array,
+        q: jax.Array, q_valid: jax.Array, tenant: jax.Array,
+        gate_on: jax.Array, k_q: jax.Array, nprobe_q: jax.Array,
+        super_gate: jax.Array, k: int, nprobe: int, slack: int,
+        cap_take: int, max_nbr: int, scan_chunk: int = 0) -> jax.Array:
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    cap_q = jnp.zeros(q_valid.shape, jnp.int32)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = \
+        _search_fused_ivf_tiered_scan(
+            state, q8a, scale_a, cold, centroids, members, extras,
+            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+            super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
+            cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk)
     return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
 
 

@@ -152,6 +152,41 @@ def build_ivf(emb: jax.Array, mask_np: np.ndarray,
                     residual=jnp.asarray(residual), built_rows=n_alive)
 
 
+def online_counts(members) -> jax.Array:
+    """Per-cluster live-prefix occupancy of a member table — the ``counts``
+    column the online-IVF ingest kernels (``core.state._ivf_online_update``)
+    append through. Build-time tables are dense prefixes per cluster, so
+    the live count IS the append cursor."""
+    m = jnp.asarray(members)
+    return (m >= 0).sum(axis=-1).astype(jnp.int32)
+
+
+@jax.jit
+def _staleness_device(emb: jax.Array, mask: jax.Array, cent: jax.Array,
+                      members: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Device side of :func:`assignment_staleness`: count member-table
+    slots whose row's argmax centroid (under the CURRENT centroids) is no
+    longer the cluster the slot lives in."""
+    assign = _assign_device(emb, mask, cent)               # [N], dead -> -1
+    safe = jnp.maximum(members, 0)
+    C = cent.shape[0]
+    ok = (members >= 0) & (assign[safe] >= 0)
+    stale = ok & (assign[safe] != jnp.arange(C)[:, None])
+    return stale.sum(), ok.sum()
+
+
+def assignment_staleness(emb, mask_np, cent, members) -> float:
+    """Fraction of live member-table slots whose cluster no longer matches
+    the row's argmax under the current centroids — the staleness number
+    online IVF bounds (mini-batch centroid drift can strand old members;
+    an offline rebuild by construction measures 0.0 here). An O(N·C)
+    DIAGNOSTIC probe for bench/maintenance — never the serving path."""
+    stale, live = _staleness_device(emb, jnp.asarray(mask_np),
+                                    jnp.asarray(cent), jnp.asarray(members))
+    live = int(live)
+    return float(stale) / live if live else 0.0
+
+
 def gather_rows(centroids: jax.Array, members: jax.Array,
                 extras: jax.Array, q_c: jax.Array, nprobe: int
                 ) -> Tuple[jax.Array, jax.Array]:

@@ -99,6 +99,9 @@ class ShardedMemoryIndex:
                  serve_kernel_cache_max: int = 8,
                  edge_capacity: int = 1 << 17,
                  ingest_fused: bool = True,
+                 ivf_online: bool = True,
+                 ivf_member_cap_factor: int = 4,
+                 ivf_online_eta: float = 1.0,
                  hbm_budget_bytes: int = 0,
                  hbm_headroom_fraction: float = 0.1,
                  plan_max_splits: int = 16,
@@ -210,6 +213,19 @@ class ShardedMemoryIndex:
         self._ivf_routed = None   # np bool [rows]
         self._ivf_fresh: List[int] = []
         self._ivf_tabs_cache = None
+        # Online IVF maintenance (ISSUE 12), pod twin: with a seeded
+        # build, the LIVE coarse tables — ``(cent [C,d] replicated,
+        # members [n,C,M] stacked per shard with LOCAL row ids — the
+        # exact layout make_fused_sharded mode="ivf" serves from —
+        # counts [n,C] REPLICATED per-(shard, cluster) occupancy)`` —
+        # ride the distributed ingest dispatch as donated state: the
+        # centroid scores join the grouped all_gather as a fourth
+        # candidate group, member appends land owner-chip-local, and the
+        # mini-batch centroid step is replicated arithmetic.
+        self.ivf_online = bool(ivf_online)
+        self.ivf_member_cap_factor = max(1, int(ivf_member_cap_factor))
+        self.ivf_online_eta = float(ivf_online_eta)
+        self._ivf_dev = None      # (cent, members_sh, counts) live tables
 
         # Tiered memory (ISSUE 8): attach_tiering hangs a TierManager here
         # (per-shard host cold stores — one per mesh partition — plus the
@@ -409,13 +425,14 @@ class ShardedMemoryIndex:
 
     # --------------------------------------------------- fused pod ingest
     def _ingest_kernels(self, k: int, shard_modes: Tuple[int, ...],
-                        with_shadow: bool) -> S.IngestShardedKernels:
-        key = (k, shard_modes, with_shadow)
+                        with_shadow: bool, with_ivf: bool = False
+                        ) -> S.IngestShardedKernels:
+        key = (k, shard_modes, with_shadow, with_ivf)
         kern = self._ingest_cache.get(key)
         if kern is None:
             kern = S.make_ingest_fused_sharded(
                 self.mesh, self.axis, k=k, shard_modes=shard_modes,
-                with_shadow=with_shadow)
+                with_shadow=with_shadow, with_ivf=with_ivf)
             self._ingest_cache.put(key, kern)
             self.telemetry.gauge("kernel.cache_entries",
                                  len(self._ingest_cache),
@@ -504,7 +521,9 @@ class ShardedMemoryIndex:
                 self.int8_serving and not self._int8_dirty
                 and self._int8_shadow is not None
                 and self._int8_shadow[0].shape[0] == self.capacity + 1)
-        kern = self._ingest_kernels(k_eff, shard_modes, with_shadow)
+            with_ivf = self.ivf_online and self._ivf_dev is not None
+        kern = self._ingest_kernels(k_eff, shard_modes, with_shadow,
+                                    with_ivf)
         dev_args = (
             jnp.asarray(padded), jnp.asarray(emb_p),
             jnp.asarray(pad(np.asarray(saliences, np.float32))),
@@ -517,34 +536,42 @@ class ShardedMemoryIndex:
             jnp.int32(len(link_pool_list)), jnp.float32(now_rel),
             jnp.int32(tid), jnp.float32(dedup_gate),
             jnp.float32(chain_weight), jnp.float32(link_gate),
-            jnp.float32(link_scale))
-        self._maybe_record_ingest_hbm(kern, dev_args, with_shadow, b)
+            jnp.float32(link_scale), jnp.float32(self.ivf_online_eta))
+        self._maybe_record_ingest_hbm(kern, dev_args, with_shadow, b,
+                                      with_ivf=with_ivf)
         tel = self.telemetry
         t0 = time.perf_counter()
         with trace_annotation("lz.ingest.pod_fused"):
             with self._state_lock:
                 arena, edges = self._arena, self._edge_state
                 shadow = self._int8_shadow if with_shadow else None
+                ivf = self._ivf_dev if with_ivf else None
                 sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                         and sys.getrefcount(edges) <= self._SOLE_REFS
                         and (shadow is None
                              or (sys.getrefcount(shadow[0]) <= 2
-                                 and sys.getrefcount(shadow[1]) <= 2)))
+                                 and sys.getrefcount(shadow[1]) <= 2))
+                        and (ivf is None
+                             or (sys.getrefcount(ivf[0]) <= 2
+                                 and sys.getrefcount(ivf[1]) <= 2
+                                 and sys.getrefcount(ivf[2]) <= 2)))
+                state_args = ((arena, edges)
+                              + (shadow if shadow is not None else ())
+                              + (ivf if ivf is not None else ()))
+                got = self._guarded(
+                    lambda fn: self._ingest_dispatch(fn, *state_args,
+                                                     *dev_args),
+                    kern.ingest, kern.ingest_copy, sole,
+                    (arena, edges, shadow, ivf), "pod_ingest")
+                new_arena, new_edges, got = got[0], got[1], got[2:]
                 if shadow is not None:
-                    new_arena, new_edges, q8n, sn, flat = self._guarded(
-                        lambda fn: self._ingest_dispatch(
-                            fn, arena, edges, shadow[0], shadow[1],
-                            *dev_args),
-                        kern.ingest, kern.ingest_copy, sole,
-                        (arena, edges, shadow), "pod_ingest")
-                    self._int8_shadow = (q8n, sn)
-                else:
-                    new_arena, new_edges, flat = self._guarded(
-                        lambda fn: self._ingest_dispatch(fn, arena, edges,
-                                                         *dev_args),
-                        kern.ingest, kern.ingest_copy, sole,
-                        (arena, edges), "pod_ingest")
-                del arena, edges, shadow
+                    self._int8_shadow = (got[0], got[1])
+                    got = got[2:]
+                if ivf is not None:
+                    self._ivf_dev = (got[0], got[1], got[2])
+                    got = got[3:]
+                flat = got[0]
+                del arena, edges, shadow, ivf
                 self._arena = new_arena
                 self._edge_state = new_edges
             host = fetch_packed(*flat)          # the ONE readback
@@ -555,12 +582,13 @@ class ShardedMemoryIndex:
             ids, rows, host, chain_slot_list, link_pool_list,
             shard_modes=shard_modes, k_eff=k_eff, tid=tid,
             chain_weight=chain_weight, link_scale=link_scale,
-            now_abs=now_abs, shadow_fresh=with_shadow)
+            now_abs=now_abs, shadow_fresh=with_shadow,
+            ivf_fresh=with_ivf)
 
     def _ingest_finish_host(self, ids, rows, host, chain_slot_list,
                             link_pool_list, *, shard_modes, k_eff, tid,
                             chain_weight, link_scale, now_abs,
-                            shadow_fresh) -> Dict:
+                            shadow_fresh, ivf_fresh=False) -> Dict:
         """Host bookkeeping after the ONE fused readback: register
         surviving ids, free duplicate rows, mirror accepted edges into the
         host map, reclaim the untouched pool suffix, retry overflowed
@@ -641,7 +669,36 @@ class ShardedMemoryIndex:
         if not shadow_fresh:
             self._int8_dirty = True
         self._emb_gen += 1
-        if self._ivf is not None and live_rows:
+        if ivf_fresh:
+            # Online IVF (ISSUE 12): in-dispatch member appends — routed
+            # immediately; cluster-capacity spills join the exact-scan
+            # extras (readback position -1), like link-pool overflow.
+            ivf_ctr = ctr[3:]
+            pos_w = ivf_ctr[1]
+            routed = self._ivf_routed
+            spilled = []
+            for i in range(n):
+                if dup[i]:
+                    continue
+                r = rows[i]
+                if int(pos_w[i, 0]) >= 0:
+                    if routed is not None:
+                        routed[r] = True
+                elif not (routed is not None and routed[r]) \
+                        and r not in self._ivf_fresh:
+                    spilled.append(r)
+            if spilled:
+                tel.bump("ivf.member_overflows", len(spilled))
+                self._ivf_fresh.extend(spilled)
+                self._ivf_tabs_cache = None
+            dev = self._ivf_dev
+            if dev is not None:
+                slots = int(np.prod(dev[1].shape))
+                tel.gauge("ivf.member_pool_occupancy",
+                          float(ivf_ctr[3][0, 0]) / max(slots, 1))
+            tel.bump("ivf.appends", int(ivf_ctr[4][0, 0]))
+            tel.bump("ivf.centroid_shift_ppm", int(ivf_ctr[5][0, 0]))
+        elif self._ivf is not None and live_rows:
             routed = self._ivf_routed
             for r in live_rows:
                 if not routed[r] and r not in self._ivf_fresh:
@@ -819,7 +876,9 @@ class ShardedMemoryIndex:
             k=max(1, int(link_k)),
             dtype_bytes=int(np.dtype(self.dtype).itemsize),
             mesh_parts=self.n_parts, edge_cap=self.edge_capacity,
-            link_k=max(1, int(link_k)))
+            link_k=max(1, int(link_k)),
+            ivf=1 if (self.ivf_online and self._ivf_dev is not None)
+            else 0)
 
     def plan_ingest(self, n: int, link_k: int = 3):
         """Pod twin of ``MemoryIndex.plan_ingest`` (ISSUE 11): admission
@@ -829,33 +888,37 @@ class ShardedMemoryIndex:
             self._ingest_geometry(n, link_k), chunkable=False)
 
     def _maybe_record_ingest_hbm(self, kern, dev_args, with_shadow: bool,
-                                 b: int) -> None:
+                                 b: int, with_ivf: bool = False) -> None:
         """Opt-in peak-HBM gauge for one pod ingest-kernel geometry
         (AOT lower + ``memory_analysis()`` of the non-donating twin; one
         extra compile, zero extra dispatches) — feeds the
         ``scripts/check_hbm_budget.py`` write-path gate."""
         if not self.telemetry_hbm or not self.telemetry.enabled:
             return    # never consume the once-key while warmup mutes the registry
-        key = ("ingest", b, with_shadow)
+        key = ("ingest", b, with_shadow, with_ivf)
         if key in self._hbm_recorded:
             return
         self._hbm_recorded.add(key)
         try:
             with self._state_lock:
                 sh = self._int8_shadow if with_shadow else None
+                ivf = self._ivf_dev if with_ivf else None
                 args = ((self._arena, self._edge_state)
                         + ((sh[0], sh[1]) if sh is not None else ())
+                        + (ivf if ivf is not None else ())
                         + dev_args)
             peak = peak_bytes(
                 kern.ingest_copy.lower(*args).compile().memory_analysis())
         except Exception:   # noqa: BLE001 — never fail the write path
             return
         if peak is not None:
-            self.telemetry.gauge(
-                "kernel.peak_hbm_bytes", peak,
-                labels={"path": "ingest", "batch": str(b),
-                        "rows": str(self.capacity + 1),
-                        "mesh": f"{self.n_parts}x{self.axis}"})
+            labels = {"path": "ingest", "batch": str(b),
+                      "rows": str(self.capacity + 1),
+                      "mesh": f"{self.n_parts}x{self.axis}"}
+            if with_ivf:
+                labels["ivf"] = "true"
+            self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
+                                 labels=labels)
             self.planner.observe_gauge(self._ingest_geometry(b), peak)
 
     def warmup_ingest(self, geometries=(256,), *, dedup_gate: float = 0.95,
@@ -1143,7 +1206,8 @@ class ShardedMemoryIndex:
         mask = np.asarray(st.alive)
         if int(mask.sum()) < 2 * max(4, nprobe):
             return False
-        ivf = build_ivf(st.emb, mask, n_clusters=n_clusters, iters=iters)
+        ivf = build_ivf(st.emb, mask, n_clusters=n_clusters, iters=iters,
+                        member_cap_factor=self.ivf_member_cap_factor)
         members = np.asarray(ivf.members)
         residual = np.asarray(ivf.residual)
         routed = np.zeros((self.capacity + 1,), bool)
@@ -1157,17 +1221,51 @@ class ShardedMemoryIndex:
             self._ivf_routed = routed
             self._ivf_fresh = []
             self._ivf_tabs_cache = None
+            self._publish_online_tables(members)
         return True
+
+    def _publish_online_tables(self, members: np.ndarray) -> None:
+        """Seed the LIVE pod coarse tables from a build (ISSUE 12): the
+        per-shard LOCAL-row member split becomes the array the
+        distributed ingest appends through AND the serving kernel
+        gathers from; ``counts [n, C]`` is each (shard, cluster) append
+        cursor, replicated so the ingest kernel's verdicts stay
+        replicated arithmetic. Caller holds ``_state_lock``."""
+        if not self.ivf_online or self._ivf is None:
+            self._ivf_dev = None
+            return
+        from lazzaro_tpu.ops.ivf import shard_serve_tables
+
+        cent = self._ivf[0]
+        mem_sh, _ = shard_serve_tables(members,
+                                       np.zeros((0,), np.int64),
+                                       self.n_parts, self.part_rows)
+        counts = (mem_sh >= 0).sum(axis=-1).astype(np.int32)
+        self._ivf_dev = (
+            jax.device_put(jnp.asarray(cent, jnp.float32), self._rep),
+            jax.device_put(jnp.asarray(mem_sh), self._stacked),
+            jax.device_put(jnp.asarray(counts), self._rep))
 
     def _ivf_tables(self, k_bucket: int):
         """(centroids, members_sh, extras_sh, nprobe) device tables for the
         fused IVF program, or None to serve dense (no build, or too few
-        candidates per shard to fill k)."""
+        candidates per shard to fill k). With online maintenance the
+        centroid/member tables are the LIVE device arrays the distributed
+        ingest maintains (never cached — their identity IS the snapshot);
+        only the extras split (sealed residual + overflow/add spills +
+        supers) is host-assembled and cached."""
         if self._ivf is None:
             return None
+        live = self._ivf_dev if self.ivf_online else None
         cache = self._ivf_tabs_cache
         if cache is not None and cache[0] >= k_bucket:
-            return cache[1]
+            ext_sh_dev, nprobe, n_static = cache[1]
+            if live is not None:
+                n_cand = nprobe * live[1].shape[2] + n_static
+                if n_cand < k_bucket + self.coarse_slack:
+                    return None
+                return live[0], live[1], ext_sh_dev, nprobe
+            return cache[2]
         from lazzaro_tpu.ops.ivf import pack_extras, shard_serve_tables
 
         cent, members, residual, nprobe = self._ivf
@@ -1178,9 +1276,15 @@ class ShardedMemoryIndex:
             return None
         mem_sh, ext_sh = shard_serve_tables(members, extras, self.n_parts,
                                             self.part_rows)
-        tabs = (cent, jax.device_put(mem_sh, self._stacked),
-                jax.device_put(ext_sh, self._stacked), nprobe)
-        self._ivf_tabs_cache = (k_bucket, tabs)
+        ext_sh_dev = jax.device_put(ext_sh, self._stacked)
+        if live is not None:
+            tabs = (live[0], live[1], ext_sh_dev, nprobe)
+        else:
+            tabs = (cent, jax.device_put(mem_sh, self._stacked),
+                    ext_sh_dev, nprobe)
+        self._ivf_tabs_cache = (k_bucket,
+                                (ext_sh_dev, nprobe, extras.shape[0]),
+                                tabs)
         return tabs
 
     def _fused_kernels(self, mode: str, k_bucket: int, nprobe: int,

@@ -78,6 +78,13 @@ class Geometry:
     nprobe: int = 0
     scan_chunk: int = 0
     link_k: int = 3              # ingest link-scan width per shard mode
+    # Online-IVF maintenance rides the ingest dispatch (ISSUE 12): 1 adds
+    # the centroid block + member/counts tables to the resident set and
+    # the [batch, C] assignment tile + [C, d] update workspace to the
+    # transient (serve-side IVF geometry is carried by mode="ivf").
+    ivf: int = 0
+    # Member-table capacity factor (slots ≈ factor · rows total).
+    ivf_cap_factor: int = 4
 
     def with_(self, **kw) -> "Geometry":
         d = asdict(self)
@@ -132,6 +139,14 @@ class CostModel:
             # routing entry per row plus the centroid block
             n_cent = max(1, int(math.sqrt(g.rows)))
             total += n_cent * g.dim * 4 + rows_pc * 8
+        if g.kind == "ingest" and g.ivf:
+            # Online-IVF state donated through the ingest dispatch
+            # (ISSUE 12): centroid block (f32, replicated), member table
+            # (cap_factor int32 slots per row, row-sharded with the
+            # master) and the counts column.
+            n_cent = max(1, int(math.sqrt(g.rows)))
+            total += n_cent * (g.dim + 1) * 4
+            total += rows_pc * max(1, g.ivf_cap_factor) * 4
         total += g.edge_cap * EDGE_SLOT_BYTES
         # CSR shadow (indptr + neighbor pool ≈ 2 entries/edge, i32)
         total += (rows_pc + 2) * 4 + 2 * g.edge_cap * 4
@@ -158,6 +173,14 @@ class CostModel:
             # once (PR 9 single-stream refactor) + candidate triples
             tile = chunk * (rows_pc + 1) * 4 \
                 + chunk * max(1, g.link_k) * 3 * 4 * 2
+            if g.ivf:
+                # the [batch, C] assignment tile, the [C, d] centroid
+                # update workspace (sums + proposal), and the batch-wide
+                # intra-cluster rank matrix (ISSUE 12)
+                n_cent = max(1, int(math.sqrt(g.rows)))
+                tile += g.batch * n_cent * 4
+                tile += 3 * n_cent * g.dim * 4
+                tile += g.batch * g.batch * 4
         else:
             # dense scan: [chunk, rows] f32 scores + the two mask tiles
             # and the top-k workspace XLA materializes beside them
@@ -176,7 +199,7 @@ class CostModel:
     @staticmethod
     def _res_key(g: Geometry) -> str:
         return (f"{g.kind}:{g.mode}:b{g.batch}:r{g.rows}:k{g.k}"
-                f":m{g.mesh_parts}")
+                f":m{g.mesh_parts}" + (":ivf" if g.ivf else ""))
 
     def observe(self, g: Geometry, measured_bytes: float) -> bool:
         """Fold one measured AOT ``memory_analysis()`` peak back in.

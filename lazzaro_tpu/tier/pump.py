@@ -261,6 +261,14 @@ class TierManager:
                     raise
                 self.cold_np[chunk] = True
                 self._invalidate_mask()
+                # Online IVF (ISSUE 12): demoted rows drop out of the live
+                # member tables — their zeroed master row must never feed
+                # the exact in-kernel rescore. Rides the commit-then-zero
+                # ordering: the scrub only runs after the cold copy is
+                # durable and the hot row is zeroed.
+                hook = getattr(idx, "_ivf_on_demoted", None)
+                if hook is not None:
+                    hook(chunk)
                 moved += len(chunk)
             ms = (time.perf_counter() - t0) * 1e3
             self.telemetry.record("tier.pump_chunk_ms", ms,
@@ -307,6 +315,12 @@ class TierManager:
                             s.drop([r])
                     self.cold_np[chunk] = False
                     self._invalidate_mask()
+                    # Online IVF (ISSUE 12): the exact master row is back;
+                    # re-cover it through the exact-scan extras (the slot
+                    # it held in the member tables was scrubbed on demote)
+                    hook = getattr(idx, "_ivf_on_promoted", None)
+                    if hook is not None:
+                        hook(chunk)
                 for r in chunk:
                     self._no_demote_until[r] = now + self.hysteresis_s
                     self._hits.pop(r, None)

@@ -49,6 +49,17 @@ the paths passed as arguments) and exits nonzero if:
     snuck back in; ragged kernels are keyed per (mode × geometry)
     only); pre-ragged artifacts (``pr2_``…``pr6_`` prefixes) are
     grandfathered,
+  - (ISSUE 12) an ONLINE-IVF artifact (any dict with ``"ivf_online":
+    true``) does not record a measured ``dispatches_per_conversation``
+    (gated == 1 by the generic rule — in-dispatch IVF maintenance must
+    never grow the write path past ONE dispatch), lacks a
+    ``recall_at_10``/``recall_floor`` pair (online tables must match the
+    offline rebuild they replaced), lacks an
+    ``ingest_overhead_fraction``, or records an
+    ``assignment_staleness_fraction`` that is missing or above its
+    recorded ``assignment_staleness_max`` (default 0.02 — mini-batch
+    centroid drift stranding members is the failure mode online IVF must
+    bound),
   - (ISSUE 9) a SHARDED-INGEST artifact (any dict with
     ``"ingest_sharded": true``) does not record a measured
     ``dispatches_per_conversation`` (gated to == 1 like
@@ -105,7 +116,7 @@ _DISPATCH_KEYS = ("dispatches_per_turn", "dispatches_per_conversation")
 
 
 def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
-          tiereds, ingests):
+          tiereds, ingests, online_ivfs):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -124,6 +135,8 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             tiereds.append((path, obj))
         if obj.get("ingest_sharded") is True:
             ingests.append((path, obj))
+        if obj.get("ivf_online") is True:
+            online_ivfs.append((path, obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k in _DISPATCH_KEYS:
@@ -132,11 +145,11 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
                 hits.append((here, v, obj.get("planned_" + k)))
             else:
                 _walk(v, here, hits, recalls, speedups, meshes, tel_blocks,
-                      raggeds, tiereds, ingests)
+                      raggeds, tiereds, ingests, online_ivfs)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
             _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
-                  tel_blocks, raggeds, tiereds, ingests)
+                  tel_blocks, raggeds, tiereds, ingests, online_ivfs)
 
 
 def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
@@ -201,6 +214,31 @@ def _check_ragged(loc, obj, bad):
                          f"specialization snuck back in)"))
 
 
+def _check_online_ivf(loc, obj, bad):
+    """The ISSUE 12 online-IVF gate on one ``"ivf_online": true`` dict."""
+    if "dispatches_per_conversation" not in obj:
+        bad.append((loc, "online-ivf artifact must record a measured "
+                         "'dispatches_per_conversation'"))
+    if "recall_at_10" not in obj or "recall_floor" not in obj:
+        bad.append((loc, "online-ivf artifact must record a recall_at_10/"
+                         "recall_floor pair vs the offline rebuild"))
+    if "ingest_overhead_fraction" not in obj:
+        bad.append((loc, "online-ivf artifact must record "
+                         "'ingest_overhead_fraction' (in-dispatch "
+                         "maintenance cost vs maintenance-free ingest)"))
+    stale = obj.get("assignment_staleness_fraction")
+    ceiling = obj.get("assignment_staleness_max", 0.02)
+    try:
+        ok = float(stale) <= float(ceiling)
+    except (TypeError, ValueError):
+        ok = False
+    if not ok:
+        bad.append((loc, f"assignment_staleness_fraction == {stale!r} "
+                         f"(must record a measured value <= {ceiling!r} — "
+                         f"mini-batch centroid drift is stranding "
+                         f"members)"))
+
+
 def _check_ingest(loc, obj, bad):
     """The ISSUE 9 sharded-ingest gate on one ``"ingest_sharded": true``
     dict."""
@@ -261,6 +299,7 @@ def main(argv):
     checked_ragged = 0
     checked_tiered = 0
     checked_ingest = 0
+    checked_online_ivf = 0
     bad = []
     for p in paths:
         try:
@@ -270,9 +309,9 @@ def main(argv):
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
         (hits, recalls, speedups, meshes, tel_blocks, raggeds, tiereds,
-         ingests) = [], [], [], [], [], [], [], []
+         ingests, online_ivfs) = [], [], [], [], [], [], [], [], []
         _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
-              tel_blocks, raggeds, tiereds, ingests)
+              tel_blocks, raggeds, tiereds, ingests, online_ivfs)
         grandfathered = os.path.basename(p).startswith(
             _PRE_TELEMETRY_PREFIXES)
         for loc, measured_fused, block in tel_blocks:
@@ -288,6 +327,9 @@ def main(argv):
         for loc, obj in ingests:
             checked_ingest += 1
             _check_ingest(loc, obj, bad)
+        for loc, obj in online_ivfs:
+            checked_online_ivf += 1
+            _check_online_ivf(loc, obj, bad)
         for loc, v, planned in hits:
             checked += 1
             if v == 1:
@@ -335,8 +377,9 @@ def main(argv):
           f"pair(s), {checked_mesh} sharded artifact(s), "
           f"{checked_telemetry} telemetry block(s), "
           f"{checked_ragged} ragged gate(s), "
-          f"{checked_tiered} tiered gate(s), and "
-          f"{checked_ingest} sharded-ingest gate(s) across "
+          f"{checked_tiered} tiered gate(s), "
+          f"{checked_ingest} sharded-ingest gate(s), and "
+          f"{checked_online_ivf} online-ivf gate(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 

@@ -120,7 +120,8 @@ def _geometry_from_gauge(plan_mod, key: str, artifact: dict):
             kind="ingest", mode="ingest",
             batch=int(lab.get("batch") or 256), rows=rows, dim=int(dim),
             k=3, dtype_bytes=dtype_bytes,
-            mesh_parts=_mesh_parts(lab.get("mesh", "1")))
+            mesh_parts=_mesh_parts(lab.get("mesh", "1")),
+            ivf=1 if lab.get("ivf") == "true" else 0)
     return plan_mod.Geometry(
         kind="serve", mode=lab.get("mode", "exact"),
         batch=int(lab.get("batch") or 128), rows=rows, dim=int(dim),
@@ -138,7 +139,8 @@ def _geometry_from_dict(plan_mod, d: dict):
             dtype_bytes=int(d.get("dtype_bytes", 4)),
             mesh_parts=int(d.get("mesh_parts", 1)),
             edge_cap=int(d.get("edge_cap", 0)),
-            nprobe=int(d.get("nprobe", 0)))
+            nprobe=int(d.get("nprobe", 0)),
+            ivf=int(d.get("ivf", 0)))
     except (TypeError, ValueError):
         return None
 
