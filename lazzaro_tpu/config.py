@@ -69,7 +69,12 @@ class MemoryConfig:
     # with ivf_serving > 0, the member scan reads product-quantized codes
     # (m = dim/8 bytes per row instead of dim·2) and the top shortlist is
     # re-scored exactly from the master, so returned scores stay exact.
-    # No effect without ivf_serving.
+    # Serves fused (state.search_fused_pq — ADC table build, m-byte
+    # member scan, exact rescore, gate/CSR/boost tail in ONE dispatch)
+    # with codes maintained INSIDE the fused ingest dispatch against the
+    # frozen codebook; the codebook retrains only on ivf_maintenance's
+    # rare re-seed. Composes with tiering (cold rows scan the PQ slab)
+    # and the mesh. No effect without ivf_serving.
     pq_serving: bool = False
     # Fused single-dispatch ingest (core/state.py ingest_fused): the
     # per-conversation mutation sequence (node scatter, dedup merge touch,
@@ -133,8 +138,9 @@ class MemoryConfig:
     # (state.make_fused_sharded): shard-local scan (exact or int8
     # coarse+rescore), one all_gather + global top-k merge, then the
     # gate/CSR/boost tail with shard-local scatters — the pod path keeps
-    # the full serving semantics. Only pq_serving bypasses fusion (the PQ
-    # member scan keeps its classic multi-dispatch path).
+    # the full serving semantics. With pq_serving on, the coarse stage is
+    # the in-dispatch ADC member scan over the m-byte code slab
+    # (state.search_fused_pq, ISSUE 16) — every mode is fused now.
     serve_fused: bool = True
     # QueryScheduler flush policy: a pending batch ships when it reaches
     # serve_batch_max requests OR when its oldest request has waited

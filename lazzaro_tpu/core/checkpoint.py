@@ -309,6 +309,18 @@ def save_index(index: MemoryIndex, ckpt_dir: str,
         # dashboard's overflow rate reset on every restore).
         "counters": {"link_pool_overflows": index.link_pool_overflows},
     }
+    # PQ serving pack (ISSUE 16): codebook + the complete m-byte code slab
+    # ride the snapshot — rebuilding them on load would be exactly the
+    # offline encode pass the incremental maintenance killed. The meta
+    # block mirrors the ``counters`` idiom: absent in older checkpoints,
+    # restored verbatim when present, and ``complete`` records the
+    # dirty-free invariant (the pack is never saved half-encoded).
+    pack = getattr(index, "_pq_pack", None)
+    if pack is not None and pack[1] is not None:
+        arrays["pq_book_cent"] = np.asarray(pack[0].centroids, np.float32)
+        arrays["pq_codes"] = np.asarray(pack[1], np.uint8)
+        meta["pq"] = {"m": int(pack[0].m), "dim": int(pack[0].dim),
+                      "complete": True}
     # Tiered memory (ISSUE 8): the residency column and the cold store's
     # payload (exact vectors in the wire dtype + their shadow codes) ride
     # the same snapshot, so a reloaded index serves bit-identically to the
@@ -376,6 +388,21 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
     # restore fused-path counters (absent in pre-ISSUE-6 checkpoints)
     index.link_pool_overflows = int(
         meta.get("counters", {}).get("link_pool_overflows", 0))
+
+    # PQ pack (ISSUE 16): restore the saved codebook + complete code slab
+    # so the restored index serves PQ (and maintains codes incrementally)
+    # without an offline re-encode; absent in pre-ISSUE-16 checkpoints,
+    # and dropped when the snapshot's slab no longer matches the arena.
+    if pq_serving and "pq" in meta and "pq_book_cent" in data:
+        from lazzaro_tpu.ops.pq import PQCodebook
+
+        codes = np.asarray(data["pq_codes"], np.uint8)
+        if codes.shape[0] == arena.capacity + 1:
+            book = PQCodebook(
+                centroids=jnp.asarray(
+                    np.asarray(data["pq_book_cent"], np.float32)),
+                dim=int(meta["pq"]["dim"]))
+            index._pq_pack = (book, jnp.asarray(codes))
 
     # Free lists via vectorized set-difference (descending, so allocation
     # pops low rows first — same shape as a fresh index).

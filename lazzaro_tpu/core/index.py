@@ -259,13 +259,17 @@ class MemoryIndex:
         self.link_pool_overflows = 0
         # IVF-PQ member storage (ops/pq.py): the member scan reads m-byte
         # codes instead of d·2-byte rows and the shortlist is re-scored
-        # exactly from the master. Codebook trains in ivf_maintenance;
-        # codes re-encode lazily like the int8 shadow. Book and codes are
-        # published as ONE tuple — codes are meaningless against any other
-        # book, so a reader must never pair them across a retrain.
+        # exactly from the master. Codebook trains in ivf_maintenance,
+        # which also runs the ONE full encode (ISSUE 16) — from then on
+        # the published pack is complete and self-maintaining: the fused
+        # ingest's in-dispatch ``_pq_scatter`` encodes every accepted
+        # batch, non-fused writers patch exactly their own rows via
+        # ``_pq_encode_rows``, and grow pads the slab in place. The old
+        # ``_pq_dirty`` offline full re-encode is gone. Book and codes
+        # are published as ONE tuple — codes are meaningless against any
+        # other book, so a reader must never pair them across a retrain.
         self.pq_serving = bool(pq_serving) and self.ivf_nprobe > 0
         self._pq_pack: Optional[tuple] = None  # (PQCodebook, codes | None)
-        self._pq_dirty = True
         self.mesh = mesh
         self.shard_axis = shard_axis
         self._n_parts = int(mesh.shape[shard_axis]) if mesh is not None else 1
@@ -362,7 +366,6 @@ class MemoryIndex:
         self._ivf_serve_cache = None
         self._ivf_stale = 0
         self._pq_pack = None
-        self._pq_dirty = True
         if v is None:
             self._ivf_routed = None
             self._ivf_in_residual = None
@@ -596,21 +599,82 @@ class MemoryIndex:
         if new_ivf is not None:
             self._ivf_dev = tuple(new_ivf)
 
+    def _pq_ingest_arg(self):
+        """The live ``(book_cent, codes)`` PQ pack to thread through the
+        fused ingest program for in-dispatch code maintenance (ISSUE 16,
+        the PQ twin of ``_ingest_shadow_arg``), or None when there is
+        nothing to maintain (PQ off, no published pack yet — the first
+        ``ivf_maintenance`` trains AND fully encodes — or a mesh, where
+        the pod index threads its own row-sharded pack). Caller holds
+        ``_state_lock``."""
+        if not self.pq_serving or self.mesh is not None:
+            return None
+        pack = self._pq_pack
+        if pack is None or pack[1] is None:
+            return None
+        if pack[1].shape[0] != self._state.emb.shape[0]:
+            return None
+        return (pack[0].centroids, pack[1])
+
+    def _pq_sole(self, pq) -> bool:
+        # book_cent is held by the PQCodebook field + the threaded tuple,
+        # codes by the pack tuple + the threaded tuple — one slot more
+        # than the shadow's gate counts, hence the +1. A serving dispatch
+        # holding either array forces the copying twin.
+        return (pq is None
+                or (sys.getrefcount(pq[0]) <= self._SOLE_SHADOW_REFS + 1
+                    and sys.getrefcount(pq[1]) <= self._SOLE_SHADOW_REFS + 1))
+
+    def _store_pq_dev(self, new_pq) -> None:
+        """Republish the ingest-maintained PQ pack. The donated dispatch
+        consumed the old buffers, so the kernel's returned arrays REPLACE
+        them under the SAME book object (the kernel passes the codebook
+        through unchanged — codes stay paired with the book they were
+        encoded against)."""
+        if new_pq is None:
+            return
+        pack = self._pq_pack
+        if pack is not None:
+            pack[0].centroids = new_pq[0]
+            self._pq_pack = (pack[0], new_pq[1])
+
+    def _pq_encode_rows(self, rows: Sequence[int]) -> None:
+        """Patch exactly ``rows``' codes in the published pack from the
+        CURRENT master (the non-fused writers' twin of the in-kernel
+        ``_pq_scatter``): one small encode + scatter, never the offline
+        full re-encode. No-op without a complete published pack — the
+        next ``ivf_maintenance`` full encode covers those rows."""
+        pack = self._pq_pack
+        if pack is None or pack[1] is None or not rows:
+            return
+        st = self.state
+        codes = pack[1]
+        if codes.shape[0] != st.emb.shape[0]:
+            return
+        from lazzaro_tpu.ops.pq import encode_pq
+        r = jnp.asarray(np.asarray(rows, np.int32))
+        new = encode_pq(pack[0].centroids, st.emb[r])
+        self._pq_pack = (pack[0], codes.at[r].set(new))
+        self.telemetry.bump("pq.rows_encoded", len(rows))
+
     def _apply_fused(self, *args, **kwargs):
         """Dispatch ``S.ingest_fused`` over BOTH states (plus the int8
         shadow when it is being incrementally maintained, plus the live
-        online-IVF coarse tables), donating only when this index holds
-        the sole reference to each; returns ``(link_flat,
-        shadow_maintained, ivf_maintained)`` — the kernel's non-state
-        outputs and which sidecars stayed fresh in-kernel."""
+        online-IVF coarse tables, plus the PQ pack — ISSUE 16), donating
+        only when this index holds the sole reference to each; returns
+        ``(link_flat, shadow_maintained, ivf_maintained, pq_maintained)``
+        — the kernel's non-state outputs and which sidecars stayed fresh
+        in-kernel."""
         sharded = self.ingest_sharded and self.mesh is not None
         with self._state_lock:
             arena, edges = self._state, self._edge_state
             shadow = self._ingest_shadow_arg(sharded_ok=sharded)
             ivf = self._ivf_online_arg()
+            pq = self._pq_ingest_arg()
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                     and sys.getrefcount(edges) <= self._SOLE_REFS
-                    and self._shadow_sole(shadow) and self._ivf_sole(ivf))
+                    and self._shadow_sole(shadow) and self._ivf_sole(ivf)
+                    and self._pq_sole(pq))
             if sharded:
                 # Non-dedup ingest under a mesh (ISSUE 12 satellite): the
                 # distributed plain-ingest program replaces the GSPMD
@@ -632,22 +696,24 @@ class MemoryIndex:
                 else:
                     new_arena, new_edges, link_flat = got
                     new_shadow = None
-                new_ivf = None
+                new_ivf = new_pq = None
             else:
-                (new_arena, new_edges, new_shadow, new_ivf,
+                (new_arena, new_edges, new_shadow, new_ivf, new_pq,
                  link_flat) = self._guarded(
                     lambda fn: self._ingest_dispatch(fn, arena, edges,
-                                                     shadow, ivf, *args,
-                                                     **kwargs),
+                                                     shadow, ivf, pq,
+                                                     *args, **kwargs),
                     S.ingest_fused, S.ingest_fused_copy, sole,
-                    (arena, edges, shadow, ivf), "ingest")
-            del arena, edges, shadow, ivf
+                    (arena, edges, shadow, ivf, pq), "ingest")
+            del arena, edges, shadow, ivf, pq
             self.state = new_arena
             self.edge_state = new_edges
             if new_shadow is not None:
                 self._int8_shadow = new_shadow
             self._store_ivf_dev(new_ivf)
-        return link_flat, new_shadow is not None, new_ivf is not None
+            self._store_pq_dev(new_pq)
+        return (link_flat, new_shadow is not None, new_ivf is not None,
+                new_pq is not None)
 
     # ------------------------------------------------------------------ ids
     def tenant_id(self, name: str) -> int:
@@ -697,16 +763,16 @@ class MemoryIndex:
         """Attach a :class:`tier.TierManager`: a per-row residency column,
         host cold stores (one per mesh partition), and the watermark/
         hysteresis demotion policy. Serving switches to the tiered fused
-        program the moment any row is cold: the int8 coarse scan covers
-        the whole corpus from the (always-maintained) shadow, hot-only
-        turns stay ONE dispatch, cold-hit turns pay one bounded finish
-        dispatch. Incompatible with ``pq_serving`` (the PQ member scan
-        rescores from the master, which a cold row no longer has).
-        Returns the manager (also at ``self.tiering``)."""
+        program the moment any row is cold: the coarse scan covers the
+        whole corpus from the (always-maintained) shadow — int8 codes,
+        or the m-byte PQ slab under ``pq_serving`` (ISSUE 16 lifted the
+        old incompatibility: a demoted row's PQ codes stay valid because
+        the incremental scatter never touches them, and the rare re-seed
+        re-encode patches them from the host cold store) — hot-only turns
+        stay ONE dispatch, cold-hit turns pay one bounded finish
+        dispatch. Returns the manager (also at ``self.tiering``)."""
         from lazzaro_tpu.tier import TierManager
 
-        if self.pq_serving:
-            raise ValueError("tiering is incompatible with pq_serving")
         self.tiering = TierManager(self, hot_budget_rows, **kw)
         return self.tiering
 
@@ -740,7 +806,15 @@ class MemoryIndex:
             new_cap = self._grown_capacity(old_cap)
             self.state = S.grow_arena(self.state, new_cap)
             self._int8_dirty = True        # emb shape changed
-            self._pq_dirty = True
+            pack = self._pq_pack
+            if pack is not None and pack[1] is not None:
+                # pad the code slab in place of a full re-encode: grown
+                # rows are free (not alive) until written, and every
+                # writer patches its own rows' codes
+                codes = pack[1]
+                grown = jnp.zeros((new_cap + 1, codes.shape[1]), jnp.uint8)
+                self._pq_pack = (pack[0],
+                                 grown.at[:codes.shape[0]].set(codes))
             self._emb_gen += 1
             if self.tiering is not None:
                 self.tiering.on_grow(new_cap + 1)
@@ -798,7 +872,7 @@ class MemoryIndex:
             jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
         )
         self._int8_dirty = True            # emb rows written
-        self._pq_dirty = True
+        self._pq_encode_rows(rows)         # codes patched, never re-encoded
         self._emb_gen += 1
         self._note_super(rows, [bool(x) for x in is_super])
         self._ivf_note_added(rows)
@@ -1062,7 +1136,7 @@ class MemoryIndex:
                 else "fused")
         t0 = time.perf_counter()
         with trace_annotation(f"lz.ingest.{kind}"):
-            link_flat, shadow_fresh, ivf_fresh = self._apply_fused(
+            link_flat, shadow_fresh, ivf_fresh, pq_fresh = self._apply_fused(
                 jnp.asarray(padded), jnp.asarray(emb),
                 jnp.asarray(pad([float(s) for s in saliences])),
                 jnp.asarray(pad([float(t) - self.epoch
@@ -1083,7 +1157,10 @@ class MemoryIndex:
                 k=k_eff, shard_modes=shard_modes)
             if not shadow_fresh:
                 self._int8_dirty = True
-            self._pq_dirty = True
+            if not pq_fresh:
+                # kernel couldn't thread the pack (mesh fallback / pre-
+                # publish): patch exactly this batch's rows host-side
+                self._pq_encode_rows(rows)
             self._emb_gen += 1
             self._note_super(rows, [bool(x) for x in is_super])
             if self.tiering is not None:   # a re-added cold row is hot again
@@ -1206,20 +1283,23 @@ class MemoryIndex:
 
     def _apply_dedup_fused(self, *args, k, shard_modes):
         """Dispatch the device-dedup fused ingest over BOTH states (plus
-        the maintained int8 shadow) under the ownership gate (mirror of
-        ``_apply_fused``); returns ``(flat, shadow_maintained)``. Under a
-        mesh with ``ingest_sharded`` the program is the distributed
-        shard_map composition (ONE distributed dispatch; the shadow
-        row-shards with the master, so it stays maintained in-kernel on
-        the pod path too)."""
+        the maintained int8 shadow, online-IVF tables, and PQ pack) under
+        the ownership gate (mirror of ``_apply_fused``); returns ``(flat,
+        shadow_maintained, ivf_maintained, pq_maintained)``. Under a mesh
+        with ``ingest_sharded`` the program is the distributed shard_map
+        composition (ONE distributed dispatch; the shadow row-shards with
+        the master, so it stays maintained in-kernel on the pod path
+        too)."""
         sharded = self.ingest_sharded and self.mesh is not None
         with self._state_lock:
             arena, edges = self._state, self._edge_state
             shadow = self._ingest_shadow_arg(sharded_ok=sharded)
             ivf = self._ivf_online_arg()
+            pq = self._pq_ingest_arg()
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                     and sys.getrefcount(edges) <= self._SOLE_REFS
-                    and self._shadow_sole(shadow) and self._ivf_sole(ivf))
+                    and self._shadow_sole(shadow) and self._ivf_sole(ivf)
+                    and self._pq_sole(pq))
             if sharded:
                 kern = self._ingest_sharded_kernels(k, tuple(shard_modes),
                                                     shadow is not None)
@@ -1237,22 +1317,24 @@ class MemoryIndex:
                         kern.ingest, kern.ingest_copy, sole,
                         (arena, edges), "ingest_sharded")
                     new_shadow = None
-                new_ivf = None
+                new_ivf = new_pq = None
             else:
-                (new_arena, new_edges, new_shadow, new_ivf,
+                (new_arena, new_edges, new_shadow, new_ivf, new_pq,
                  flat) = self._guarded(
                     lambda fn: self._ingest_dispatch(
-                        fn, arena, edges, shadow, ivf, *args, k=k,
+                        fn, arena, edges, shadow, ivf, pq, *args, k=k,
                         shard_modes=shard_modes),
                     S.ingest_dedup_fused, S.ingest_dedup_fused_copy, sole,
-                    (arena, edges, shadow, ivf), "ingest")
-            del arena, edges, shadow, ivf
+                    (arena, edges, shadow, ivf, pq), "ingest")
+            del arena, edges, shadow, ivf, pq
             self.state = new_arena
             self.edge_state = new_edges
             if new_shadow is not None:
                 self._int8_shadow = new_shadow
             self._store_ivf_dev(new_ivf)
-        return flat, new_shadow is not None, new_ivf is not None
+            self._store_pq_dev(new_pq)
+        return (flat, new_shadow is not None, new_ivf is not None,
+                new_pq is not None)
 
     def _ingest_geometry(self, n: int, link_k: int = 3) -> Geometry:
         return Geometry(
@@ -1262,7 +1344,8 @@ class MemoryIndex:
             dtype_bytes=int(np.dtype(self.dtype).itemsize),
             mesh_parts=self._n_parts, edge_cap=self.edge_state.capacity,
             link_k=max(1, int(link_k)),
-            ivf=1 if self._ivf_online_arg() is not None else 0)
+            ivf=1 if self._ivf_online_arg() is not None else 0,
+            pq=1 if self._pq_ingest_arg() is not None else 0)
 
     def plan_ingest(self, n: int, link_k: int = 3):
         """Admission decision for an ``n``-fact fused ingest mega-batch
@@ -1369,11 +1452,14 @@ class MemoryIndex:
         self._maybe_record_ingest_hbm(dev_args, k_eff, shard_modes, b)
         t0 = time.perf_counter()
         with trace_annotation(f"lz.ingest.{kind}"):
-            flat, shadow_fresh, ivf_fresh = self._apply_dedup_fused(
+            flat, shadow_fresh, ivf_fresh, pq_fresh = self._apply_dedup_fused(
                 *dev_args, k=k_eff, shard_modes=shard_modes)
             if not shadow_fresh:
                 self._int8_dirty = True
-            self._pq_dirty = True
+            if not pq_fresh:
+                # dup rows never became alive, but their codes are masked
+                # with them — patching the whole batch is safe and cheap
+                self._pq_encode_rows(rows)
             self._emb_gen += 1
             host = fetch_packed(*flat)         # the ONE readback
         self.telemetry.record("ingest.dispatch_ms",
@@ -1522,8 +1608,10 @@ class MemoryIndex:
         if not self.telemetry_hbm or not self.telemetry.enabled:
             return    # never consume the once-key while warmup mutes the registry
         ivf_on = self._ivf_online_arg() is not None
+        with self._state_lock:
+            pq_on = self._pq_ingest_arg() is not None
         key = ("ingest", b, k_eff, tuple(shard_modes),
-               self.state.emb.shape[0], ivf_on)
+               self.state.emb.shape[0], ivf_on, pq_on)
         if key in self._hbm_recorded:
             return
         self._hbm_recorded.add(key)
@@ -1533,6 +1621,7 @@ class MemoryIndex:
                 sharded = self.ingest_sharded and self.mesh is not None
                 shadow = self._ingest_shadow_arg(sharded_ok=sharded)
                 ivf = self._ivf_online_arg()
+                pq = self._pq_ingest_arg()
                 if sharded:
                     kern = self._ingest_sharded_kernels(
                         k_eff, tuple(shard_modes), shadow is not None)
@@ -1541,7 +1630,7 @@ class MemoryIndex:
                                                      *dev_args)
                 else:
                     lowered = S.ingest_dedup_fused_copy.lower(
-                        arena, edges, shadow, ivf, *dev_args, k=k_eff,
+                        arena, edges, shadow, ivf, pq, *dev_args, k=k_eff,
                         shard_modes=tuple(shard_modes))
             peak = peak_bytes(lowered.compile().memory_analysis())
         except Exception:   # noqa: BLE001 — observability must never block ingest
@@ -1555,6 +1644,10 @@ class MemoryIndex:
                 # the AOT gauge the ivf-aware ingest cost model (ISSUE 12
                 # satellite) calibrates against
                 labels["ivf"] = "true"
+            if pq_on:
+                # the write-path gauge check_hbm_budget.py's pq=true
+                # sweep reads (ISSUE 16 satellite)
+                labels["pq"] = "true"
             self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
                                  labels=labels)
             self.planner.observe_gauge(
@@ -1846,6 +1939,10 @@ class MemoryIndex:
                 count_changed = (want_raw >= 2 * cur_c
                                  or 4 * want_raw <= cur_c)
                 if not count_changed and churn <= pack[0].built_rows // 4:
+                    # no re-seed due — but delete/demote holes still waste
+                    # member-pool capacity; compact them in place when
+                    # they cross the occupancy threshold (ISSUE 16)
+                    self.ivf_member_repack()
                     return False
             elif churn <= pack[0].built_rows // 4:
                 # staleness = rows awaiting a member slot PLUS member
@@ -1874,14 +1971,94 @@ class MemoryIndex:
         self._ivf_pack = (ivf, ())
         self._publish_online_tables(ivf)
         if self.pq_serving:
-            # (re)train the member codebook on the same build cadence; the
-            # codes shadow re-encodes lazily on the serving path. ONE pack
-            # swap: a reader sees the old (book, codes) pair or the new
-            # book awaiting codes — never old codes under a new book.
+            # (re)train the member codebook on the same build cadence and
+            # publish it WITH its complete code slab in ONE pack swap — a
+            # reader sees the old (book, codes) pair or the new complete
+            # one, never old codes under a new book (r5 review) and never
+            # a codeless book on the serving path. From here the pack is
+            # self-maintaining (in-kernel ``_pq_scatter``, per-row
+            # ``_pq_encode_rows``, grow-time slab pad) until the next
+            # re-seed — this is the ONLY full encode (ISSUE 16).
             from lazzaro_tpu.ops.pq import train_pq
-            self._pq_dirty = True
-            self._pq_pack = (train_pq(st.emb, mask_np), None)
+            self._pq_publish(train_pq(st.emb, mask_np), st)
         return True
+
+    def ivf_member_repack(self, hole_frac: float = 0.25) -> bool:
+        """Compact the holes out of the LIVE online member tables. Tier-
+        demote scrubs member slots to -1 and ``delete`` leaves slots
+        pointing at dead (``alive``-masked) rows, both without moving the
+        per-cluster append cursor — so the holes waste pool capacity
+        (appends overflow to the extras earlier than the live population
+        warrants) until a full re-seed re-packs the tables. This is the
+        cheap middle ground (ISSUE 16 satellite): ONE host pass reusing
+        the prefix-sum pool-compactor idiom (stable partition of live
+        slots ahead of holes per cluster, cursors reset to the live
+        population) and one table republish — no k-means, no re-route.
+        Fires only when holes exceed ``hole_frac`` of the occupied slots;
+        returns True if a repack ran and bumps ``ivf.member_repacks``."""
+        if self._ivf_dev is None:
+            return False
+        with self._state_lock:
+            dev = self._ivf_dev
+            if dev is None:
+                return False
+            members = np.asarray(dev[1])
+            counts = np.asarray(dev[2])
+            alive = np.asarray(self._state.alive)
+            n_slots = members.shape[1]
+            idx = np.arange(n_slots)[None, :]
+            occ = idx < counts[:, None]
+            row_ok = np.take(alive, np.clip(members, 0, len(alive) - 1))
+            live = (members >= 0) & occ & row_ok
+            n_occ = int(occ.sum())
+            holes = n_occ - int(live.sum())
+            if holes <= 0 or holes < hole_frac * max(1, n_occ):
+                return False
+            order = np.argsort(~live, axis=1, kind="stable")
+            packed = np.take_along_axis(members, order, axis=1)
+            new_counts = live.sum(axis=1).astype(counts.dtype)
+            packed[idx >= new_counts[:, None]] = -1
+            # fresh uploads, never an in-place scatter: a serving dispatch
+            # may still hold the old tables (same publish discipline as
+            # ``_publish_online_tables``)
+            self._ivf_dev = (dev[0], jnp.asarray(packed),
+                             jnp.asarray(new_counts))
+        self.telemetry.bump("ivf.member_repacks")
+        self.telemetry.bump("ivf.member_holes_reclaimed", holes)
+        return True
+
+    def _pq_publish(self, book, st) -> None:
+        """Publish a freshly trained codebook WITH its complete code slab
+        in ONE pack swap — the pack is complete from the moment it is
+        visible, so the serving path never encodes (ISSUE 16 killed
+        ``_pq_dirty``/lazy re-encode). Cold rows' masters are zeroed by
+        the commit-then-zero demote, so their codes are encoded from the
+        exact vectors in the host cold store instead. If a writer raced
+        the off-lock encode, it is redone once with the lock held (no
+        further rows can land mid-encode); maintenance is rare, so the
+        paused-writer window is acceptable."""
+        from lazzaro_tpu.ops.pq import encode_pq
+
+        def _codes(arena):
+            codes = encode_pq(book.centroids, arena.emb)
+            tm = self.tiering
+            if tm is not None and tm.cold_count:
+                rows = np.nonzero(tm.cold_np[:arena.emb.shape[0]])[0]
+                if len(rows):
+                    vecs = jnp.asarray(
+                        np.asarray(tm.gather_cold(rows.tolist()),
+                                   np.float32))
+                    r = jnp.asarray(rows.astype(np.int32))
+                    codes = codes.at[r].set(
+                        encode_pq(book.centroids, vecs))
+            return codes
+
+        codes = _codes(st)
+        with self._state_lock:
+            if self._state is not st:
+                codes = _codes(self._state)
+            self._pq_pack = (book, codes)
+        self.telemetry.bump("pq.publishes")
 
     def ivf_staleness_probe(self) -> Optional[float]:
         """Measured ``assignment_staleness`` of the live coarse tables:
@@ -1905,22 +2082,20 @@ class MemoryIndex:
         return frac
 
     def _pq_codes_for(self, st: S.ArenaState, pack):
-        """Lazy re-encode of the PQ code shadow from ONE arena snapshot
-        (same contract as the int8 shadow: invalidated by add/grow,
-        cleared only when no writer raced past ``st``). Codes are encoded
-        with — and published next to — ``pack``'s book; if a maintenance
-        retrain raced us, the fresh codes are still returned for THIS
-        serve (they match the local book) but never published against the
-        newer book (r5 review: that pairing scores garbage)."""
+        """Codes paired with ``pack``'s book for ONE arena snapshot. Since
+        ISSUE 16 the published pack is complete and self-maintaining, so
+        this is normally a plain read; the defensive one-shot encode only
+        covers a pack caught mid-publish (codeless book) or an arena that
+        grew past the slab. Defensively-encoded codes are still returned
+        for THIS serve (they match the local book) but published only
+        when neither the pack nor the arena moved — never against a newer
+        book (r5 review: that pairing scores garbage)."""
         book, codes = pack
-        if (self._pq_dirty or codes is None
-                or codes.shape[0] != st.emb.shape[0]):
+        if codes is None or codes.shape[0] != st.emb.shape[0]:
             from lazzaro_tpu.ops.pq import encode_pq
             codes = encode_pq(book.centroids, st.emb)
-            if self._pq_pack is pack:
+            if self._pq_pack is pack and self.state is st:
                 self._pq_pack = (book, codes)
-                if self.state is st:
-                    self._pq_dirty = False
         return codes
 
     def _ivf_residual_dev(self, ivf, fresh):
@@ -2006,6 +2181,38 @@ class MemoryIndex:
         if n_cand < k_kernel:
             return None
         return cent, members, extras, nprobe
+
+    def _pq_fused_pack(self, k_kernel: int):
+        """(centroids, members, extras, nprobe, book_cent, codes) tables
+        for the fused PQ serving kernel (ISSUE 16), or None to fall
+        through the routing to the remaining modes. None when: PQ is off
+        or has no coarse routing to ride, the index is mesh-backed (the
+        pod index threads its own row-sharded pack), no COMPLETE pack is
+        published yet (``ivf_maintenance`` trains and fully encodes in
+        one swap — a codeless book never serves), the code slab lags the
+        arena (grow mid-publish), no coarse build exists, or the
+        candidate count can't fill the kernel's k. Like the IVF pack,
+        the live tables ARE the identity — the in-kernel ``_pq_scatter``
+        keeps the codes current, so there is nothing to invalidate."""
+        if (not self.pq_serving or not self.ivf_nprobe
+                or self.mesh is not None):
+            return None
+        pq = self._pq_pack
+        if pq is None or pq[1] is None:
+            return None
+        if pq[1].shape[0] != self.state.emb.shape[0]:
+            return None
+        pack = self._ivf_pack
+        if pack is None:
+            return None
+        ivf, fresh = pack
+        extras = self._ivf_extras_dev(ivf, fresh)
+        cent, members = self._ivf_live_tables(ivf)
+        nprobe = min(self.ivf_nprobe, int(cent.shape[0]))
+        n_cand = nprobe * members.shape[1] + extras.shape[0]
+        if n_cand < k_kernel:
+            return None
+        return cent, members, extras, nprobe, pq[0].centroids, pq[1]
 
     def _int8_shadow_for(self, st: S.ArenaState):
         """(Re)build the int8 shadow from ONE arena snapshot; under a mesh
@@ -2112,12 +2319,18 @@ class MemoryIndex:
                     else "quant" if self.int8_serving else "exact")
             return "sharded_" + base, k_bucket
         if tiered:
-            # IVF composes with tiering now (ISSUE 12): hot candidates
-            # from the member gather, cold rows from the shadow coarse
-            # scan — no dense fallback when a build is published.
+            # IVF composes with tiering now (ISSUE 12), and so does PQ
+            # (ISSUE 16): hot candidates from the member gather, cold
+            # rows from the residency-masked shadow coarse scan — int8
+            # codes or the m-byte PQ slab — no dense fallback when a
+            # build is published.
+            if self._pq_fused_pack(k_bucket) is not None:
+                return "pq_tiered", k_bucket
             if self._ivf_fused_pack(k_bucket) is not None:
                 return "ivf_tiered", k_bucket
             return "tiered", k_bucket
+        if self._pq_fused_pack(k_bucket) is not None:
+            return "pq", k_bucket
         if self._ivf_fused_pack(k_bucket) is not None:
             return "ivf", k_bucket
         if self.int8_serving:
@@ -2133,7 +2346,8 @@ class MemoryIndex:
             dim=self.dim, k=k_bucket,
             dtype_bytes=int(np.dtype(self.dtype).itemsize),
             mesh_parts=self._n_parts, edge_cap=self.edge_state.capacity,
-            nprobe=int(self.ivf_nprobe or 0))
+            nprobe=int(self.ivf_nprobe or 0),
+            slack=int(self.coarse_slack))
 
     def search_fused_requests(self, reqs, *, cap_take: int, max_nbr: int,
                               super_gate: float, acc_boost: float,
@@ -2413,14 +2627,28 @@ class MemoryIndex:
         # coarse scan, merged at the k+slack window for the same bounded
         # cold finish.
         ivf_tabs = self._ivf_fused_pack(k_bucket)
+        # Fused PQ serving (ISSUE 16): with a complete (book, codes) pack
+        # published, the coarse stage is the m-byte ADC member scan — the
+        # flat LUT built in-kernel from the query and codebook, codes
+        # gathered for the visited clusters' members, exact f32 rescore
+        # of the top-(k+slack) survivors from the master — and the gate/
+        # CSR/boost tail rides unchanged: the last serving mode joins the
+        # ONE-dispatch contract. With cold rows present PQ composes with
+        # tiering the same way IVF does, except the cold coarse scan
+        # reads the PQ slab (m bytes/row) instead of the int8 shadow.
+        pq_tabs = self._pq_fused_pack(k_bucket)
         ivf_tiered = tiered and ivf_tabs is not None
-        if ivf_tabs is not None:
-            statics["nprobe"] = ivf_tabs[3]
+        pq_tiered = tiered and pq_tabs is not None
+        coarse_tabs = pq_tabs if pq_tabs is not None else ivf_tabs
+        if coarse_tabs is not None:
+            statics["nprobe"] = coarse_tabs[3]
             statics["slack"] = self.coarse_slack
         elif use_quant or tiered:
             statics["slack"] = self.coarse_slack
-        mode = ("ivf_tiered" if ivf_tiered
+        mode = ("pq_tiered" if pq_tiered
+                else "ivf_tiered" if ivf_tiered
                 else "tiered" if tiered
+                else "pq" if pq_tabs is not None
                 else "ivf" if ivf_tabs is not None
                 else "quant" if use_quant else "exact")
         # Ragged sidecar device columns (ISSUE 7): per-query k / cap /
@@ -2431,8 +2659,8 @@ class MemoryIndex:
             np.minimum(cap_arr, statics["cap_take"], out=cap_arr)
             k_dev = jnp.asarray(padb(k_arr, 0, np.int32))
             capq_dev = jnp.asarray(padb(cap_arr, 0, np.int32))
-            if ivf_tabs is not None:
-                ceil_np = ivf_tabs[3]
+            if coarse_tabs is not None:
+                ceil_np = coarse_tabs[3]
                 np_arr = np.zeros((nq,), np.int32)
                 for i, r in enumerate(reqs):
                     rn = getattr(r, "nprobe", None)
@@ -2446,12 +2674,16 @@ class MemoryIndex:
             # score tile, SAME single dispatch, bit-identical results.
             statics["scan_chunk"] = int(scan_chunk)
         self._note_serve_kernel(mode, statics, ragged)
-        tier_pack = ((*self._int8_shadow_for(st), tm.cold_mask_dev())
-                     if tiered else None)
+        # pq_tiered never touches the int8 shadow — the cold coarse scan
+        # reads the PQ slab already in pq_tabs; only the residency mask
+        # rides in the tier pack there
+        tier_pack = (None if not tiered
+                     else (tm.cold_mask_dev(),) if pq_tiered
+                     else (*self._int8_shadow_for(st), tm.cold_mask_dev()))
         self._maybe_record_hbm(mode, st, args, statics, super_gate,
                                ivf_tabs, use_quant, ragged=ragged,
                                k_dev=k_dev, npq_dev=npq_dev,
-                               tier_pack=tier_pack)
+                               tier_pack=tier_pack, pq_tabs=pq_tabs)
         # Fault point "plan.oom" (ISSUE 11): an HBM allocation failure the
         # admission plan missed; the wrapper answers with one replan.
         faults.fire("plan.oom", mode=mode, batch=pad_n)
@@ -2477,7 +2709,42 @@ class MemoryIndex:
                     # at the end executes it donation-safe (ISSUE 10):
                     # a transient failure retries through the copying
                     # twin, a consumed input raises typed ArenaPoisoned.
-                    if ivf_tiered:
+                    if pq_tiered:
+                        # PQ × tiering (ISSUE 16): exact member gather for
+                        # hot, residency-masked ADC coarse over the code
+                        # slab for cold — the codes/tables are read-only
+                        # replicas, so only the residency mask is taken
+                        # fresh here
+                        cold_dev = tm.cold_mask_dev()
+                        cent, members, extras, _, book_cent, codes = \
+                            pq_tabs
+                        pre = (book_cent, codes, cold_dev, cent, members,
+                               extras)
+                        if ragged:
+                            twins = (S.search_fused_pq_tiered_ragged,
+                                     S.search_fused_pq_tiered_ragged_copy)
+                            boost_args = (boost_dev, k_dev, capq_dev,
+                                          npq_dev) + scalars
+                        else:
+                            twins = (S.search_fused_pq_tiered,
+                                     S.search_fused_pq_tiered_copy)
+                            boost_args = (boost_dev,) + scalars
+                    elif pq_tabs is not None:
+                        # Fused PQ serving (ISSUE 16): ADC member scan +
+                        # exact shortlist rescore, then the same tail
+                        cent, members, extras, _, book_cent, codes = \
+                            pq_tabs
+                        pre = (book_cent, codes, cent, members, extras)
+                        if ragged:
+                            twins = (S.search_fused_pq_ragged,
+                                     S.search_fused_pq_ragged_copy)
+                            boost_args = (boost_dev, k_dev, capq_dev,
+                                          npq_dev) + scalars
+                        else:
+                            twins = (S.search_fused_pq,
+                                     S.search_fused_pq_copy)
+                            boost_args = (boost_dev,) + scalars
+                    elif ivf_tiered:
                         # IVF × tiering (ISSUE 12): member gather for hot,
                         # residency-masked shadow coarse for cold — all
                         # taken against ``cur`` under the lock
@@ -2559,6 +2826,29 @@ class MemoryIndex:
                         "serve_" + mode)
                     del cur
                     self.state = new_state
+            elif pq_tiered:
+                cold_dev = tm.cold_mask_dev()
+                cent, members, extras, _, book_cent, codes = pq_tabs
+                if ragged:
+                    packed = S.search_fused_pq_tiered_ragged_read(
+                        st, book_cent, codes, cold_dev, cent, members,
+                        extras, *args, k_dev, npq_dev,
+                        jnp.float32(super_gate), **statics)
+                else:
+                    packed = S.search_fused_pq_tiered_read(
+                        st, book_cent, codes, cold_dev, cent, members,
+                        extras, *args, jnp.float32(super_gate), **statics)
+            elif pq_tabs is not None:
+                cent, members, extras, _, book_cent, codes = pq_tabs
+                if ragged:
+                    packed = S.search_fused_pq_ragged_read(
+                        st, book_cent, codes, cent, members, extras,
+                        *args, k_dev, npq_dev, jnp.float32(super_gate),
+                        **statics)
+                else:
+                    packed = S.search_fused_pq_read(
+                        st, book_cent, codes, cent, members, extras,
+                        *args, jnp.float32(super_gate), **statics)
             elif ivf_tiered:
                 q8, scale = self._int8_shadow_for(st)
                 cold_dev = tm.cold_mask_dev()
@@ -2700,7 +2990,8 @@ class MemoryIndex:
                         if self.serve_ragged else
                         min(max(next_pow2(max(cap_take,
                                               int(k or cap_take))), 1), cap))
-            mode = ("ivf" if self._ivf_fused_pack(k_kernel) is not None
+            mode = ("pq" if self._pq_fused_pack(k_kernel) is not None
+                    else "ivf" if self._ivf_fused_pack(k_kernel) is not None
                     else "quant" if self.int8_serving else "exact")
         # the warmup tenant matches no arena row (never allocated to one)
         self._tenants.setdefault("~warmup", -2)
@@ -2746,7 +3037,7 @@ class MemoryIndex:
     def _maybe_record_hbm(self, mode: str, st, args, statics, super_gate,
                           ivf_tabs, use_quant, ragged: bool = False,
                           k_dev=None, npq_dev=None,
-                          tier_pack=None) -> None:
+                          tier_pack=None, pq_tabs=None) -> None:
         """Record the ``memory_analysis()`` peak-HBM gauge for one fused
         serving geometry, once per (mode × k-bucket × cap/nbr) key —
         "Memory Safe Computations with XLA": compiled-program introspection
@@ -2761,7 +3052,30 @@ class MemoryIndex:
             return
         self._hbm_recorded.add(key)
         try:
-            if tier_pack is not None and ivf_tabs is not None:
+            if pq_tabs is not None and tier_pack is not None:
+                cold_dev = tier_pack[-1]
+                cent, members, extras, _, book_cent, codes = pq_tabs
+                if ragged:
+                    lowered = S.search_fused_pq_tiered_ragged_read.lower(
+                        st, book_cent, codes, cold_dev, cent, members,
+                        extras, *args, k_dev, npq_dev,
+                        jnp.float32(super_gate), **statics)
+                else:
+                    lowered = S.search_fused_pq_tiered_read.lower(
+                        st, book_cent, codes, cold_dev, cent, members,
+                        extras, *args, jnp.float32(super_gate), **statics)
+            elif pq_tabs is not None:
+                cent, members, extras, _, book_cent, codes = pq_tabs
+                if ragged:
+                    lowered = S.search_fused_pq_ragged_read.lower(
+                        st, book_cent, codes, cent, members, extras,
+                        *args, k_dev, npq_dev, jnp.float32(super_gate),
+                        **statics)
+                else:
+                    lowered = S.search_fused_pq_read.lower(
+                        st, book_cent, codes, cent, members, extras,
+                        *args, jnp.float32(super_gate), **statics)
+            elif tier_pack is not None and ivf_tabs is not None:
                 q8, scale, cold_dev = tier_pack
                 cent, members, extras, _ = ivf_tabs
                 if ragged:
@@ -2814,14 +3128,20 @@ class MemoryIndex:
         except Exception:   # noqa: BLE001 — observability must never serve 500s
             return
         if peak is not None:
-            self.telemetry.gauge(
-                "kernel.peak_hbm_bytes", peak,
-                labels={"mode": mode,
-                        "k": str(statics.get("k")),
-                        "rows": str(st.emb.shape[0]),
-                        "batch": str(int(args[2].shape[0])),
-                        "mesh": (f"{self._n_parts}x{self.shard_axis}"
-                                 if self.mesh is not None else "1")})
+            labels = {"mode": mode,
+                      "k": str(statics.get("k")),
+                      "rows": str(st.emb.shape[0]),
+                      "batch": str(int(args[2].shape[0])),
+                      "mesh": (f"{self._n_parts}x{self.shard_axis}"
+                               if self.mesh is not None else "1")}
+            if pq_tabs is not None:
+                # the serve-path gauge check_hbm_budget.py's pq=true
+                # sweep reads (ISSUE 16 satellite); slack sizes the
+                # exact-rescore shortlist the cost model must over-bound
+                labels["pq"] = "true"
+                labels["slack"] = str(int(self.coarse_slack))
+            self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
+                                 labels=labels)
             # Calibrate the admission model against the measured truth
             # (ISSUE 11): predictions must over-bound every recorded
             # gauge — the multiplier grows here whenever one beats it.
@@ -2834,7 +3154,8 @@ class MemoryIndex:
                          mesh_parts=self._n_parts,
                          edge_cap=self.edge_state.capacity,
                          nprobe=int(statics.get("nprobe") or 0),
-                         scan_chunk=int(statics.get("scan_chunk") or 0)),
+                         scan_chunk=int(statics.get("scan_chunk") or 0),
+                         slack=int(self.coarse_slack)),
                 peak)
 
     def _demux_fused(self, reqs, results, valid, boost_on, gate_s, gate_r,

@@ -913,10 +913,11 @@ class MemorySystem:
         dispatch, ISSUE 5) — shard-local scan, one all_gather merge,
         shard-local boost scatters — so the pod path keeps the gate /
         neighbor / boost semantics and the one-distributed-dispatch turn
-        too. Only IVF-PQ member storage keeps its classic prefilter scan
-        the fused kernel does not reproduce."""
-        return (self.config.serve_fused
-                and not (self.index.ivf_nprobe and self.index.pq_serving))
+        too. PQ member storage joined the fused path last (ISSUE 16,
+        ``state.search_fused_pq``: in-kernel ADC table build + m-byte
+        member scan + exact shortlist rescore), so every serving mode now
+        keeps the one-dispatch contract — ``serve_fused`` alone decides."""
+        return self.config.serve_fused
 
     def _ensure_scheduler(self) -> QueryScheduler:
         """Lazily spawn the cross-request query scheduler (one worker thread
@@ -2884,6 +2885,11 @@ Be clinical yet insightful. Do not include conversational filler."""
                                   else None),
             "serve_dispatches": tel.counter_total("serve.dispatches"),
             "ingest_dispatches": tel.counter_total("ingest.dispatches"),
+            # ISSUE 16 satellite: rows the non-fused write surface spilled
+            # into the exact-scan extras (pod add()) — the residual write
+            # path's burden on the coarse structure, as a headline number.
+            "ivf_add_extras_spills": tel.counter_total(
+                "ivf.add_extras_spills"),
             "link_pool_overflows": self.index.link_pool_overflows,
             "peak_hbm_bytes": peak_hbm or None,
             "scheduler": (self.query_scheduler.stats()

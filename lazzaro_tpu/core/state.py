@@ -627,6 +627,31 @@ def _shadow_scatter(shadow, rows: jax.Array, emb_stored: jax.Array):
     return (q8.at[rows].set(q_new), scale.at[rows].set(s_new))
 
 
+def _pq_scatter(pq, rows: jax.Array, emb_stored: jax.Array):
+    """Incremental PQ code maintenance INSIDE the fused ingest program
+    (ISSUE 16, the PQ twin of ``_shadow_scatter``): encode exactly the
+    rows being written against the FROZEN codebook — m small
+    [B, dsub]×[dsub, 256] matmuls, the same argmax ``ops.pq.encode_pq``
+    runs over the whole arena — and scatter their m-byte codes in place.
+    An O(batch) update instead of the offline full re-encode the old
+    ``_pq_dirty`` flag forced; codebook drift is handled by the rare
+    ``ivf_maintenance`` re-seed, never here. ``pq`` is ``(book_cent
+    [m, 256, dsub] f32, codes [cap+1, m] u8)`` or None (PQ serving off /
+    no published pack); None passes through untouched. Sentinel-padded
+    rows encode into the sentinel row — harmless, every serving scan
+    masks it."""
+    if pq is None:
+        return None
+    book_cent, codes = pq
+    m, _, dsub = book_cent.shape
+    x = emb_stored.astype(jnp.float32).reshape(rows.shape[0], m, dsub)
+    cnorm = jnp.sum(book_cent * book_cent, axis=2)              # [m, 256]
+    scores = (2.0 * jnp.einsum("nmd,mkd->nmk", x, book_cent)
+              - cnorm[None, :, :])                              # [B, m, 256]
+    new = jnp.argmax(scores, axis=2).astype(jnp.uint8)
+    return (book_cent, codes.at[rows].set(new))
+
+
 def _ivf_online_assign(cent: jax.Array, qf: jax.Array, live: jax.Array
                        ) -> jax.Array:
     """Cluster assignment of the accepted batch against the CURRENT
@@ -733,6 +758,7 @@ def _ingest_fused(
     edges: EdgeState,
     shadow,                  # (q8 [cap+1, d] i8, scale [cap+1] f32) or None
     ivf,                     # (cent [C,d], members [C,M], counts [C]) or None
+    pq,                      # (book_cent [m,256,dsub], codes [cap+1,m]) or None
     rows: jax.Array,         # [B] i32 new-node rows, sentinel-padded
     emb: jax.Array,          # [B, d]
     salience: jax.Array,     # [B] f32
@@ -756,7 +782,8 @@ def _ingest_fused(
     ivf_eta: jax.Array,      # centroid learning-rate scale (inert w/o ivf)
     k: int,
     shard_modes: Tuple[int, ...] = (1, 0),
-) -> Tuple[ArenaState, EdgeState, object, object, Tuple[jax.Array, ...]]:
+) -> Tuple[ArenaState, EdgeState, object, object, object,
+           Tuple[jax.Array, ...]]:
     """The per-conversation ingest sequence — ``arena_add`` →
     ``arena_merge_touch`` → ``arena_link_candidates_multi`` → gated
     ``edges_add`` — fused into ONE donated device program.
@@ -777,12 +804,15 @@ def _ingest_fused(
     centroids, appended to their clusters' member tables, and the
     mini-batch centroid step runs — all inside this same dispatch
     (``_ivf_online_update``; the extra readback leaves trail the link
-    counters)."""
+    counters). With PQ serving on, the written rows' m-byte codes are
+    re-encoded against the frozen codebook in the same program
+    (``_pq_scatter``) — no extra dispatches, no extra readback leaves."""
     qf = normalize(emb)
     emb_stored = qf.astype(arena.emb.dtype)
     arena = _arena_add(arena, rows, emb, salience, timestamp, type_id,
                        shard_id, tenant_id, is_super)
     shadow = _shadow_scatter(shadow, rows, emb_stored)
+    pq = _pq_scatter(pq, rows, emb_stored)
     arena = _arena_merge_touch(arena, touch_rows, touch_sal, now)
     link_flat = _arena_link_candidates_multi(arena, rows, rows, tenant, k,
                                              shard_modes)
@@ -801,7 +831,7 @@ def _ingest_fused(
         outs = outs + tuple(
             jnp.broadcast_to(x[:, None], leaf) for x in (a_rb, p_rb)
         ) + tuple(jnp.broadcast_to(t, leaf) for t in tail)
-    return arena, edges, shadow, ivf, outs
+    return arena, edges, shadow, ivf, pq, outs
 
 
 def _gated_link_insert(edges, link_flat, link_pool, pool_len, src_rows,
@@ -884,7 +914,8 @@ def _gated_link_insert(edges, link_flat, link_pool, pool_len, src_rows,
 
 
 ingest_fused, ingest_fused_copy = _donated_pair(
-    _ingest_fused, donate=(0, 1, 2, 3), static_argnames=("k", "shard_modes"))
+    _ingest_fused, donate=(0, 1, 2, 3, 4),
+    static_argnames=("k", "shard_modes"))
 
 
 # ---------------------------------------------------------------------------
@@ -998,6 +1029,7 @@ def _ingest_dedup_fused(
     edges: EdgeState,
     shadow,                  # (q8 [cap+1, d] i8, scale [cap+1] f32) or None
     ivf,                     # (cent [C,d], members [C,M], counts [C]) or None
+    pq,                      # (book_cent [m,256,dsub], codes [cap+1,m]) or None
     rows: jax.Array,         # [B] i32 candidate row per fact, sentinel-padded
     emb: jax.Array,          # [B, d]
     salience: jax.Array,     # [B] f32 (doubles as the merge-touch candidate)
@@ -1019,7 +1051,8 @@ def _ingest_dedup_fused(
     ivf_eta: jax.Array,      # centroid learning-rate scale (inert w/o ivf)
     k: int,
     shard_modes: Tuple[int, ...] = (1, 0),
-) -> Tuple[ArenaState, EdgeState, object, object, Tuple[jax.Array, ...]]:
+) -> Tuple[ArenaState, EdgeState, object, object, object,
+           Tuple[jax.Array, ...]]:
     """``_ingest_fused`` plus the dedup probe the classic pipeline pays a
     separate dispatch+readback for: masked top-1 against the PRE-add arena
     and an intra-batch gram resolve duplicate facts ON DEVICE, duplicate
@@ -1057,6 +1090,7 @@ def _ingest_dedup_fused(
     arena = _arena_add(arena, add_rows, emb, salience, timestamp, type_id,
                        shard_id, tenant_id, is_super)
     shadow = _shadow_scatter(shadow, add_rows, qd)
+    pq = _pq_scatter(pq, add_rows, qd)
     touch_rows = jnp.where(dup, target, cap)
     arena = _arena_merge_touch(arena, touch_rows, salience, now)
     chain_live = chain_src >= 0
@@ -1082,11 +1116,11 @@ def _ingest_dedup_fused(
     # and the host fetches them all in ONE packed transfer
     wide = tuple(jnp.broadcast_to(a[:, None], (b, k))
                  for a in (dup.astype(jnp.int32), target, chain_src))
-    return arena, edges, shadow, ivf, wide + outs
+    return arena, edges, shadow, ivf, pq, wide + outs
 
 
 ingest_dedup_fused, ingest_dedup_fused_copy = _donated_pair(
-    _ingest_dedup_fused, donate=(0, 1, 2, 3),
+    _ingest_dedup_fused, donate=(0, 1, 2, 3, 4),
     static_argnames=("k", "shard_modes"))
 
 
@@ -1138,6 +1172,7 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
                               shard_modes: Tuple[int, ...] = (1, 0),
                               with_shadow: bool = False,
                               with_ivf: bool = False,
+                              with_pq: bool = False,
                               dedup: bool = True
                               ) -> IngestShardedKernels:
     """Build the distributed fused ingest program for ``mesh``.
@@ -1181,6 +1216,14 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
     leaves as the single-chip kernel (assign, member pos, overflow,
     occupancy, appends, centroid shift).
 
+    ``with_pq=True`` (ISSUE 16) threads the PQ pack after the IVF
+    tables: ``book_cent [m, 256, dsub]`` replicated (frozen between
+    re-seeds) and ``codes [rows, m]`` u8 row-sharded with the master.
+    The accepted rows' codes are re-encoded against the codebook and
+    scattered owner-chip-local through the same localized row vector as
+    the node scatter (``_pq_scatter`` — replicated arithmetic, local
+    write). No extra readback leaves, no extra collectives.
+
     ``dedup=False`` builds the NON-dedup program instead (ROADMAP
     residual: ``ingest_batch`` under a mesh) — the ``_ingest_fused``
     semantics composed with the mesh: explicit merge-touch rows and
@@ -1215,13 +1258,15 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
         return jnp.where((loc >= 0) & (loc < n_local), loc, n_local)
 
     def _split_state(rest):
-        shadow = ivf = None
+        shadow = ivf = pq = None
         if with_shadow:
             shadow, rest = (rest[0], rest[1]), rest[2:]
         if with_ivf:
             # members arrive stacked [1, C, M] inside shard_map
             ivf, rest = (rest[0], rest[1][0], rest[2]), rest[3:]
-        return shadow, ivf, rest
+        if with_pq:
+            pq, rest = (rest[0], rest[1]), rest[2:]
+        return shadow, ivf, pq, rest
 
     def _cent_group(ivf, qf, shard):
         """This chip's centroid-slice top-1 as one more merge candidate
@@ -1302,16 +1347,18 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
             jnp.broadcast_to(x[:, None], (b, k)) for x in (a_rb, p_rb)
         ) + tuple(jnp.broadcast_to(t, (b, k)) for t in tail)
 
-    def _pack_state(arena, edges, shadow, ivf, outs):
+    def _pack_state(arena, edges, shadow, ivf, pq, outs):
         out = (arena, edges)
         if with_shadow:
             out = out + (shadow[0], shadow[1])
         if with_ivf:
             out = out + (ivf[0], ivf[1][None, :, :], ivf[2])
+        if with_pq:
+            out = out + (pq[0], pq[1])
         return out + (outs,)
 
     def _local(arena, edges, *rest):
-        shadow, ivf, rest = _split_state(rest)
+        shadow, ivf, pq, rest = _split_state(rest)
         (rows, emb, salience, timestamp, type_id, shard_id_v, tenant_id_v,
          is_super, chain_gid, chain_slots, link_pool, pool_len, now, tenant,
          dedup_gate, chain_w, link_gate, link_scale, ivf_eta) = rest
@@ -1376,6 +1423,7 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
         arena = _arena_add(arena, add_l, emb, salience, timestamp, type_id,
                            shard_id_v, tenant_id_v, is_super)
         shadow = _shadow_scatter(shadow, add_l, qd)
+        pq = _pq_scatter(pq, add_l, qd)
         touch_l = _localize(jnp.where(dup, target, cap), row_base, local_n)
         arena = _arena_merge_touch(arena, touch_l, salience, now)
 
@@ -1400,7 +1448,7 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
             outs = outs + _ivf_outs(ivf, a_rb, p_rb, tail, b)
         wide = tuple(jnp.broadcast_to(a[:, None], (b, k))
                      for a in (dup.astype(jnp.int32), target, chain_src))
-        return _pack_state(arena, edges, shadow, ivf, wide + outs)
+        return _pack_state(arena, edges, shadow, ivf, pq, wide + outs)
 
     def _local_plain(arena, edges, *rest):
         """The non-dedup program (``ingest_batch`` under a mesh): the
@@ -1408,7 +1456,7 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
         scatter, explicit merge touch, POST-add link scan per shard mode,
         explicit chain triples, gated compacted link insert — shard-local
         scans, one grouped all_gather, owner-chip writes."""
-        shadow, ivf, rest = _split_state(rest)
+        shadow, ivf, pq, rest = _split_state(rest)
         (rows, emb, salience, timestamp, type_id, shard_id_v, tenant_id_v,
          is_super, touch_rows, touch_sal, chain_slots, chain_src,
          chain_tgt, chain_w, link_pool, pool_len, now, tenant, link_gate,
@@ -1426,6 +1474,7 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
         arena = _arena_add(arena, rows_l, emb, salience, timestamp,
                            type_id, shard_id_v, tenant_id_v, is_super)
         shadow = _shadow_scatter(shadow, rows_l, qd)
+        pq = _pq_scatter(pq, rows_l, qd)
         touch_l = _localize(touch_rows, row_base, local_n)
         arena = _arena_merge_touch(arena, touch_l, touch_sal, now)
         # post-add link scan, batch rows excluded as candidates — the
@@ -1473,7 +1522,7 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
             ivf, a_rb, p_rb, tail = _ivf_sharded_update(
                 ivf, rows, qf, valid_q, assign, ivf_eta, shard, local_n)
             outs = outs + _ivf_outs(ivf, a_rb, p_rb, tail, b)
-        return _pack_state(arena, edges, shadow, ivf, outs)
+        return _pack_state(arena, edges, shadow, ivf, pq, outs)
 
     arena_specs = ArenaState(
         emb=P(axis, None), salience=P(axis), timestamp=P(axis),
@@ -1487,6 +1536,8 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
     # cent replicated, members stacked per shard, counts replicated
     ivf_specs = ((P(None, None), P(axis, None, None), P(None, None))
                  if with_ivf else ())
+    # codebook replicated (frozen), codes row-sharded with the master
+    pq_specs = ((P(None, None, None), P(axis, None)) if with_pq else ())
     if dedup:
         batch_specs = (
             P(None),        # rows
@@ -1511,14 +1562,16 @@ def make_ingest_fused_sharded(mesh, axis: str, *, k: int,
         )
         n_out = 3 * n_modes + 3 + (IVF_INGEST_TAIL if with_ivf else 0)
         fn = _local_plain
-    out_state = (arena_specs, edge_specs) + shadow_specs + ivf_specs
+    out_state = (arena_specs, edge_specs) + shadow_specs + ivf_specs \
+        + pq_specs
     mapped = shard_map(
         fn, mesh=mesh,
         in_specs=(arena_specs, edge_specs) + shadow_specs + ivf_specs
-        + batch_specs,
+        + pq_specs + batch_specs,
         out_specs=out_state + (tuple(P(None, None) for _ in range(n_out)),),
         check_vma=False)
-    donate = tuple(range(2 + len(shadow_specs) + len(ivf_specs)))
+    donate = tuple(range(2 + len(shadow_specs) + len(ivf_specs)
+                         + len(pq_specs)))
     return IngestShardedKernels(
         ingest=jax.jit(mapped, donate_argnums=donate),
         ingest_copy=jax.jit(mapped))
@@ -3058,6 +3111,549 @@ def search_fused_ivf_tiered_ragged_read(
 
 
 # ---------------------------------------------------------------------------
+# Fused IVF-PQ serving (ISSUE 16): the last serving mode leaves the classic
+# multi-dispatch path. The ADC table build (query × codebook sub-distances),
+# the m-byte PQ scan over the top-nprobe clusters' LIVE member tables (the
+# PR 12 donated tables — PQ finally sees online IVF), the exact f32
+# shortlist rescore from gathered master rows at the coarse_fetch_slack
+# window, and the super-gate/CSR-gather/boost-scatter tail all fuse into
+# ONE donated dispatch + ONE packed readback. Structurally this is the int8
+# branch of ``_ivf_two_tier`` with the coarse stage swapped: instead of a
+# d-byte int8 row the candidate costs m bytes (m·1-byte code gather + m LUT
+# adds), so the coarse tier reads ~d/m× less HBM per candidate — the
+# substrate for the billion-row full-corpus scan (ROADMAP item 5). The gate
+# verdict and every returned score come from the exact rescore, so ADC
+# error never leaks past the shortlist boundary.
+# ---------------------------------------------------------------------------
+
+
+def _pq_flat_lut(book_cent: jax.Array, qn: jax.Array) -> jax.Array:
+    """ADC lookup tables for a query chunk: each query's inner product
+    with every subspace centroid, flattened to ``[C, m·256]`` so a row's
+    score is an m-gather + sum over its byte codes (offset by subspace).
+    The build is tiny — m gemms of [C, dsub]×[dsub, 256] — and amortizes
+    over every candidate the chunk touches (same LUT layout as the
+    classic ``ops.pq.ivf_pq_search``, traced into the fused program)."""
+    m, _, dsub = book_cent.shape
+    lut = jnp.einsum("cmd,mkd->cmk", qn.reshape(qn.shape[0], m, dsub),
+                     book_cent, preferred_element_type=jnp.float32)
+    return lut.reshape(qn.shape[0], m * 256)
+
+
+def _pq_adc_scores(flat_lut: jax.Array, codes_g: jax.Array) -> jax.Array:
+    """Asymmetric-distance scores for per-query gathered codes: ``codes_g
+    [C, L, m]`` u8 → ``[C, L]`` f32 approximate inner products. One take
+    per (candidate, subspace) against the query's flat LUT."""
+    m = codes_g.shape[-1]
+    offs = (jnp.arange(m) * 256).astype(jnp.int32)
+    idx = codes_g.astype(jnp.int32) + offs[None, None, :]
+    return jax.vmap(
+        lambda fl, ix: jnp.take(fl, ix, axis=0).sum(-1))(flat_lut, idx)
+
+
+def _pq_two_tier(state: ArenaState, book_cent: jax.Array, codes: jax.Array,
+                 centroids: jax.Array, members: jax.Array,
+                 extras: jax.Array, q_c: jax.Array, tenant_c: jax.Array,
+                 k: int, nprobe: int, slack: int, nprobe_c=None):
+    """IVF-PQ two-tier core: coarse centroid prefilter + member gather
+    (``ops.ivf.gather_rows`` — identical candidate assembly to the IVF
+    kernels, extras included, so fresh/residual/super rows are always in
+    the window), ADC coarse scoring from the m-byte codes, exact f32
+    rescore of the k+slack shortlist from the master arena, duplicate-row
+    dedup at the top-k boundary. The incremental ``_pq_scatter`` keeps
+    every live row's codes current, so no candidate needs a staleness
+    escape hatch. Shard-local by construction when given per-shard tables
+    with LOCAL row indices (the codes slab row-shards with the master).
+    Returns the ``(gate_s, gate_r, ann_s, ann_r, n_dup)`` contract of
+    ``_ivf_two_tier``; ``nprobe_c`` raggedness is identical."""
+    from lazzaro_tpu.ops.ivf import gather_rows
+
+    cap = state.capacity
+    L = nprobe * members.shape[1] + extras.shape[0]
+    k_fetch = min(k + slack, L)
+    g_fetch = min(1 + slack, L)
+    qn = normalize(q_c)                               # [C, d] f32
+    cand, safe = gather_rows(centroids, members, extras, qn, nprobe)
+    valid = ((cand >= 0) & state.alive[safe]
+             & (state.tenant_id[safe] == tenant_c[:, None]))
+    if nprobe_c is not None:
+        m_w = members.shape[1]
+        pos = jnp.arange(L)
+        in_members = pos < nprobe * m_w
+        rank = pos // max(m_w, 1)
+        valid = valid & (~in_members[None, :]
+                         | (rank[None, :] < nprobe_c[:, None]))
+    sup = state.is_super[safe]
+    qd = qn.astype(state.emb.dtype)
+
+    # coarse tier: m bytes per candidate — the LUT gather, not a matmul
+    flat_lut = _pq_flat_lut(book_cent, qn)
+    coarse = _pq_adc_scores(flat_lut, codes[safe])    # [C, L]
+    a_s0, a_pos = jax.lax.top_k(
+        jnp.where(valid & ~sup, coarse, NEG_INF), k_fetch)
+    g_s0, g_pos = jax.lax.top_k(
+        jnp.where(valid & sup, coarse, NEG_INF), g_fetch)
+    a_s0, a_pos, g_s0, g_pos = jax.lax.optimization_barrier(
+        (a_s0, a_pos, g_s0, g_pos))
+
+    # exact rescore of the few survivors from the master — scores and the
+    # gate verdict never see ADC error (same contract as the int8 path)
+    def rescore(rows_c, coarse_s):
+        g = state.emb[rows_c]                         # [C, kf, d]
+        ex = jnp.einsum("cd,ckd->ck", qd, g,
+                        preferred_element_type=jnp.float32)
+        return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
+
+    a_rows0 = jnp.take_along_axis(cand, a_pos, axis=1)
+    a_rows_safe = jnp.where(a_s0 > NEG_INF / 2, a_rows0, cap)
+    ann_ex = rescore(a_rows_safe, a_s0)
+    g_rows0 = jnp.take_along_axis(cand, g_pos, axis=1)
+    g_rows_safe = jnp.where(g_s0 > NEG_INF / 2, g_rows0, cap)
+    gate_ex = rescore(g_rows_safe, g_s0)
+    g_s, g_sel = jax.lax.top_k(gate_ex, 1)
+    gate_s = g_s[:, 0]
+    gate_r0 = jnp.take_along_axis(g_rows_safe, g_sel, axis=1)[:, 0]
+    ann_s, ann_r, n_dup = _dedup_topk(ann_ex, a_rows_safe, cap, k)
+    gate_r = jnp.where(gate_s > NEG_INF / 2, gate_r0, cap)
+    return gate_s, gate_r, ann_s, ann_r, n_dup
+
+
+def _search_fused_pq_scan(state: ArenaState, book_cent: jax.Array,
+                          codes: jax.Array, centroids: jax.Array,
+                          members: jax.Array, extras: jax.Array,
+                          csr_indptr: jax.Array, csr_nbr: jax.Array,
+                          q: jax.Array, q_valid: jax.Array,
+                          tenant: jax.Array, gate_on: jax.Array,
+                          boost_on: jax.Array, super_gate: jax.Array,
+                          k: int, nprobe: int, slack: int, cap_take: int,
+                          max_nbr: int, k_q=None, cap_q=None,
+                          nprobe_q=None, scan_chunk: int = 0):
+    """PQ per-chunk compute phase: the ADC two-tier core, then the shared
+    gate/CSR/boost tail. Ragged sidecars behave exactly as in
+    ``_search_fused_ivf_scan``."""
+    ragged = k_q is not None
+
+    def body(q_c, valid_c, tenant_c, gate_c, boost_c, *rag):
+        nprobe_c = rag[2] if ragged else None
+        gate_s, gate_r, ann_s, ann_r, n_dup = _pq_two_tier(
+            state, book_cent, codes, centroids, members, extras, q_c,
+            tenant_c, k, nprobe, slack, nprobe_c=nprobe_c)
+        cap_c = None
+        if ragged:
+            k_c, cap_c = rag[0], rag[1]
+            ann_s, ann_r = _ragged_topk_mask(ann_s, ann_r, k_c,
+                                             state.capacity)
+        fast, acc_rows, nbr_rows = _gate_and_boost_rows(
+            state, csr_indptr, csr_nbr, gate_s, gate_r, ann_s, ann_r,
+            valid_c, tenant_c, gate_c, boost_c, super_gate, cap_take,
+            max_nbr, cap_c=cap_c)
+        return (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows,
+                n_dup)
+
+    arrays = (q, q_valid, tenant, gate_on, boost_on)
+    if ragged:
+        arrays = arrays + (k_q, cap_q, nprobe_q)
+    return chunked_map_multi(body, arrays,
+                             chunk=min(scan_chunk or IVF_SERVE_CHUNK,
+                                       IVF_SERVE_CHUNK))
+
+
+def _search_fused_pq(
+    state: ArenaState,
+    book_cent: jax.Array,    # [m, 256, dsub] f32 frozen PQ codebook
+    codes: jax.Array,        # [cap+1, m] u8 live codes (incrementally kept)
+    centroids: jax.Array,    # [C, d] f32 L2-normalized (ops/ivf.py build)
+    members: jax.Array,      # [C, M] i32 arena rows, -1 padded
+    extras: jax.Array,       # [E] i32 residual + fresh + super rows, -1 pad
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    nprobe: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """``search_fused_ivf`` with the m-byte ADC scan as the coarse stage:
+    ONE donated dispatch + ONE packed readback per coalesced batch in PQ
+    mode. Only the arena state is donated — the codebook, codes slab, and
+    coarse tables are long-lived read-only replicas (the boost scatter
+    touches salience/access/freshness, never embeddings or codes)."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
+        _search_fused_pq_scan(state, book_cent, codes, centroids, members,
+                              extras, csr_indptr, csr_nbr, q, q_valid,
+                              tenant, gate_on, boost_on, super_gate, k,
+                              nprobe, slack, cap_take, max_nbr)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+
+
+search_fused_pq, search_fused_pq_copy = _donated_pair(
+    _search_fused_pq, static_argnames=("k", "nprobe", "slack", "cap_take",
+                                       "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
+                                             "cap_take", "max_nbr"))
+def search_fused_pq_read(state: ArenaState, book_cent: jax.Array,
+                         codes: jax.Array, centroids: jax.Array,
+                         members: jax.Array, extras: jax.Array,
+                         csr_indptr: jax.Array, csr_nbr: jax.Array,
+                         q: jax.Array, q_valid: jax.Array,
+                         tenant: jax.Array, gate_on: jax.Array,
+                         super_gate: jax.Array, k: int, nprobe: int,
+                         slack: int, cap_take: int, max_nbr: int
+                         ) -> jax.Array:
+    """Read-only twin of ``search_fused_pq`` (pure ``search_memories``
+    fleets in PQ mode): same ADC scan + exact rescore, no state mutation,
+    no donation dance."""
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = _search_fused_pq_scan(
+        state, book_cent, codes, centroids, members, extras, csr_indptr,
+        csr_nbr, q, q_valid, tenant, gate_on, boost_off, super_gate, k,
+        nprobe, slack, cap_take, max_nbr)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+
+
+def _search_fused_pq_ragged(
+    state: ArenaState,
+    book_cent: jax.Array,
+    codes: jax.Array,
+    centroids: jax.Array,
+    members: jax.Array,
+    extras: jax.Array,
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    k_q: jax.Array,
+    cap_q: jax.Array,
+    nprobe_q: jax.Array,     # [Q] i32 per-query probe width (≤ nprobe)
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    nprobe: int,             # STATIC probe ceiling (the build's width)
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+    scan_chunk: int = 0,
+) -> Tuple[ArenaState, jax.Array]:
+    """``search_fused_pq`` with the (k, cap, nprobe) sidecar: the member
+    gather and ADC scan run to the ceilings, each query masks at its own
+    boundaries — one compiled PQ kernel for mixed-shape traffic."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
+        _search_fused_pq_scan(state, book_cent, codes, centroids, members,
+                              extras, csr_indptr, csr_nbr, q, q_valid,
+                              tenant, gate_on, boost_on, super_gate, k,
+                              nprobe, slack, cap_take, max_nbr, k_q=k_q,
+                              cap_q=cap_q, nprobe_q=nprobe_q,
+                              scan_chunk=scan_chunk)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+
+
+search_fused_pq_ragged, search_fused_pq_ragged_copy = _donated_pair(
+    _search_fused_pq_ragged,
+    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr",
+                     "scan_chunk"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
+                                             "cap_take", "max_nbr",
+                                             "scan_chunk"))
+def search_fused_pq_ragged_read(state: ArenaState, book_cent: jax.Array,
+                                codes: jax.Array, centroids: jax.Array,
+                                members: jax.Array, extras: jax.Array,
+                                csr_indptr: jax.Array, csr_nbr: jax.Array,
+                                q: jax.Array, q_valid: jax.Array,
+                                tenant: jax.Array, gate_on: jax.Array,
+                                k_q: jax.Array, nprobe_q: jax.Array,
+                                super_gate: jax.Array, k: int, nprobe: int,
+                                slack: int, cap_take: int, max_nbr: int,
+                                scan_chunk: int = 0) -> jax.Array:
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    cap_q = jnp.zeros(q_valid.shape, jnp.int32)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = _search_fused_pq_scan(
+        state, book_cent, codes, centroids, members, extras, csr_indptr,
+        csr_nbr, q, q_valid, tenant, gate_on, boost_off, super_gate, k,
+        nprobe, slack, cap_take, max_nbr, k_q=k_q, cap_q=cap_q,
+        nprobe_q=nprobe_q, scan_chunk=scan_chunk)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+
+
+# ---------------------------------------------------------------------------
+# PQ × tiering (ISSUE 16): lifts the last tiering incompatibility. Hot
+# candidates come from the IVF member gather with exact in-kernel rescore —
+# unchanged from the IVF×tiered kernel — and COLD rows come from the
+# full-corpus ADC scan restricted to the cold residency mask (a demoted
+# row's master embedding is zeroed, but its m-byte codes stay valid: the
+# incremental scatter only touches written rows, and the re-seed full
+# encode patches cold rows from the host ColdStore). The blended k+slack
+# window, the deferred boosts, and the packed readback are layout-identical
+# to the tiered kernels, so ``tier.serve.tiered_decode_and_finish`` —
+# including the bounded exact-rescore finish dispatch for cold survivors —
+# runs unchanged.
+# ---------------------------------------------------------------------------
+
+
+def _pq_tiered_two_tier(state: ArenaState, book_cent: jax.Array,
+                        codes: jax.Array, cold: jax.Array,
+                        centroids: jax.Array, members: jax.Array,
+                        extras: jax.Array, q_c: jax.Array,
+                        tenant_c: jax.Array, k: int, nprobe: int,
+                        slack: int, nprobe_c=None):
+    """Tier-aware PQ core: exact member gather for the hot tier, ADC
+    coarse over the COLD rows only (m bytes per cold row — the cheapest
+    full-corpus coverage any mode has), blended top-(k+slack) with row
+    dedup. Contract identical to ``_ivf_tiered_two_tier``."""
+    from lazzaro_tpu.ops.ivf import gather_rows
+
+    cap = state.capacity
+    n = state.emb.shape[0]
+    L = nprobe * members.shape[1] + extras.shape[0]
+    k_fetch = min(k + slack, L + n)
+    k_hot = min(k + slack, L)
+    k_cold = min(k + slack, n)
+    qn = normalize(q_c)                                   # [C, d] f32
+    qd = qn.astype(state.emb.dtype)
+    cand, safe = gather_rows(centroids, members, extras, qn, nprobe)
+    valid = ((cand >= 0) & state.alive[safe] & ~cold[safe]
+             & (state.tenant_id[safe] == tenant_c[:, None]))
+    if nprobe_c is not None:
+        m_w = members.shape[1]
+        pos = jnp.arange(L)
+        in_members = pos < nprobe * m_w
+        rank = pos // max(m_w, 1)
+        valid = valid & (~in_members[None, :]
+                         | (rank[None, :] < nprobe_c[:, None]))
+    sup = state.is_super[safe]
+    vecs = state.emb[safe]                                # [C, L, d]
+    sc = jnp.einsum("cd,cld->cl", qd, vecs,
+                    preferred_element_type=jnp.float32)
+    h_s, h_pos = jax.lax.top_k(jnp.where(valid & ~sup, sc, NEG_INF), k_hot)
+    g_s0, g_pos = jax.lax.top_k(jnp.where(valid & sup, sc, NEG_INF), 1)
+    # cold tier: ADC coarse over the residency-masked full-corpus codes
+    flat_lut = _pq_flat_lut(book_cent, qn)
+    m = book_cent.shape[0]
+    offs = (jnp.arange(m) * 256).astype(jnp.int32)
+    idx_full = codes.astype(jnp.int32) + offs[None, :]    # [rows, m]
+    coarse = jax.vmap(
+        lambda fl: jnp.take(fl, idx_full, axis=0).sum(-1))(flat_lut)
+    cold_m = (cold[None, :] & state.alive[None, :]
+              & ~state.is_super[None, :]
+              & (state.tenant_id[None, :] == tenant_c[:, None]))
+    c_s, c_r = jax.lax.top_k(jnp.where(cold_m, coarse, NEG_INF), k_cold)
+    h_s, h_pos, g_s0, g_pos, c_s, c_r = jax.lax.optimization_barrier(
+        (h_s, h_pos, g_s0, g_pos, c_s, c_r))
+    h_rows = jnp.take_along_axis(cand, h_pos, axis=1)
+    # blended window: hot exact ++ cold coarse, one more top-k + dedup
+    all_s = jnp.concatenate([h_s, c_s], axis=1)
+    all_r = jnp.concatenate([h_rows, c_r], axis=1)
+    ann_s, ann_r, n_dup = _dedup_topk(all_s, all_r, cap, k_fetch)
+    is_cold = cold[jnp.minimum(ann_r, n - 1)] & (ann_s > NEG_INF / 2)
+    cold_any = is_cold.any(axis=-1)
+    gate_s = g_s0[:, 0]
+    gate_r0 = jnp.take_along_axis(cand, g_pos, axis=1)[:, 0]
+    gate_r = jnp.where(gate_s > NEG_INF / 2, gate_r0, cap)
+    return gate_s, gate_r, ann_s, ann_r, n_dup, cold_any
+
+
+def _search_fused_pq_tiered_scan(state: ArenaState, book_cent: jax.Array,
+                                 codes: jax.Array, cold: jax.Array,
+                                 centroids: jax.Array, members: jax.Array,
+                                 extras: jax.Array, csr_indptr: jax.Array,
+                                 csr_nbr: jax.Array, q: jax.Array,
+                                 q_valid: jax.Array, tenant: jax.Array,
+                                 gate_on: jax.Array, boost_on: jax.Array,
+                                 super_gate: jax.Array, k: int,
+                                 nprobe: int, slack: int, cap_take: int,
+                                 max_nbr: int, k_q=None, cap_q=None,
+                                 nprobe_q=None, scan_chunk: int = 0):
+    """PQ×tiered per-chunk compute: the tier-aware PQ core, then the
+    shared gate/CSR/boost tail with cold-hit queries' boosts deferred to
+    the bounded finish dispatch — the tiered scan's contract."""
+    ragged = k_q is not None
+
+    def chunk(q_c, valid_c, tenant_c, gate_c, boost_c, *rag):
+        np_c = rag[2] if ragged else None
+        g_s, g_r, ann_s, ann_r, n_dup, cold_any = _pq_tiered_two_tier(
+            state, book_cent, codes, cold, centroids, members, extras,
+            q_c, tenant_c, k, nprobe, slack, nprobe_c=np_c)
+        cap_c = None
+        if ragged:
+            k_c, cap_c = rag[0], rag[1]
+            kf = jnp.minimum(k_c + slack, ann_s.shape[1])
+            ann_s, ann_r = _ragged_topk_mask(ann_s, ann_r, kf,
+                                             state.capacity)
+        fast, acc_rows, nbr_rows = _gate_and_boost_rows(
+            state, csr_indptr, csr_nbr, g_s, g_r, ann_s, ann_r,
+            valid_c, tenant_c, gate_c, boost_c & ~cold_any, super_gate,
+            cap_take, max_nbr, cap_c=cap_c)
+        return g_s, g_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup
+
+    arrays = (q, q_valid, tenant, gate_on, boost_on)
+    if ragged:
+        arrays = arrays + (k_q, cap_q, nprobe_q)
+    return chunked_map_multi(chunk, arrays,
+                             chunk=(scan_chunk or IVF_SERVE_CHUNK))
+
+
+def _search_fused_pq_tiered(
+    state: ArenaState,
+    book_cent: jax.Array,
+    codes: jax.Array,
+    cold: jax.Array,
+    centroids: jax.Array,
+    members: jax.Array,
+    extras: jax.Array,
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    nprobe: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+) -> Tuple[ArenaState, jax.Array]:
+    """ONE donated dispatch + ONE packed readback: IVF member gather for
+    the hot tier, cold-masked ADC coarse for the demoted rows, tiered
+    candidate window (k+slack wide) for the bounded finish."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
+        _search_fused_pq_tiered_scan(
+            state, book_cent, codes, cold, centroids, members, extras,
+            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
+            super_gate, k, nprobe, slack, cap_take, max_nbr)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+
+
+search_fused_pq_tiered, search_fused_pq_tiered_copy = _donated_pair(
+    _search_fused_pq_tiered,
+    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
+                                             "cap_take", "max_nbr"))
+def search_fused_pq_tiered_read(state: ArenaState, book_cent: jax.Array,
+                                codes: jax.Array, cold: jax.Array,
+                                centroids: jax.Array, members: jax.Array,
+                                extras: jax.Array, csr_indptr: jax.Array,
+                                csr_nbr: jax.Array, q: jax.Array,
+                                q_valid: jax.Array, tenant: jax.Array,
+                                gate_on: jax.Array, super_gate: jax.Array,
+                                k: int, nprobe: int, slack: int,
+                                cap_take: int, max_nbr: int) -> jax.Array:
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = \
+        _search_fused_pq_tiered_scan(
+            state, book_cent, codes, cold, centroids, members, extras,
+            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+            super_gate, k, nprobe, slack, cap_take, max_nbr)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+
+
+def _search_fused_pq_tiered_ragged(
+    state: ArenaState,
+    book_cent: jax.Array,
+    codes: jax.Array,
+    cold: jax.Array,
+    centroids: jax.Array,
+    members: jax.Array,
+    extras: jax.Array,
+    csr_indptr: jax.Array,
+    csr_nbr: jax.Array,
+    q: jax.Array,
+    q_valid: jax.Array,
+    tenant: jax.Array,
+    gate_on: jax.Array,
+    boost_on: jax.Array,
+    k_q: jax.Array,
+    cap_q: jax.Array,
+    nprobe_q: jax.Array,
+    now: jax.Array,
+    super_gate: jax.Array,
+    acc_boost: jax.Array,
+    nbr_boost: jax.Array,
+    k: int,
+    nprobe: int,
+    slack: int,
+    cap_take: int,
+    max_nbr: int,
+    scan_chunk: int = 0,
+) -> Tuple[ArenaState, jax.Array]:
+    """PQ×tiered serving with the (k, cap, nprobe) sidecar."""
+    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
+        _search_fused_pq_tiered_scan(
+            state, book_cent, codes, cold, centroids, members, extras,
+            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
+            super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
+            cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk)
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+
+
+search_fused_pq_tiered_ragged, search_fused_pq_tiered_ragged_copy = \
+    _donated_pair(_search_fused_pq_tiered_ragged,
+                  static_argnames=("k", "nprobe", "slack", "cap_take",
+                                   "max_nbr", "scan_chunk"))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
+                                             "cap_take", "max_nbr",
+                                             "scan_chunk"))
+def search_fused_pq_tiered_ragged_read(
+        state: ArenaState, book_cent: jax.Array, codes: jax.Array,
+        cold: jax.Array, centroids: jax.Array, members: jax.Array,
+        extras: jax.Array, csr_indptr: jax.Array, csr_nbr: jax.Array,
+        q: jax.Array, q_valid: jax.Array, tenant: jax.Array,
+        gate_on: jax.Array, k_q: jax.Array, nprobe_q: jax.Array,
+        super_gate: jax.Array, k: int, nprobe: int, slack: int,
+        cap_take: int, max_nbr: int, scan_chunk: int = 0) -> jax.Array:
+    boost_off = jnp.zeros(q_valid.shape, bool)
+    cap_q = jnp.zeros(q_valid.shape, jnp.int32)
+    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = \
+        _search_fused_pq_tiered_scan(
+            state, book_cent, codes, cold, centroids, members, extras,
+            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+            super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
+            cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk)
+    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+
+
+# ---------------------------------------------------------------------------
 # Pod-scale fused serving (ISSUE 5): the SAME chat-turn program — two-tier
 # scan, super gate, CSR neighbor gather, boost scatters — composed with the
 # device mesh as ONE distributed shard_map dispatch + ONE packed readback.
@@ -3123,6 +3719,12 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     - ``"ivf_quant"`` — IVF prefilter + int8-gathered coarse + exact
                         rescore; tables ``(q8, scale, centroids, members,
                         extras)``
+    - ``"pq"``        — IVF prefilter + m-byte ADC coarse + exact rescore
+                        (``_pq_two_tier``, ISSUE 16); tables ``(book_cent
+                        [m,256,dsub] replicated, codes [rows,m] row-
+                        sharded with the master, centroids, members,
+                        extras)`` — the ADC LUT build is replicated
+                        arithmetic, candidates ride the existing merge
 
     Call signatures (tables is the mode's tuple above, ``()`` for exact):
 
@@ -3156,12 +3758,13 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     from lazzaro_tpu.ops.topk import sharded_topk_merge
     from lazzaro_tpu.utils.compat import shard_map
 
-    if mode not in ("exact", "quant", "ivf", "ivf_quant", "tiered"):
+    if mode not in ("exact", "quant", "ivf", "ivf_quant", "tiered", "pq"):
         raise ValueError(f"unknown fused-sharded mode {mode!r}")
     if cap_take > k:
         raise ValueError("cap_take must not exceed k")
     n_shards = mesh.shape[axis]
-    chunk = IVF_SERVE_CHUNK if mode.startswith("ivf") else QUERY_CHUNK
+    chunk = (IVF_SERVE_CHUNK if mode.startswith("ivf") or mode == "pq"
+             else QUERY_CHUNK)
     # Tiered mode (ISSUE 8): the merged candidate block stays k+slack wide
     # so the host can finish cold-hit queries (exact rescore of host-
     # gathered rows + final re-rank) over the same window.
@@ -3188,6 +3791,9 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
         elif mode == "ivf_quant":
             q8_l, scale_l, cent, mem2, ext2 = tables
             mem_l, ext_l, shadow_l = mem2[0], ext2[0], (q8_l, scale_l)
+        elif mode == "pq":
+            book_l, codes_l, cent, mem2, ext2 = tables
+            mem_l, ext_l = mem2[0], ext2[0]
 
         def core(q_c, tenant_c, *rag):
             nprobe_c = rag[0] if rag else None
@@ -3206,13 +3812,19 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
                     arena, q8_l, scale_l, cold_l, q_c, tenant_c, k_l,
                     slack)
                 return g_s, g_r, a_s, a_r, zeros, cold_c
+            if mode == "pq":
+                g_s, g_r, a_s, a_r, n_dup = _pq_two_tier(
+                    arena, book_l, codes_l, cent, mem_l, ext_l, q_c,
+                    tenant_c, k_l, nprobe, slack, nprobe_c=nprobe_c)
+                return g_s[:, None], g_r[:, None], a_s, a_r, n_dup, off
             g_s, g_r, a_s, a_r, n_dup = _ivf_two_tier(
                 arena, shadow_l, cent, mem_l, ext_l, q_c, tenant_c, k_l,
                 nprobe, slack, nprobe_c=nprobe_c)
             return g_s[:, None], g_r[:, None], a_s, a_r, n_dup, off
 
         arrays = (q, tenant)
-        if nprobe_q is not None and mode.startswith("ivf"):
+        if nprobe_q is not None and (mode.startswith("ivf")
+                                     or mode == "pq"):
             arrays = arrays + (nprobe_q,)
         g_s, g_r, a_s, a_r, dup_l, cold_l_q = chunked_map_multi(
             core, arrays, chunk=chunk)
@@ -3344,6 +3956,8 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
         "ivf": (P(None, None), P(axis, None, None), P(axis, None)),
         "ivf_quant": (P(axis, None), P(axis), P(None, None),
                       P(axis, None, None), P(axis, None)),
+        "pq": (P(None, None, None), P(axis, None), P(None, None),
+               P(axis, None, None), P(axis, None)),
     }[mode]
     common = (state_specs, tables_specs, P(axis, None), P(axis, None),
               P(None, None), P(None), P(None), P(None))

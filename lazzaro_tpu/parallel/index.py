@@ -80,6 +80,17 @@ def _shadow_update(q8, scale, rows, emb_stored):
     return q8.at[rows].set(q_new), scale.at[rows].set(s_new)
 
 
+@jax.jit
+def _pq_codes_update(book_cent, codes, rows, emb_stored):
+    """Incremental PQ-code maintenance for freshly written rows (ISSUE
+    16) — the non-fused-write twin of the in-kernel ``_pq_scatter``:
+    encode the stored vectors against the frozen codebook and patch the
+    batch's rows in place."""
+    from lazzaro_tpu.ops.pq import encode_pq
+
+    return codes.at[rows].set(encode_pq(book_cent, emb_stored))
+
+
 class ShardedMemoryIndex:
     # References to the arena pytree at the donation gate when this index
     # is the sole owner: the ``_arena`` attribute, the ``cur`` local, and
@@ -90,6 +101,7 @@ class ShardedMemoryIndex:
                  axis: str = "data", dtype=jnp.bfloat16,
                  tenant_affinity: bool = True, k: int = 10,
                  serve_fused: bool = True, int8_serving: bool = False,
+                 pq_serving: bool = False,
                  coarse_slack: int = 8, cap_take: int = 5,
                  max_nbr: int = 32, super_gate: float = 0.4,
                  acc_boost: float = 0.05, nbr_boost: float = 0.02,
@@ -144,6 +156,7 @@ class ShardedMemoryIndex:
 
         self.serve_fused = bool(serve_fused)
         self.int8_serving = bool(int8_serving)
+        self.pq_serving = bool(pq_serving)
         self.coarse_slack = max(0, int(coarse_slack))
         self.cap_take = int(cap_take)
         self.max_nbr = int(max_nbr)
@@ -185,6 +198,13 @@ class ShardedMemoryIndex:
         # maintained incrementally by add()'s scatter once built)
         self._int8_shadow = None
         self._int8_dirty = True
+
+        # PQ serving pack (ISSUE 16): ``(book_cent [m,256,dsub] replicated,
+        # codes [rows,m] u8 row-sharded with the master)``. Published
+        # COMPLETE by ivf_build, then maintained incrementally — the fused
+        # ingest's in-kernel ``_pq_scatter`` and add()'s host patch — so
+        # the pack never carries a dirty flag.
+        self._pq_pack = None
 
         # Pod-scale fused ingest (ISSUE 9): a row-sharded edge arena is
         # the write target of the distributed ingest program — the fused
@@ -425,14 +445,16 @@ class ShardedMemoryIndex:
 
     # --------------------------------------------------- fused pod ingest
     def _ingest_kernels(self, k: int, shard_modes: Tuple[int, ...],
-                        with_shadow: bool, with_ivf: bool = False
+                        with_shadow: bool, with_ivf: bool = False,
+                        with_pq: bool = False
                         ) -> S.IngestShardedKernels:
-        key = (k, shard_modes, with_shadow, with_ivf)
+        key = (k, shard_modes, with_shadow, with_ivf, with_pq)
         kern = self._ingest_cache.get(key)
         if kern is None:
             kern = S.make_ingest_fused_sharded(
                 self.mesh, self.axis, k=k, shard_modes=shard_modes,
-                with_shadow=with_shadow, with_ivf=with_ivf)
+                with_shadow=with_shadow, with_ivf=with_ivf,
+                with_pq=with_pq)
             self._ingest_cache.put(key, kern)
             self.telemetry.gauge("kernel.cache_entries",
                                  len(self._ingest_cache),
@@ -522,8 +544,10 @@ class ShardedMemoryIndex:
                 and self._int8_shadow is not None
                 and self._int8_shadow[0].shape[0] == self.capacity + 1)
             with_ivf = self.ivf_online and self._ivf_dev is not None
+            with_pq = (self._pq_pack is not None
+                       and self._pq_pack[1].shape[0] == self.capacity + 1)
         kern = self._ingest_kernels(k_eff, shard_modes, with_shadow,
-                                    with_ivf)
+                                    with_ivf, with_pq)
         dev_args = (
             jnp.asarray(padded), jnp.asarray(emb_p),
             jnp.asarray(pad(np.asarray(saliences, np.float32))),
@@ -538,7 +562,7 @@ class ShardedMemoryIndex:
             jnp.float32(chain_weight), jnp.float32(link_gate),
             jnp.float32(link_scale), jnp.float32(self.ivf_online_eta))
         self._maybe_record_ingest_hbm(kern, dev_args, with_shadow, b,
-                                      with_ivf=with_ivf)
+                                      with_ivf=with_ivf, with_pq=with_pq)
         tel = self.telemetry
         t0 = time.perf_counter()
         with trace_annotation("lz.ingest.pod_fused"):
@@ -546,6 +570,7 @@ class ShardedMemoryIndex:
                 arena, edges = self._arena, self._edge_state
                 shadow = self._int8_shadow if with_shadow else None
                 ivf = self._ivf_dev if with_ivf else None
+                pq = self._pq_pack if with_pq else None
                 sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                         and sys.getrefcount(edges) <= self._SOLE_REFS
                         and (shadow is None
@@ -554,15 +579,19 @@ class ShardedMemoryIndex:
                         and (ivf is None
                              or (sys.getrefcount(ivf[0]) <= 2
                                  and sys.getrefcount(ivf[1]) <= 2
-                                 and sys.getrefcount(ivf[2]) <= 2)))
+                                 and sys.getrefcount(ivf[2]) <= 2))
+                        and (pq is None
+                             or (sys.getrefcount(pq[0]) <= 2
+                                 and sys.getrefcount(pq[1]) <= 2)))
                 state_args = ((arena, edges)
                               + (shadow if shadow is not None else ())
-                              + (ivf if ivf is not None else ()))
+                              + (ivf if ivf is not None else ())
+                              + (pq if pq is not None else ()))
                 got = self._guarded(
                     lambda fn: self._ingest_dispatch(fn, *state_args,
                                                      *dev_args),
                     kern.ingest, kern.ingest_copy, sole,
-                    (arena, edges, shadow, ivf), "pod_ingest")
+                    (arena, edges, shadow, ivf, pq), "pod_ingest")
                 new_arena, new_edges, got = got[0], got[1], got[2:]
                 if shadow is not None:
                     self._int8_shadow = (got[0], got[1])
@@ -570,8 +599,11 @@ class ShardedMemoryIndex:
                 if ivf is not None:
                     self._ivf_dev = (got[0], got[1], got[2])
                     got = got[3:]
+                if pq is not None:
+                    self._pq_pack = (got[0], got[1])
+                    got = got[2:]
                 flat = got[0]
-                del arena, edges, shadow, ivf
+                del arena, edges, shadow, ivf, pq
                 self._arena = new_arena
                 self._edge_state = new_edges
             host = fetch_packed(*flat)          # the ONE readback
@@ -878,7 +910,8 @@ class ShardedMemoryIndex:
             mesh_parts=self.n_parts, edge_cap=self.edge_capacity,
             link_k=max(1, int(link_k)),
             ivf=1 if (self.ivf_online and self._ivf_dev is not None)
-            else 0)
+            else 0,
+            pq=1 if self._pq_pack is not None else 0)
 
     def plan_ingest(self, n: int, link_k: int = 3):
         """Pod twin of ``MemoryIndex.plan_ingest`` (ISSUE 11): admission
@@ -888,14 +921,15 @@ class ShardedMemoryIndex:
             self._ingest_geometry(n, link_k), chunkable=False)
 
     def _maybe_record_ingest_hbm(self, kern, dev_args, with_shadow: bool,
-                                 b: int, with_ivf: bool = False) -> None:
+                                 b: int, with_ivf: bool = False,
+                                 with_pq: bool = False) -> None:
         """Opt-in peak-HBM gauge for one pod ingest-kernel geometry
         (AOT lower + ``memory_analysis()`` of the non-donating twin; one
         extra compile, zero extra dispatches) — feeds the
         ``scripts/check_hbm_budget.py`` write-path gate."""
         if not self.telemetry_hbm or not self.telemetry.enabled:
             return    # never consume the once-key while warmup mutes the registry
-        key = ("ingest", b, with_shadow, with_ivf)
+        key = ("ingest", b, with_shadow, with_ivf, with_pq)
         if key in self._hbm_recorded:
             return
         self._hbm_recorded.add(key)
@@ -903,9 +937,11 @@ class ShardedMemoryIndex:
             with self._state_lock:
                 sh = self._int8_shadow if with_shadow else None
                 ivf = self._ivf_dev if with_ivf else None
+                pq = self._pq_pack if with_pq else None
                 args = ((self._arena, self._edge_state)
                         + ((sh[0], sh[1]) if sh is not None else ())
                         + (ivf if ivf is not None else ())
+                        + (pq if pq is not None else ())
                         + dev_args)
             peak = peak_bytes(
                 kern.ingest_copy.lower(*args).compile().memory_analysis())
@@ -917,6 +953,8 @@ class ShardedMemoryIndex:
                       "mesh": f"{self.n_parts}x{self.axis}"}
             if with_ivf:
                 labels["ivf"] = "true"
+            if with_pq:
+                labels["pq"] = "true"
             self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
                                  labels=labels)
             self.planner.observe_gauge(self._ingest_geometry(b), peak)
@@ -1056,13 +1094,27 @@ class ShardedMemoryIndex:
                                  jax.device_put(scale, self._row_sh))
         else:
             self._int8_dirty = True
+        # PQ codes: patched in place against the frozen codebook — the
+        # pack stays COMPLETE through every write path (ISSUE 16).
+        pack = self._pq_pack
+        if pack is not None and pack[1].shape[0] == self.capacity + 1:
+            stored = S.normalize(emb_dev).astype(self.dtype)
+            codes = _pq_codes_update(pack[0], pack[1],
+                                     jnp.asarray(padded), stored)
+            self._pq_pack = (pack[0], jax.device_put(codes, self._mat_sh))
         # IVF freshness: unrouted rows serve exactly from the extras until
-        # the next ivf_build folds them into clusters.
+        # the next ivf_build folds them into clusters. Spills through this
+        # non-fused write surface are counted (ISSUE 16 satellite) so the
+        # exact-scan extras burden stays measurable.
         if self._ivf is not None:
             routed = self._ivf_routed
+            spilled = 0
             for r in rows:
                 if not routed[r] and r not in self._ivf_fresh:
                     self._ivf_fresh.append(r)
+                    spilled += 1
+            if spilled:
+                self.telemetry.bump("ivf.add_extras_spills", spilled)
             self._ivf_tabs_cache = None
         self._emb_gen += 1
         if self.tiering is not None:       # a re-added cold row is hot again
@@ -1222,7 +1274,44 @@ class ShardedMemoryIndex:
             self._ivf_fresh = []
             self._ivf_tabs_cache = None
             self._publish_online_tables(members)
+            self._publish_pq(st, mask)
         return True
+
+    def _publish_pq(self, st: S.ArenaState, mask_np: np.ndarray) -> None:
+        """Train + publish the COMPLETE PQ pack for the pod path (ISSUE
+        16): codebook replicated, the full-slab encode row-sharded with
+        the master. After this one build the pack is maintained
+        incrementally (fused ingest's ``_pq_scatter``, add()'s host
+        patch) — there is no dirty flag to clear. Caller holds
+        ``_state_lock``."""
+        if not self.pq_serving:
+            self._pq_pack = None
+            return
+        from lazzaro_tpu.ops.pq import encode_pq, train_pq
+
+        book = train_pq(st.emb, mask_np)
+        codes = encode_pq(book.centroids, st.emb)
+        self._pq_pack = (
+            jax.device_put(book.centroids, self._rep),
+            jax.device_put(codes, self._mat_sh))
+        self.telemetry.bump("pq.publishes", labels={"surface": "pod"})
+
+    def _pq_tables(self, k_bucket: int):
+        """(book_cent, codes_sh, centroids, members_sh, extras_sh, nprobe)
+        device tables for the fused ``mode="pq"`` pod program, or None to
+        fall through to the IVF/dense routing (no pack, no coarse build,
+        or a stale-capacity slab after growth)."""
+        if not self.pq_serving:
+            return None
+        with self._state_lock:
+            pack = self._pq_pack
+        if pack is None or pack[1].shape[0] != self.capacity + 1:
+            return None
+        ivf_tabs = self._ivf_tables(k_bucket)
+        if ivf_tabs is None:
+            return None
+        cent, mem_sh, ext_sh, nprobe = ivf_tabs
+        return pack[0], pack[1], cent, mem_sh, ext_sh, nprobe
 
     def _publish_online_tables(self, members: np.ndarray) -> None:
         """Seed the LIVE pod coarse tables from a build (ISSUE 12): the
@@ -1323,6 +1412,8 @@ class ShardedMemoryIndex:
         if tm is not None and tm.cold_count > 0:
             return "sharded_tiered", k_bucket
         if self._ivf is not None and self.serve_fused:
+            if self.pq_serving and self._pq_pack is not None:
+                return "sharded_pq", k_bucket
             return "sharded_ivf", k_bucket
         if self.int8_serving:
             return "sharded_quant", k_bucket
@@ -1495,7 +1586,9 @@ class ShardedMemoryIndex:
 
         tm = self.tiering
         tiered = tm is not None and tm.cold_count > 0
-        ivf_tabs = None if tiered else self._ivf_tables(k_bucket)
+        pq_tabs = None if tiered else self._pq_tables(k_bucket)
+        ivf_tabs = (None if tiered or pq_tabs is not None
+                    else self._ivf_tables(k_bucket))
         use_quant = self.int8_serving
         if tiered:
             # full-corpus int8 coarse scan + tier-aware rescore: the only
@@ -1504,6 +1597,13 @@ class ShardedMemoryIndex:
             mode = "tiered"
             ivf_tabs = None
             tables = (*self._int8_shadow_for(), tm.cold_mask_dev())
+        elif pq_tabs is not None:
+            # m-byte ADC coarse over the shared IVF candidate assembly +
+            # exact rescore — the smallest-resident pod mode (ISSUE 16)
+            book_cent, codes_sh, cent, mem_sh, ext_sh, nprobe = pq_tabs
+            mode = "pq"
+            ivf_tabs = pq_tabs       # nprobe sidecar routing below
+            tables = (book_cent, codes_sh, cent, mem_sh, ext_sh)
         elif ivf_tabs is not None:
             cent, mem_sh, ext_sh, nprobe = ivf_tabs
             mode = "ivf_quant" if use_quant else "ivf"
@@ -1622,12 +1722,14 @@ class ShardedMemoryIndex:
         except Exception:   # noqa: BLE001 — never fail the serve
             return
         if peak is not None:
-            self.telemetry.gauge(
-                "kernel.peak_hbm_bytes", peak,
-                labels={"mode": f"pod_{mode}", "k": str(k_bucket),
-                        "rows": str(self.capacity + 1),
-                        "batch": str(int(args[3].shape[0])),
-                        "mesh": f"{self.n_parts}x{self.axis}"})
+            labels = {"mode": f"pod_{mode}", "k": str(k_bucket),
+                      "rows": str(self.capacity + 1),
+                      "batch": str(int(args[3].shape[0])),
+                      "mesh": f"{self.n_parts}x{self.axis}"}
+            if mode == "pq":
+                labels["pq"] = "true"
+            self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
+                                 labels=labels)
             self.planner.observe_gauge(
                 Geometry(kind="serve", mode=f"pod_{mode}",
                          batch=int(args[3].shape[0]),

@@ -67,7 +67,7 @@ class Geometry:
     (``QUERY_CHUNK``, or ``IVF_SERVE_CHUNK`` for the IVF gather)."""
 
     kind: str = "serve"          # "serve" | "ingest"
-    mode: str = "exact"          # exact | quant | ivf | tiered
+    mode: str = "exact"          # exact | quant | ivf | pq | tiered
     batch: int = 8
     rows: int = 1024
     dim: int = 768
@@ -85,6 +85,16 @@ class Geometry:
     ivf: int = 0
     # Member-table capacity factor (slots ≈ factor · rows total).
     ivf_cap_factor: int = 4
+    # PQ code maintenance rides the ingest dispatch (ISSUE 16): 1 adds
+    # the u8 code slab + codebook to the resident set and the batch
+    # encode tile to the transient (serve-side PQ geometry is carried by
+    # mode="pq").
+    pq: int = 0
+    # Exact-rescore over-fetch depth (``coarse_fetch_slack``): the PQ
+    # serve kernel gathers and f32-rescores ``k + slack`` shortlist rows
+    # per query, so the transient term is LINEAR in it — a per-family
+    # multiplier cannot absorb a knob the operator can turn.
+    slack: int = 8
 
     def with_(self, **kw) -> "Geometry":
         d = asdict(self)
@@ -97,6 +107,8 @@ def _mode_family(mode: str) -> str:
     calibration multiplier is per family, the rows-per-chip term already
     carries the mesh geometry."""
     m = mode.replace("sharded_", "").replace("pod_", "")
+    if m.startswith("pq"):
+        return "pq"
     if m.startswith("ivf"):
         return "ivf"
     return m if m in ("exact", "quant", "tiered", "ingest") else "exact"
@@ -134,11 +146,28 @@ class CostModel:
                 total += rows_pc * (g.dim + 4)
         if fam == "tiered":
             total += rows_pc            # residency mask (bool→byte)
+        if fam == "pq":
+            # u8 code slab (m ≈ dim/8 bytes per row — the smallest
+            # resident coarse representation any mode carries), the
+            # replicated codebook (256·dim f32 regardless of m), the
+            # coarse routing tables, and the residency byte pq_tiered
+            # adds (carried unconditionally: one byte/row of slack)
+            m_sub = max(1, g.dim // 8)
+            n_cent = max(1, int(math.sqrt(g.rows)))
+            total += rows_pc * m_sub
+            total += 256 * g.dim * 4
+            total += n_cent * g.dim * 4 + rows_pc * 8
+            total += rows_pc
         if fam == "ivf":
             # centroids (replicated) + member/extras tables ~ one int32
             # routing entry per row plus the centroid block
             n_cent = max(1, int(math.sqrt(g.rows)))
             total += n_cent * g.dim * 4 + rows_pc * 8
+        if g.kind == "ingest" and g.pq:
+            # PQ pack donated through the ingest dispatch (ISSUE 16):
+            # the u8 code slab (row-sharded with the master) + the
+            # replicated codebook.
+            total += rows_pc * max(1, g.dim // 8) + 256 * g.dim * 4
         if g.kind == "ingest" and g.ivf:
             # Online-IVF state donated through the ingest dispatch
             # (ISSUE 12): centroid block (f32, replicated), member table
@@ -158,10 +187,26 @@ class CostModel:
         splitting and scan chunking shrink."""
         rows_pc = -(-g.rows // max(1, g.mesh_parts))
         fam = _mode_family(g.mode)
-        default_chunk = IVF_SERVE_CHUNK if fam == "ivf" else QUERY_CHUNK
+        default_chunk = (IVF_SERVE_CHUNK if fam in ("ivf", "pq")
+                         else QUERY_CHUNK)
         chunk = min(g.batch, g.scan_chunk or default_chunk)
         chunk = max(1, chunk)
-        if fam == "ivf":
+        if fam == "pq":
+            # ADC member scan: the per-chunk flat LUT [chunk, m·256] f32,
+            # the gathered candidate codes [chunk, cands, m] u8 + their
+            # coarse scores, and the exact-rescore gather of the
+            # k+slack shortlist from the master
+            n_cent = max(1, int(math.sqrt(g.rows)))
+            m = -(-g.rows // n_cent)
+            m_sub = max(1, g.dim // 8)
+            cands = max(1, g.nprobe or 4) * m + g.k
+            tile = chunk * m_sub * 256 * 4
+            tile += chunk * cands * (m_sub + 8)
+            # shortlist gather + the sorted copy XLA keeps beside it —
+            # k + slack rows deep (the coarse_fetch_slack knob), f32
+            tile += chunk * (g.k + max(8, g.slack) + 16) \
+                * (g.dim + 2) * 4 * 2
+        elif fam == "ivf":
             # the gather footprint: [chunk, nprobe·M + extras, d] f32
             # candidate block; M ≈ rows/√rows member slots per cluster
             n_cent = max(1, int(math.sqrt(g.rows)))
@@ -181,6 +226,10 @@ class CostModel:
                 tile += g.batch * n_cent * 4
                 tile += 3 * n_cent * g.dim * 4
                 tile += g.batch * g.batch * 4
+            if g.pq:
+                # the in-dispatch batch encode (ISSUE 16): [batch, m,
+                # 256] sub-distance tile against the frozen codebook
+                tile += g.batch * max(1, g.dim // 8) * 256 * 4
         else:
             # dense scan: [chunk, rows] f32 scores + the two mask tiles
             # and the top-k workspace XLA materializes beside them
@@ -199,7 +248,8 @@ class CostModel:
     @staticmethod
     def _res_key(g: Geometry) -> str:
         return (f"{g.kind}:{g.mode}:b{g.batch}:r{g.rows}:k{g.k}"
-                f":m{g.mesh_parts}" + (":ivf" if g.ivf else ""))
+                f":m{g.mesh_parts}" + (":ivf" if g.ivf else "")
+                + (":pq" if g.pq else ""))
 
     def observe(self, g: Geometry, measured_bytes: float) -> bool:
         """Fold one measured AOT ``memory_analysis()`` peak back in.

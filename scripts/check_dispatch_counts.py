@@ -81,6 +81,15 @@ the paths passed as arguments) and exits nonzero if:
     the hot-only probe's ``dispatches_per_turn`` stays pinned to 1 by
     the generic dispatch gate). Earlier artifacts never carry the flag,
     so they are grandfathered by construction,
+  - (ISSUE 16) a FUSED-PQ artifact (any dict with ``"pq_fused": true``)
+    does not record a measured ``dispatches_per_turn`` (gated == 1 by
+    the generic rule — the m-byte ADC member scan, exact rescore, and
+    the gate/CSR/boost tail must stay ONE dispatch), lacks a
+    ``recall_at_10``/``recall_floor`` pair vs the classic
+    ``ivf_pq_search`` path on the same fixture, or does not record
+    ``bytes_per_row`` (the resident-footprint headline — PQ's whole
+    reason to exist — must stay measured, and below the int8 shadow's
+    when both are present as ``bytes_per_row``/``int8_bytes_per_row``),
 
 so any of these regressions turns red in CI instead of shipping.
 
@@ -116,7 +125,7 @@ _DISPATCH_KEYS = ("dispatches_per_turn", "dispatches_per_conversation")
 
 
 def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
-          tiereds, ingests, online_ivfs):
+          tiereds, ingests, online_ivfs, pq_fuseds):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -137,6 +146,8 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             ingests.append((path, obj))
         if obj.get("ivf_online") is True:
             online_ivfs.append((path, obj))
+        if obj.get("pq_fused") is True:
+            pq_fuseds.append((path, obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k in _DISPATCH_KEYS:
@@ -145,11 +156,12 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
                 hits.append((here, v, obj.get("planned_" + k)))
             else:
                 _walk(v, here, hits, recalls, speedups, meshes, tel_blocks,
-                      raggeds, tiereds, ingests, online_ivfs)
+                      raggeds, tiereds, ingests, online_ivfs, pq_fuseds)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
             _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
-                  tel_blocks, raggeds, tiereds, ingests, online_ivfs)
+                  tel_blocks, raggeds, tiereds, ingests, online_ivfs,
+                  pq_fuseds)
 
 
 def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
@@ -239,6 +251,36 @@ def _check_online_ivf(loc, obj, bad):
                          f"members)"))
 
 
+def _check_pq_fused(loc, obj, bad):
+    """The ISSUE 16 fused-PQ gate on one ``"pq_fused": true`` dict."""
+    if "dispatches_per_turn" not in obj:
+        bad.append((loc, "fused-pq artifact must record a measured "
+                         "'dispatches_per_turn'"))
+    if "recall_at_10" not in obj or "recall_floor" not in obj:
+        bad.append((loc, "fused-pq artifact must record a recall_at_10/"
+                         "recall_floor pair vs the classic ivf_pq_search "
+                         "path"))
+    bpr = obj.get("bytes_per_row")
+    try:
+        bpr_ok = float(bpr) > 0
+    except (TypeError, ValueError):
+        bpr_ok = False
+    if not bpr_ok:
+        bad.append((loc, f"fused-pq artifact records bytes_per_row == "
+                         f"{bpr!r} (must be a measured positive number — "
+                         f"the resident-footprint headline)"))
+    int8_bpr = obj.get("int8_bytes_per_row")
+    if bpr_ok and int8_bpr is not None:
+        try:
+            smaller = float(bpr) < float(int8_bpr)
+        except (TypeError, ValueError):
+            smaller = False
+        if not smaller:
+            bad.append((loc, f"fused-pq bytes_per_row {bpr!r} is not "
+                             f"below the int8 shadow's {int8_bpr!r} — "
+                             f"the PQ footprint advantage regressed"))
+
+
 def _check_ingest(loc, obj, bad):
     """The ISSUE 9 sharded-ingest gate on one ``"ingest_sharded": true``
     dict."""
@@ -300,6 +342,7 @@ def main(argv):
     checked_tiered = 0
     checked_ingest = 0
     checked_online_ivf = 0
+    checked_pq = 0
     bad = []
     for p in paths:
         try:
@@ -309,9 +352,11 @@ def main(argv):
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
         (hits, recalls, speedups, meshes, tel_blocks, raggeds, tiereds,
-         ingests, online_ivfs) = [], [], [], [], [], [], [], [], []
+         ingests, online_ivfs, pq_fuseds) = ([], [], [], [], [], [], [],
+                                             [], [], [])
         _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
-              tel_blocks, raggeds, tiereds, ingests, online_ivfs)
+              tel_blocks, raggeds, tiereds, ingests, online_ivfs,
+              pq_fuseds)
         grandfathered = os.path.basename(p).startswith(
             _PRE_TELEMETRY_PREFIXES)
         for loc, measured_fused, block in tel_blocks:
@@ -330,6 +375,9 @@ def main(argv):
         for loc, obj in online_ivfs:
             checked_online_ivf += 1
             _check_online_ivf(loc, obj, bad)
+        for loc, obj in pq_fuseds:
+            checked_pq += 1
+            _check_pq_fused(loc, obj, bad)
         for loc, v, planned in hits:
             checked += 1
             if v == 1:
@@ -378,8 +426,9 @@ def main(argv):
           f"{checked_telemetry} telemetry block(s), "
           f"{checked_ragged} ragged gate(s), "
           f"{checked_tiered} tiered gate(s), "
-          f"{checked_ingest} sharded-ingest gate(s), and "
-          f"{checked_online_ivf} online-ivf gate(s) across "
+          f"{checked_ingest} sharded-ingest gate(s), "
+          f"{checked_online_ivf} online-ivf gate(s), and "
+          f"{checked_pq} fused-pq gate(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 

@@ -115,18 +115,23 @@ def _geometry_from_gauge(plan_mod, key: str, artifact: dict):
     rows = int(lab.get("rows") or 0)
     if rows <= 0:
         return None
+    # ISSUE 16: pq=true labels (serve mode="pq"/"pq_tiered" + ingest with
+    # in-kernel code maintenance) sweep through the PQ resident/transient
+    # terms of the cost model.
+    pq = 1 if lab.get("pq") == "true" else 0
     if lab.get("path") == "ingest":
         return plan_mod.Geometry(
             kind="ingest", mode="ingest",
             batch=int(lab.get("batch") or 256), rows=rows, dim=int(dim),
             k=3, dtype_bytes=dtype_bytes,
             mesh_parts=_mesh_parts(lab.get("mesh", "1")),
-            ivf=1 if lab.get("ivf") == "true" else 0)
+            ivf=1 if lab.get("ivf") == "true" else 0, pq=pq)
     return plan_mod.Geometry(
         kind="serve", mode=lab.get("mode", "exact"),
         batch=int(lab.get("batch") or 128), rows=rows, dim=int(dim),
         k=int(lab.get("k") or 128), dtype_bytes=dtype_bytes,
-        mesh_parts=_mesh_parts(lab.get("mesh", "1")))
+        mesh_parts=_mesh_parts(lab.get("mesh", "1")), pq=pq,
+        slack=int(lab.get("slack") or 8))
 
 
 def _geometry_from_dict(plan_mod, d: dict):
@@ -140,7 +145,9 @@ def _geometry_from_dict(plan_mod, d: dict):
             mesh_parts=int(d.get("mesh_parts", 1)),
             edge_cap=int(d.get("edge_cap", 0)),
             nprobe=int(d.get("nprobe", 0)),
-            ivf=int(d.get("ivf", 0)))
+            ivf=int(d.get("ivf", 0)),
+            pq=int(d.get("pq", 0)),
+            slack=int(d.get("slack", 8)))
     except (TypeError, ValueError):
         return None
 

@@ -266,12 +266,13 @@ def test_multi_tenant_batch_isolation():
         ms.close()
 
 
-def test_fused_serving_covers_int8_and_ivf_but_not_pq():
+def test_fused_serving_covers_every_mode():
     """Since ISSUE 3 the fused path serves int8 mode itself (the quantized
-    coarse-scan + exact-rescore kernel), and since ISSUE 4 the IVF coarse
+    coarse-scan + exact-rescore kernel), since ISSUE 4 the IVF coarse
     stage rides INSIDE the fused program too (centroid prefilter + member
-    gather, ``search_fused_ivf``) — only IVF-PQ member storage keeps its
-    own classic prefilter scan and bypasses fusion."""
+    gather, ``search_fused_ivf``), and since ISSUE 16 PQ member storage
+    joined as well (``search_fused_pq`` — in-kernel ADC member scan +
+    exact rescore) — no mode opts out of fusion anymore."""
     with tempfile.TemporaryDirectory() as tmp:
         ms = _ingest(_system(tmp))
         assert ms._use_fused_serving()
@@ -281,5 +282,7 @@ def test_fused_serving_covers_int8_and_ivf_but_not_pq():
         ms.index.ivf_nprobe = 4
         assert ms._use_fused_serving()     # IVF rides the fused kernel now
         ms.index.pq_serving = True
-        assert not ms._use_fused_serving()  # PQ keeps the classic scan
+        assert ms._use_fused_serving()     # PQ rides it too (ISSUE 16)
+        ms.config.serve_fused = False
+        assert not ms._use_fused_serving()  # only the config opts out
         ms.close()

@@ -119,11 +119,17 @@ def test_memory_index_pq_serving_and_freshness():
     (got, sc), = idx.search_batch(emb[probe[:1]], "u1", k=1)
     assert abs(sc[0] - 1.0) < 5e-3
 
-    # a fresh post-build row re-encodes lazily and is served
+    # a fresh post-build row gets its codes PATCHED into the published
+    # pack at write time (ISSUE 16: no dirty flag, no offline re-encode)
     fresh = np.zeros((1, d), np.float32)
     fresh[0, 3] = 1.0
     idx.add(["fresh"], fresh, [0.5], [0.0], ["semantic"], ["default"], "u1")
-    assert idx._pq_dirty
+    pack = idx._pq_pack
+    assert pack is not None and pack[1] is not None   # still complete
+    frow = idx.id_to_row["fresh"]
+    want = np.asarray(encode_pq(pack[0].centroids,
+                                idx.state.emb[frow:frow + 1]))[0]
+    assert np.array_equal(np.asarray(pack[1])[frow], want)
     (got, _), = idx.search_batch(fresh, "u1", k=1)
     assert got == ["fresh"]
 
@@ -185,10 +191,12 @@ def test_pq_codes_never_published_against_newer_book():
     assert idx.ivf_maintenance()
     old_pack = idx._pq_pack
 
-    # simulate a maintenance retrain racing the reader
+    # simulate a maintenance retrain racing a reader that still holds a
+    # CODELESS old pack (the pre-ISSUE-16 lazy shape; today only a pack
+    # caught mid-publish looks like this)
+    old_pack = (old_pack[0], None)
     new_book = train_pq(idx.state.emb, np.ones((idx.state.emb.shape[0],),
                                                bool), seed=99)
-    idx._pq_dirty = True
     idx._pq_pack = (new_book, None)
     new_pack = idx._pq_pack
 
