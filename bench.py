@@ -38,6 +38,7 @@ Prints ONE JSON line. Env overrides:
                                LLM (extract → constrained JSON → ingest)
 """
 
+import dataclasses
 import json
 import os
 import sys
@@ -2308,6 +2309,196 @@ def bench_tiered_serving(on_tpu: bool, rows: int = 65_536,
     return out
 
 
+def bench_paged_arena(on_tpu: bool, rows: int = 16_384, reps: int = 5,
+                      qps_floor: float = 0.9):
+    """Paged-arena acceptance bench (ISSUE 17): the SAME corpus served
+    dense and through the page-table indirection, then a grow → demote →
+    re-ingest churn on the paged variant. The artifact pins the four
+    claims the feature makes:
+
+      - serving parity cost: paged QPS ≥ ``qps_floor``× dense QPS and
+        still exactly ONE fused dispatch per turn (the indirection is a
+        gather INSIDE the kernel, not a sibling dispatch),
+      - reclamation: watermark demotion PUSHES freed slots
+        (``pages_free`` rises by exactly the demoted count / page math),
+        and the re-ingest after it POPS them back (no pool growth),
+      - copy-free growth: logical capacity growth past the initial
+        allocation reuses the emb pool buffer BY REFERENCE — zero
+        embedding bytes copied — while the dense twin reallocates its
+        whole table,
+      - planner honesty: the admission model's resident-bytes prediction
+        for the paged geometry (pool + row_map + inv_map) undercuts the
+        dense geometry the moment the pool lags capacity, and stays
+        BELOW the dense prediction after the growth step.
+    """
+    from lazzaro_tpu.core import state as S_mod
+    from lazzaro_tpu.core.index import MemoryIndex
+    from lazzaro_tpu.plan.model import CostModel
+    from lazzaro_tpu.serve import RetrievalRequest
+    from lazzaro_tpu.utils.telemetry import Telemetry
+
+    B = 64
+    page_rows = max(256, rows // 16)
+    rng = np.random.default_rng(17)
+    emb = rng.standard_normal((rows, DIM)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    probe = rng.integers(0, rows, B)
+    nz = rng.standard_normal((B, DIM)).astype(np.float32)
+    nz *= 0.3 / np.linalg.norm(nz, axis=1, keepdims=True)
+    queries = (emb[probe] + nz).astype(np.float32)
+
+    def build(paged):
+        tel = Telemetry()
+        idx = MemoryIndex(dim=DIM, capacity=rows + 64, dtype=jnp.bfloat16,
+                          telemetry=tel, paged=paged, page_rows=page_rows)
+        t0 = time.perf_counter()
+        for c in range(0, rows, 65_536):
+            m = min(65_536, rows - c)
+            idx.add([f"f{c + i}" for i in range(m)], emb[c:c + m],
+                    [0.5] * m, [0.0] * m, ["semantic"] * m,
+                    ["default"] * m, "u0")
+        return idx, tel, time.perf_counter() - t0
+
+    dense, _, dense_fill_s = build(False)
+    paged, tel, paged_fill_s = build(True)
+
+    # ---- serving: QPS ratio + dispatch counter ----------------------
+    # measured over the production fused serving surface (same entry the
+    # tiered/ragged artifacts gate) — the page indirection must ride
+    # INSIDE the one fused program, so the counted dispatch total per
+    # turn is identical to dense and exactly 1.
+    kw = dict(cap_take=5, max_nbr=16, super_gate=0.4,
+              acc_boost=0.05, nbr_boost=0.02)
+
+    def reqs_for(qs):
+        return [RetrievalRequest(query=qs[i], tenant="u0", k=10,
+                                 gate_enabled=True, boost=False)
+                for i in range(len(qs))]
+
+    scan_names = ("search_fused", "search_fused_copy", "search_fused_read",
+                  "search_fused_ragged", "search_fused_ragged_copy",
+                  "search_fused_ragged_read", "arena_search")
+    calls = {"n": 0}
+    wrapped = {}
+    for name in scan_names:
+        orig = getattr(S_mod, name)
+        wrapped[name] = orig
+
+        def counting(*a, __orig=orig, **k2):
+            calls["n"] += 1
+            return __orig(*a, **k2)
+
+        setattr(S_mod, name, counting)
+    try:
+        dense.search_fused_requests(reqs_for(queries), **kw)   # compile
+        paged.search_fused_requests(reqs_for(queries), **kw)
+        res_d, res_p, times = {}, {}, {}
+        for tag, idx in (("dense", dense), ("paged", paged)):
+            calls["n"] = 0
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                res = idx.search_fused_requests(reqs_for(queries), **kw)
+            times[tag] = (time.perf_counter() - t0) * 1e3 / reps
+            (res_d if tag == "dense" else res_p)["r"] = res
+            if tag == "paged":
+                dispatches_per_turn = calls["n"] / reps
+    finally:
+        for name, orig in wrapped.items():
+            setattr(S_mod, name, orig)
+    # parity spot-check rides the artifact (the bit-parity suite is tier-1)
+    agree = sum(1 for a, b in zip(res_d["r"], res_p["r"])
+                if a.ids[:5] == b.ids[:5]) / B
+    dense_qps = B / (times["dense"] / 1e3)
+    paged_qps = B / (times["paged"] / 1e3)
+
+    # ---- planner resident-bytes: paged pool vs dense table ----------
+    cm = CostModel()
+    g_dense = dense._serve_geometry(B, "exact", 16)
+    g_paged = paged._serve_geometry(B, "exact", 16)
+    res_bytes_dense = cm.resident_bytes(g_dense)
+    res_bytes_paged = cm.resident_bytes(g_paged)
+
+    # ---- churn: demote reclaims pages, re-ingest reuses them --------
+    before = paged.stats()["paged"]
+    tm = paged.enable_tiering(rows // 2, high_watermark=1.0,
+                              low_watermark=1.0, chunk_rows=4096,
+                              hysteresis_s=0.0, promote_hits=1_000_000)
+    t0 = time.perf_counter()
+    tm.run_once(now=time.time() + 60 * 86400.0)
+    demote_s = time.perf_counter() - t0
+    after_demote = paged.stats()["paged"]
+    pool_grows_before_reingest = paged.telemetry.counter_total(
+        "arena.pool_grows")
+    m = min(4096, tm.demoted_total)
+    paged.add([f"r{i}" for i in range(m)],
+              emb[:m], [0.9] * m, [time.time()] * m,
+              ["semantic"] * m, ["default"] * m, "u0")
+    after_reingest = paged.stats()["paged"]
+    reingest_grew_pool = (paged.telemetry.counter_total("arena.pool_grows")
+                          > pool_grows_before_reingest)
+
+    # ---- copy-free growth: metadata realloc, pool by reference ------
+    # the REAL grow step on the live state: logical capacity doubles,
+    # the emb pool is the SAME buffer (is-identity — zero embedding
+    # bytes moved), and the planner's resident prediction for the grown
+    # paged geometry stays flat while the dense twin's doubles.
+    cap0, pool0 = paged.capacity, paged.state.emb.shape[0]
+    st = paged.state
+    grown = S_mod.grow_arena_paged(st, cap0 * 2 + 1)
+    grow_copied_pool = grown.emb is not st.emb
+    cap1, pool1 = int(grown.capacity), grown.emb.shape[0]
+    g_paged_grown = dataclasses.replace(g_paged, rows=cap1 + 1)
+    g_dense_grown = dataclasses.replace(g_dense, rows=cap1 + 1)
+    res_bytes_paged_grown = cm.resident_bytes(g_paged_grown)
+    res_bytes_dense_grown = cm.resident_bytes(g_dense_grown)
+
+    out = {
+        "paged": True,
+        "corpus_rows": rows,
+        "dim": DIM,
+        "batch": B,
+        "reps": reps,
+        "page_rows": page_rows,
+        "dense_fill_s": round(dense_fill_s, 1),
+        "paged_fill_s": round(paged_fill_s, 1),
+        "dense_turn_batch64_ms": round(times["dense"], 3),
+        "paged_turn_batch64_ms": round(times["paged"], 3),
+        "dense_qps": round(dense_qps, 1),
+        "paged_qps": round(paged_qps, 1),
+        "paged_qps_ratio": round(paged_qps / dense_qps, 3),
+        "paged_qps_floor": qps_floor,
+        "top5_agreement": round(agree, 4),
+        "dispatches_per_turn": dispatches_per_turn,
+        "page_stats_initial": before,
+        "page_stats_after_demote": after_demote,
+        "page_stats_after_reingest": after_reingest,
+        "demoted_rows": tm.demoted_total,
+        "demote_s": round(demote_s, 2),
+        "reingest_rows": m,
+        "reingest_grew_pool": reingest_grew_pool,
+        "growth": {
+            "capacity_before": cap0, "capacity_after": cap1,
+            "pool_rows_before": pool0, "pool_rows_after": pool1,
+            "grow_copied_pool": grow_copied_pool,
+        },
+        "planner": {
+            "resident_bytes_dense": res_bytes_dense,
+            "resident_bytes_paged": res_bytes_paged,
+            "resident_bytes_dense_after_grow": res_bytes_dense_grown,
+            "resident_bytes_paged_after_grow": res_bytes_paged_grown,
+        },
+        "mirror_mismatches": paged.telemetry.counter_total(
+            "arena.page_mirror_mismatches"),
+        "telemetry": _telemetry_block(tel),
+        "roofline": {
+            "paged_batch64": _roofline(rows, DIM, 2, times["paged"], B,
+                                       on_tpu),
+        },
+    }
+    del dense, paged
+    return out
+
+
 def bench_reference_default(on_tpu: bool):
     """Reference-DEFAULT configuration, measured (r4 review #4): hierarchy
     ON (super-node creation + the 0.4-gated fast path, ref
@@ -3339,6 +3530,40 @@ def tiered_stage_main():
                           if k not in ("telemetry",)}}}))
 
 
+def paged_arena_stage_main():
+    """Standalone paged-arena acceptance stage (BENCH_PAGED_ARENA=<rows>
+    or =1 for the default 16384): dense-vs-paged serving QPS + dispatch
+    count, watermark-demote page reclamation, copy-free growth, and the
+    planner's paged resident-bytes prediction. Writes
+    bench_artifacts/pr17_paged_arena_<size>_<dev>.json (gated in CI by
+    scripts/check_hbm_budget.py and check_dispatch_counts.py)."""
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    spec = os.environ.get("BENCH_PAGED_ARENA", "1")
+    rows = 16_384 if spec.strip() in ("", "1") else int(spec)
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    dev_tag = "tpu" if on_tpu else "cpu"
+    print(f"[bench] paged-arena stage at {rows} rows", file=sys.stderr,
+          flush=True)
+    t0 = time.perf_counter()
+    out = bench_paged_arena(on_tpu, rows)
+    out["stage_total_s"] = round(time.perf_counter() - t0, 1)
+    size_tag = "1m" if rows >= 1_000_000 else f"{rows // 1024}k"
+    path = os.path.join(art_dir,
+                        f"pr17_paged_arena_{size_tag}_{dev_tag}.json")
+    with open(path, "w") as f:
+        json.dump({"metric": "paged_qps_ratio",
+                   "value": out["paged_qps_ratio"], "unit": "x",
+                   "device": dev_tag, "sizes": {size_tag: out}},
+                  f, indent=1)
+    print(f"[bench] wrote {path}", file=sys.stderr, flush=True)
+    print(json.dumps({"metric": "paged_qps_ratio",
+                      "sizes": {size_tag: {
+                          k: v for k, v in out.items()
+                          if k not in ("telemetry",)}}}))
+
+
 def bench_fault_recovery(on_tpu: bool, rows: int = 8192, faults_n: int = 20,
                          flood: int = 512):
     """Fault-recovery acceptance stage (ISSUE 10): measures what failure
@@ -4058,6 +4283,9 @@ if __name__ == "__main__":
             sys.exit(0)
         if os.environ.get("BENCH_TIERED"):
             tiered_stage_main()
+            sys.exit(0)
+        if os.environ.get("BENCH_PAGED_ARENA"):
+            paged_arena_stage_main()
             sys.exit(0)
         if os.environ.get("BENCH_RAGGED"):
             ragged_stage_main()

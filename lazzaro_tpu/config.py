@@ -20,6 +20,14 @@ class MemoryConfig:
     initial_capacity: int = 1024    # arena rows; grows by doubling
     max_edges: int = 8192           # edge arena rows; grows by doubling
     dtype: str = "float32"          # arena embedding dtype ("bfloat16" for 1M+)
+    # Paged embedding arena (ISSUE 17): the master emb becomes fixed-size
+    # HBM pages behind an int32 row_map indirection with a device-side
+    # free list — delete/tier-demote push pool slots back (demotion
+    # reclaims real capacity), logical growth is O(metadata) and never
+    # copies the pool. Bit-parity with the dense arena on every fused
+    # mode; single-chip only (ignored with a warning under a mesh).
+    paged_arena: bool = False
+    arena_page_rows: int = 4096     # pool page granularity (rows/page)
     # Int8 serving shadow (ops/quant.py): user-facing searches scan a
     # per-row-quantized copy at half the HBM bytes (the bandwidth floor is
     # what bounds 1M-row retrieval); consolidation's dedup/link/merge

@@ -338,6 +338,18 @@ def save_index(index: MemoryIndex, ckpt_dir: str,
             "promote_hits": tier.promote_hits,
             "hysteresis_s": tier.hysteresis_s,
         }
+    # Paged arena (ISSUE 17): the logical→physical row_map, inv_map and
+    # the host mirror's free stack ride the snapshot (the ``arena_emb``
+    # column above is already pool-shaped in a paged index). The device
+    # PageTable is NOT fetched — mirror and device are pop-for-pop
+    # identical by construction, so load rebuilds the device stack from
+    # the mirror arrays.
+    if index.state.row_map is not None:
+        arrays["arena_row_map"] = np.asarray(index.state.row_map, np.int32)
+        arrays["arena_inv_map"] = np.asarray(index.state.inv_map, np.int32)
+        arrays.update(index._pager.export_arrays())
+        meta["paged"] = {"page_rows": int(index._pager.page_rows),
+                         "pool_slots": int(index._pager.pool_slots)}
     if extra_meta:
         meta.update(extra_meta)
     _write_versioned(ckpt_dir, arrays, meta)
@@ -369,6 +381,17 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
     edges = S.EdgeState(**{
         col: _device(data[f"edge_{col}"], dtypes[f"edge_{col}"])
         for col in _EDGE_COLS})
+    pg_meta = meta.get("paged")
+    if pg_meta is not None:
+        if mesh is not None:
+            raise ValueError(
+                "paged-arena checkpoints are single-chip (the pod path "
+                "keeps the dense device layout) — load without a mesh")
+        arena = arena.replace(
+            row_map=jnp.asarray(np.asarray(data["arena_row_map"],
+                                           np.int32)),
+            inv_map=jnp.asarray(np.asarray(data["arena_inv_map"],
+                                           np.int32)))
 
     dt = jnp.bfloat16 if meta["dtype"] == "bfloat16" else jnp.dtype(meta["dtype"])
     index = MemoryIndex(meta["dim"], capacity=1, edge_capacity=1, dtype=dt,
@@ -378,6 +401,22 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
                         **index_kwargs)
     index.state = arena        # setter re-shards over the mesh if given
     index.edge_state = edges
+    if pg_meta is not None:
+        from lazzaro_tpu.core.paging import PageAllocator
+
+        pool_slots = int(pg_meta["pool_slots"])
+        stack = np.asarray(data["page_stack"], np.int32)
+        # device free stack rebuilt from the mirror (they are identical
+        # by the pop-for-pop replay invariant; save never fetches it)
+        free = np.zeros((pool_slots + 1,), np.int32)
+        free[:len(stack)] = stack
+        index.paged = True
+        index.page_rows = int(pg_meta["page_rows"])
+        index._ptable = S.PageTable(free_slots=jnp.asarray(free),
+                                    free_top=jnp.int32(len(stack)))
+        index._pager = PageAllocator.from_arrays(
+            arena.capacity, pool_slots, index.page_rows,
+            stack, data["page_row_slot"])
 
     node_rows = data["node_rows"].astype(np.int64)
     node_ids = np.asarray(meta["node_ids"], object)

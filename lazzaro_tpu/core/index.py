@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lazzaro_tpu.core import state as S
+from lazzaro_tpu.core.paging import PageAllocator
 from lazzaro_tpu.ops import graphops
 from lazzaro_tpu.plan import Geometry, HbmPlanner
 from lazzaro_tpu.reliability import faults
@@ -138,6 +139,7 @@ class MemoryIndex:
                  ivf_online: bool = True, ivf_member_cap_factor: int = 4,
                  ivf_online_eta: float = 1.0,
                  pq_serving: bool = False, coarse_slack: int = 8,
+                 paged: bool = False, page_rows: int = 4096,
                  telemetry=None, telemetry_hbm: bool = False,
                  serve_ragged: bool = True, serve_k_max: int = 128,
                  serve_pad_granularity: int = 8,
@@ -300,7 +302,34 @@ class MemoryIndex:
         self.epoch = float(epoch if epoch is not None else time.time())
         capacity = self._round_capacity(capacity)
         edge_capacity = self._round_capacity(edge_capacity, block=False)
-        self.state = S.init_arena(capacity, dim, dtype)
+        # Paged embedding arena (ISSUE 17): the master emb becomes a
+        # fixed-size-page HBM pool behind an int32 ``row_map`` indirection
+        # with a device-side free list — delete/demote push slots back
+        # (real reclaimed capacity), logical growth is O(metadata) and
+        # never copies the pool. Single-chip only for the DEVICE layout:
+        # the pod path keeps the dense per-chip arena (ROADMAP residual).
+        if paged and mesh is not None:
+            import warnings
+            warnings.warn(
+                "paged arena is single-chip only (the pod path keeps the "
+                "dense per-chip device layout); the flag is ignored under "
+                "a mesh", stacklevel=3)
+            paged = False
+        self.paged = bool(paged)
+        self.page_rows = max(1, int(page_rows))
+        if self.paged:
+            # initial pool = logical capacity rounded up to whole pages
+            # (dense-equivalent HBM at t0; the pool only grows when the
+            # LIVE set outgrows it, so paged peak ≤ dense peak by design)
+            pool_slots = -(-capacity // self.page_rows) * self.page_rows
+            self.state, self._ptable = S.init_arena_paged(
+                capacity, dim, pool_slots, dtype)
+            self._pager = PageAllocator(capacity, pool_slots,
+                                        self.page_rows)
+        else:
+            self.state = S.init_arena(capacity, dim, dtype)
+            self._ptable = None
+            self._pager = None
         self.edge_state = S.init_edges(edge_capacity)
         self._free_rows: List[int] = list(range(capacity - 1, -1, -1))
         self._free_edge_slots: List[int] = list(range(edge_capacity - 1, -1, -1))
@@ -397,7 +426,7 @@ class MemoryIndex:
         """(routed, in_sealed_residual) bool bitmaps over arena rows for a
         build — the writer-side bookkeeping ``ivf_maintenance`` and the
         ``_ivf`` compat setter both publish."""
-        n = self.state.emb.shape[0]
+        n = self.state.salience.shape[0]
         routed = np.zeros((n,), bool)
         m = np.asarray(ivf.members).ravel()
         routed[m[(m >= 0) & (m < n)]] = True
@@ -547,6 +576,100 @@ class MemoryIndex:
             del cur
             self.edge_state = out
 
+    # ------------------------------------------------------- paged arena
+    def _ptable_sole(self, pt) -> bool:
+        # the PageTable's slot in ``_ptable`` plus getrefcount's argument;
+        # a checkpoint snapshot holding the stack forces the copying twin
+        return (pt is None
+                or sys.getrefcount(pt.free_slots) <= self._SOLE_SHADOW_REFS)
+
+    def _apply_arena_paged(self, donated, copying, *args, replay=None):
+        """Paged twin of ``_apply_arena``: dispatch a ``(state, ptable,
+        *args) -> (state, ptable, count)`` kernel under the ownership
+        gate, store both, and REPLAY the same free-list op on the host
+        mirror inside the same critical section (device ops execute in
+        dispatch order; replaying under the lock keeps the mirror's order
+        identical). Returns ``replay``'s result (the mirror's pop/push
+        count)."""
+        with self._state_lock:
+            cur, pt = self._state, self._ptable
+            sole = (sys.getrefcount(cur) <= self._SOLE_REFS
+                    and self._ptable_sole(pt))
+            out = self._guarded(lambda fn: fn(cur, pt, *args),
+                                donated, copying, sole, (cur, pt), "arena")
+            del cur, pt
+            self.state = out[0]
+            self._ptable = out[1]
+            mirror = replay(self._pager) if replay is not None else None
+        self._page_gauges()
+        return mirror
+
+    def _page_gauges(self) -> None:
+        """Refresh the ``arena.pages_*`` occupancy gauges from the host
+        mirror — pure bookkeeping, no device readback."""
+        pager = self._pager
+        if pager is None or not self.telemetry.enabled:
+            return
+        total, free, frag = pager.page_stats()
+        tel = self.telemetry
+        tel.gauge("arena.pages_total", total)
+        tel.gauge("arena.pages_free", free)
+        tel.gauge("arena.fragmentation", frag)
+
+    def _ensure_pool(self, rows: Sequence[int]) -> None:
+        """Pre-dispatch pool-capacity check: count the batch's NEW slot
+        bindings against the mirror's free stack and grow the pool (by
+        whole pages, at least doubling — amortized O(1)) BEFORE the
+        dispatch, so the in-kernel prefix-sum pop can never run dry."""
+        pager = self._pager
+        if pager is None:
+            return
+        need, seen = 0, set()
+        for r in rows:
+            r = int(r)
+            if r >= pager.capacity or r in seen:
+                continue
+            seen.add(r)
+            if pager.slot_of(r) < 0:
+                need += 1
+        target = pager.need_grow(need)
+        if not target:
+            return
+        with self._state_lock:
+            new_state, new_pt = S.grow_pool(self._state, self._ptable,
+                                            target)
+            self.state = new_state
+            self._ptable = new_pt
+            pager.grow_pool(target)
+            # physical emb buffer moved: abort racing pump windows (slot
+            # BINDINGS are preserved, but the gather address changed)
+            self._emb_gen += 1
+        self.telemetry.bump("arena.pool_grows")
+        self._page_gauges()
+
+    def _note_page_tail(self, page_host, mirror) -> None:
+        """Account the free-list leaves riding the packed ingest readback
+        (ISSUE 17): pop count, post-pop stack depth, overflow flag. The
+        host mirror replayed the same op at dispatch time, so the device
+        values are a parity ASSERTION, not a sync — a mismatch is counted
+        and pinned to zero by the parity tests."""
+        tel = self.telemetry
+        pops = int(page_host[0][0, 0])
+        tel.bump("arena.page_pops", pops)
+        if int(page_host[2][0, 0]):
+            tel.bump("arena.page_overflows")
+        if mirror is not None and mirror != (pops, int(page_host[1][0, 0])):
+            tel.bump("arena.page_mirror_mismatches")
+        self._page_gauges()
+
+    def _emb_logical(self, st: S.ArenaState):
+        """Logical ``[cap+1, d]`` view of the embeddings for the non-fused
+        maintenance paths (IVF build, PQ full encode, fallback coarse
+        search) — a gather through ``row_map`` when paged, the master
+        itself when dense. The fused kernels never call this; they route
+        each row access through ``S._phys`` instead."""
+        return st.emb if st.row_map is None else st.emb[st.row_map]
+
     def _ingest_shadow_arg(self, sharded_ok: bool = False):
         """Int8 shadow to thread through the fused ingest program for
         incremental code maintenance, or None when there is nothing valid
@@ -560,7 +683,8 @@ class MemoryIndex:
         if not self.int8_serving or mesh_blocked or self._int8_dirty:
             return None
         shadow = self._int8_shadow
-        if shadow is None or shadow[0].shape[0] != self._state.emb.shape[0]:
+        if (shadow is None
+                or shadow[0].shape[0] != self._state.salience.shape[0]):
             return None
         return shadow
 
@@ -612,7 +736,7 @@ class MemoryIndex:
         pack = self._pq_pack
         if pack is None or pack[1] is None:
             return None
-        if pack[1].shape[0] != self._state.emb.shape[0]:
+        if pack[1].shape[0] != self._state.salience.shape[0]:
             return None
         return (pack[0].centroids, pack[1])
 
@@ -649,11 +773,11 @@ class MemoryIndex:
             return
         st = self.state
         codes = pack[1]
-        if codes.shape[0] != st.emb.shape[0]:
+        if codes.shape[0] != st.salience.shape[0]:
             return
         from lazzaro_tpu.ops.pq import encode_pq
         r = jnp.asarray(np.asarray(rows, np.int32))
-        new = encode_pq(pack[0].centroids, st.emb[r])
+        new = encode_pq(pack[0].centroids, st.emb[S._phys(st, r)])
         self._pq_pack = (pack[0], codes.at[r].set(new))
         self.telemetry.bump("pq.rows_encoded", len(rows))
 
@@ -662,19 +786,23 @@ class MemoryIndex:
         shadow when it is being incrementally maintained, plus the live
         online-IVF coarse tables, plus the PQ pack — ISSUE 16), donating
         only when this index holds the sole reference to each; returns
-        ``(link_flat, shadow_maintained, ivf_maintained, pq_maintained)``
-        — the kernel's non-state outputs and which sidecars stayed fresh
-        in-kernel."""
+        ``(link_flat, shadow_maintained, ivf_maintained, pq_maintained,
+        page_mirror)`` — the kernel's non-state outputs, which sidecars
+        stayed fresh in-kernel, and the host free-list mirror's
+        ``(pops, free_top)`` after replaying the batch (None when
+        dense)."""
         sharded = self.ingest_sharded and self.mesh is not None
+        mirror_rows = kwargs.pop("mirror_rows", None)
         with self._state_lock:
             arena, edges = self._state, self._edge_state
             shadow = self._ingest_shadow_arg(sharded_ok=sharded)
             ivf = self._ivf_online_arg()
             pq = self._pq_ingest_arg()
+            pt = None if sharded else self._ptable
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                     and sys.getrefcount(edges) <= self._SOLE_REFS
                     and self._shadow_sole(shadow) and self._ivf_sole(ivf)
-                    and self._pq_sole(pq))
+                    and self._pq_sole(pq) and self._ptable_sole(pt))
             if sharded:
                 # Non-dedup ingest under a mesh (ISSUE 12 satellite): the
                 # distributed plain-ingest program replaces the GSPMD
@@ -696,24 +824,30 @@ class MemoryIndex:
                 else:
                     new_arena, new_edges, link_flat = got
                     new_shadow = None
-                new_ivf = new_pq = None
+                new_ivf = new_pq = new_pt = None
             else:
                 (new_arena, new_edges, new_shadow, new_ivf, new_pq,
-                 link_flat) = self._guarded(
+                 new_pt, link_flat) = self._guarded(
                     lambda fn: self._ingest_dispatch(fn, arena, edges,
-                                                     shadow, ivf, pq,
+                                                     shadow, ivf, pq, pt,
                                                      *args, **kwargs),
                     S.ingest_fused, S.ingest_fused_copy, sole,
-                    (arena, edges, shadow, ivf, pq), "ingest")
-            del arena, edges, shadow, ivf, pq
+                    (arena, edges, shadow, ivf, pq, pt), "ingest")
+            del arena, edges, shadow, ivf, pq, pt
             self.state = new_arena
             self.edge_state = new_edges
             if new_shadow is not None:
                 self._int8_shadow = new_shadow
             self._store_ivf_dev(new_ivf)
             self._store_pq_dev(new_pq)
+            if new_pt is not None:
+                self._ptable = new_pt
+            mirror = None
+            if self._pager is not None and mirror_rows is not None:
+                mirror = (self._pager.alloc(mirror_rows),
+                          self._pager.free_top)
         return (link_flat, new_shadow is not None, new_ivf is not None,
-                new_pq is not None)
+                new_pq is not None, mirror)
 
     # ------------------------------------------------------------------ ids
     def tenant_id(self, name: str) -> int:
@@ -756,6 +890,21 @@ class MemoryIndex:
                      if self.mesh is not None else None),
             "tier": (self.tiering.stats() if self.tiering is not None
                      else None),
+            "paged": (self._page_block() if self._pager is not None
+                      else None),
+        }
+
+    def _page_block(self) -> Dict[str, object]:
+        pager = self._pager
+        pages_total, pages_free, frag = pager.page_stats()
+        return {
+            "page_rows": pager.page_rows,
+            "pool_rows": pager.pool_slots,
+            "pages_total": pages_total,
+            "pages_free": pages_free,
+            "fragmentation": round(frag, 4),
+            "pops_total": pager.pops_total,
+            "pushes_total": pager.pushes_total,
         }
 
     # ------------------------------------------------------- tiered memory
@@ -794,7 +943,7 @@ class MemoryIndex:
         if cache is not None and cache[0] == key:
             return cache[1], cache[2]
         indptr, nbr = build_host_csr(list(self.edge_slots.keys()),
-                                     self.id_to_row, st.emb.shape[0])
+                                     self.id_to_row, st.salience.shape[0])
         dev = (jnp.asarray(indptr), jnp.asarray(nbr))
         self._csr_flat_cache = (key, dev[0], dev[1])
         return dev
@@ -804,8 +953,15 @@ class MemoryIndex:
         while len(self._free_rows) < n:
             old_cap = self.state.capacity
             new_cap = self._grown_capacity(old_cap)
-            self.state = S.grow_arena(self.state, new_cap)
-            self._int8_dirty = True        # emb shape changed
+            if self._pager is not None:
+                # copy-free growth (ISSUE 17): metadata-only realloc; the
+                # emb pool is untouched and grows separately, by pages,
+                # only when the LIVE set needs the slots (_ensure_pool)
+                self.state = S.grow_arena_paged(self.state, new_cap)
+                self._pager.grow_capacity(new_cap)
+            else:
+                self.state = S.grow_arena(self.state, new_cap)
+            self._int8_dirty = True        # logical emb shape changed
             pack = self._pq_pack
             if pack is not None and pack[1] is not None:
                 # pad the code slab in place of a full re-encode: grown
@@ -860,8 +1016,7 @@ class MemoryIndex:
 
         tid = self.tenant_id(tenant)
         self.tenant_nodes.setdefault(tenant, set()).update(ids)
-        self._apply_arena(
-            S.arena_add, S.arena_add_copy,
+        add_args = (
             jnp.asarray(padded),
             jnp.asarray(emb),
             jnp.asarray(pad([float(s) for s in saliences])),
@@ -871,6 +1026,14 @@ class MemoryIndex:
             jnp.asarray(pad([tid] * n, -1, np.int32)),
             jnp.asarray(pad([bool(x) for x in is_super], False, bool)),
         )
+        if self._pager is not None:
+            self._ensure_pool(rows)
+            pops = self._apply_arena_paged(
+                S.arena_add_paged, S.arena_add_paged_copy, *add_args,
+                replay=lambda p: p.alloc(rows))
+            self.telemetry.bump("arena.page_pops", pops)
+        else:
+            self._apply_arena(S.arena_add, S.arena_add_copy, *add_args)
         self._int8_dirty = True            # emb rows written
         self._pq_encode_rows(rows)         # codes patched, never re-encoded
         self._emb_gen += 1
@@ -907,11 +1070,11 @@ class MemoryIndex:
             return
         ivf, ivf_fresh = pack
         routed = self._ivf_routed
-        if routed is not None and len(routed) < self.state.emb.shape[0]:
+        if routed is not None and len(routed) < self.state.salience.shape[0]:
             # arena grew since the build: extend the routed bitmap so
             # grown rows can be marked and never double-append to the
             # residual (duplicate rows would surface twice in one top-k)
-            grown = np.zeros((self.state.emb.shape[0],), bool)
+            grown = np.zeros((self.state.salience.shape[0],), bool)
             grown[:len(routed)] = routed
             self._ivf_routed = routed = grown
         appended = []
@@ -944,8 +1107,8 @@ class MemoryIndex:
         if appended:
             routed = self._ivf_routed
             if routed is not None:
-                if len(routed) < self.state.emb.shape[0]:
-                    grown = np.zeros((self.state.emb.shape[0],), bool)
+                if len(routed) < self.state.salience.shape[0]:
+                    grown = np.zeros((self.state.salience.shape[0],), bool)
                     grown[:len(routed)] = routed
                     self._ivf_routed = routed = grown
                 routed[appended] = True
@@ -979,7 +1142,7 @@ class MemoryIndex:
             return
         with self._state_lock:
             dev = self._ivf_dev
-            drop = np.zeros((self.state.emb.shape[0],), bool)
+            drop = np.zeros((self.state.salience.shape[0],), bool)
             drop[[r for r in rows if r < len(drop)]] = True
             members = dev[1]
             fn = (S.ivf_members_drop
@@ -1076,6 +1239,7 @@ class MemoryIndex:
                 rows.append(r)
         tid = self.tenant_id(tenant)
         self.tenant_nodes.setdefault(tenant, set()).update(ids)
+        self._ensure_pool(rows)
 
         t_rows, t_sals = [], []
         for mid, msal in zip(merge_ids, merge_saliences):
@@ -1136,7 +1300,8 @@ class MemoryIndex:
                 else "fused")
         t0 = time.perf_counter()
         with trace_annotation(f"lz.ingest.{kind}"):
-            link_flat, shadow_fresh, ivf_fresh, pq_fresh = self._apply_fused(
+            (link_flat, shadow_fresh, ivf_fresh, pq_fresh,
+             page_mirror) = self._apply_fused(
                 jnp.asarray(padded), jnp.asarray(emb),
                 jnp.asarray(pad([float(s) for s in saliences])),
                 jnp.asarray(pad([float(t) - self.epoch
@@ -1154,7 +1319,7 @@ class MemoryIndex:
                 jnp.float32(now_rel), jnp.int32(tid),
                 jnp.float32(link_gate), jnp.float32(link_scale),
                 jnp.float32(self.ivf_online_eta),
-                k=k_eff, shard_modes=shard_modes)
+                k=k_eff, shard_modes=shard_modes, mirror_rows=rows)
             if not shadow_fresh:
                 self._int8_dirty = True
             if not pq_fresh:
@@ -1173,7 +1338,11 @@ class MemoryIndex:
         # Device-side ingest counters riding the same readback (ISSUE 6):
         # overflow flag + accepted-link count + pool-slot occupancy are the
         # trailing broadcast leaves after the per-mode triples (the online
-        # IVF leaves, when maintained, trail those — ISSUE 12).
+        # IVF leaves, when maintained, trail those — ISSUE 12; the paged
+        # free-list leaves are LAST — ISSUE 17).
+        if self._pager is not None:
+            self._note_page_tail(host[-S.PAGE_INGEST_TAIL:], page_mirror)
+            host = host[:-S.PAGE_INGEST_TAIL]
         ctr = host[3 * n_modes:]
         self.telemetry.bump("ingest.dispatches", labels={"kind": kind})
         self.telemetry.bump("ingest.links_accepted", int(ctr[1][0, 0]))
@@ -1281,25 +1450,26 @@ class MemoryIndex:
                                  labels={"surface": "ingest_sharded"})
         return kern
 
-    def _apply_dedup_fused(self, *args, k, shard_modes):
+    def _apply_dedup_fused(self, *args, k, shard_modes, mirror_rows=None):
         """Dispatch the device-dedup fused ingest over BOTH states (plus
         the maintained int8 shadow, online-IVF tables, and PQ pack) under
         the ownership gate (mirror of ``_apply_fused``); returns ``(flat,
-        shadow_maintained, ivf_maintained, pq_maintained)``. Under a mesh
-        with ``ingest_sharded`` the program is the distributed shard_map
-        composition (ONE distributed dispatch; the shadow row-shards with
-        the master, so it stays maintained in-kernel on the pod path
-        too)."""
+        shadow_maintained, ivf_maintained, pq_maintained, page_mirror)``.
+        Under a mesh with ``ingest_sharded`` the program is the
+        distributed shard_map composition (ONE distributed dispatch; the
+        shadow row-shards with the master, so it stays maintained
+        in-kernel on the pod path too)."""
         sharded = self.ingest_sharded and self.mesh is not None
         with self._state_lock:
             arena, edges = self._state, self._edge_state
             shadow = self._ingest_shadow_arg(sharded_ok=sharded)
             ivf = self._ivf_online_arg()
             pq = self._pq_ingest_arg()
+            pt = None if sharded else self._ptable
             sole = (sys.getrefcount(arena) <= self._SOLE_REFS
                     and sys.getrefcount(edges) <= self._SOLE_REFS
                     and self._shadow_sole(shadow) and self._ivf_sole(ivf)
-                    and self._pq_sole(pq))
+                    and self._pq_sole(pq) and self._ptable_sole(pt))
             if sharded:
                 kern = self._ingest_sharded_kernels(k, tuple(shard_modes),
                                                     shadow is not None)
@@ -1317,35 +1487,43 @@ class MemoryIndex:
                         kern.ingest, kern.ingest_copy, sole,
                         (arena, edges), "ingest_sharded")
                     new_shadow = None
-                new_ivf = new_pq = None
+                new_ivf = new_pq = new_pt = None
             else:
                 (new_arena, new_edges, new_shadow, new_ivf, new_pq,
-                 flat) = self._guarded(
+                 new_pt, flat) = self._guarded(
                     lambda fn: self._ingest_dispatch(
-                        fn, arena, edges, shadow, ivf, pq, *args, k=k,
+                        fn, arena, edges, shadow, ivf, pq, pt, *args, k=k,
                         shard_modes=shard_modes),
                     S.ingest_dedup_fused, S.ingest_dedup_fused_copy, sole,
-                    (arena, edges, shadow, ivf, pq), "ingest")
-            del arena, edges, shadow, ivf, pq
+                    (arena, edges, shadow, ivf, pq, pt), "ingest")
+            del arena, edges, shadow, ivf, pq, pt
             self.state = new_arena
             self.edge_state = new_edges
             if new_shadow is not None:
                 self._int8_shadow = new_shadow
             self._store_ivf_dev(new_ivf)
             self._store_pq_dev(new_pq)
+            if new_pt is not None:
+                self._ptable = new_pt
+            mirror = None
+            if self._pager is not None and mirror_rows is not None:
+                mirror = (self._pager.alloc(mirror_rows),
+                          self._pager.free_top)
         return (flat, new_shadow is not None, new_ivf is not None,
-                new_pq is not None)
+                new_pq is not None, mirror)
 
     def _ingest_geometry(self, n: int, link_k: int = 3) -> Geometry:
         return Geometry(
             kind="ingest", mode="ingest", batch=max(1, int(n)),
-            rows=self.state.emb.shape[0], dim=self.dim,
+            rows=self.state.salience.shape[0], dim=self.dim,
             k=max(1, int(link_k)),
             dtype_bytes=int(np.dtype(self.dtype).itemsize),
             mesh_parts=self._n_parts, edge_cap=self.edge_state.capacity,
             link_k=max(1, int(link_k)),
             ivf=1 if self._ivf_online_arg() is not None else 0,
-            pq=1 if self._pq_ingest_arg() is not None else 0)
+            pq=1 if self._pq_ingest_arg() is not None else 0,
+            pool_rows=(self.state.emb.shape[0]
+                       if self._pager is not None else 0))
 
     def plan_ingest(self, n: int, link_k: int = 3):
         """Admission decision for an ``n``-fact fused ingest mega-batch
@@ -1394,6 +1572,7 @@ class MemoryIndex:
                 self._ingest_geometry(n, min(link_k, self.state.capacity)),
                 chunkable=False)
         rows = self._alloc_rows(n)
+        self._ensure_pool(rows)
         tid = self.tenant_id(tenant)
         k_eff = min(link_k, self.state.capacity)
         n_modes = len(shard_modes)
@@ -1452,8 +1631,10 @@ class MemoryIndex:
         self._maybe_record_ingest_hbm(dev_args, k_eff, shard_modes, b)
         t0 = time.perf_counter()
         with trace_annotation(f"lz.ingest.{kind}"):
-            flat, shadow_fresh, ivf_fresh, pq_fresh = self._apply_dedup_fused(
-                *dev_args, k=k_eff, shard_modes=shard_modes)
+            (flat, shadow_fresh, ivf_fresh, pq_fresh,
+             page_mirror) = self._apply_dedup_fused(
+                *dev_args, k=k_eff, shard_modes=shard_modes,
+                mirror_rows=rows)
             if not shadow_fresh:
                 self._int8_dirty = True
             if not pq_fresh:
@@ -1467,8 +1648,12 @@ class MemoryIndex:
                               labels={"kind": kind})
         # Device counters riding the same readback: dedup verdicts are the
         # first wide leaf; the link counters trail the per-mode triples,
-        # and the online-IVF leaves (assign, member pos, 4 counters —
-        # ISSUE 12) trail those when the coarse tables were maintained.
+        # the online-IVF leaves (assign, member pos, 4 counters —
+        # ISSUE 12) trail those when the coarse tables were maintained,
+        # and the paged free-list leaves are LAST (ISSUE 17).
+        if self._pager is not None:
+            self._note_page_tail(host[-S.PAGE_INGEST_TAIL:], page_mirror)
+            host = host[:-S.PAGE_INGEST_TAIL]
         ctr = host[3 + 3 * n_modes:]
         self.telemetry.bump("ingest.dispatches",
                             labels={"kind": kind})
@@ -1611,7 +1796,7 @@ class MemoryIndex:
         with self._state_lock:
             pq_on = self._pq_ingest_arg() is not None
         key = ("ingest", b, k_eff, tuple(shard_modes),
-               self.state.emb.shape[0], ivf_on, pq_on)
+               self.state.salience.shape[0], ivf_on, pq_on)
         if key in self._hbm_recorded:
             return
         self._hbm_recorded.add(key)
@@ -1630,14 +1815,14 @@ class MemoryIndex:
                                                      *dev_args)
                 else:
                     lowered = S.ingest_dedup_fused_copy.lower(
-                        arena, edges, shadow, ivf, pq, *dev_args, k=k_eff,
-                        shard_modes=tuple(shard_modes))
+                        arena, edges, shadow, ivf, pq, self._ptable,
+                        *dev_args, k=k_eff, shard_modes=tuple(shard_modes))
             peak = peak_bytes(lowered.compile().memory_analysis())
         except Exception:   # noqa: BLE001 — observability must never block ingest
             return
         if peak is not None:
             labels = {"path": "ingest", "batch": str(b),
-                      "rows": str(self.state.emb.shape[0]),
+                      "rows": str(self.state.salience.shape[0]),
                       "mesh": (f"{self._n_parts}x{self.shard_axis}"
                                if self.mesh is not None else "1")}
             if ivf_on:
@@ -1726,8 +1911,16 @@ class MemoryIndex:
         for r in rows:
             self.row_to_id.pop(r, None)
         padded = S.pad_rows(np.asarray(rows, np.int32), self.state.capacity)
-        self._apply_arena(S.arena_delete, S.arena_delete_copy,
-                          jnp.asarray(padded))
+        if self._pager is not None:
+            # delete + free in ONE dispatch: the rows' pool slots go back
+            # on the free stack (reclaimed HBM, not dead zeros)
+            pushes = self._apply_arena_paged(
+                S.arena_delete_paged, S.arena_delete_paged_copy,
+                jnp.asarray(padded), replay=lambda p: p.free(rows))
+            self.telemetry.bump("arena.page_pushes", pushes)
+        else:
+            self._apply_arena(S.arena_delete, S.arena_delete_copy,
+                              jnp.asarray(padded))
         self._apply_edges(S.edges_delete_for_nodes,
                           S.edges_delete_for_nodes_copy, jnp.asarray(padded))
         self._free_rows.extend(rows)
@@ -1894,11 +2087,12 @@ class MemoryIndex:
             codes = self._pq_codes_for(st, pq_pack)
             scores, rows = ivf_pq_search(
                 cent, members, residual, pq_pack[0].centroids,
-                codes, st.emb, mask, S.normalize(q_pad), k_fetch,
-                nprobe=self.ivf_nprobe, r=max(4 * k_eff, 64))
+                codes, self._emb_logical(st), mask, S.normalize(q_pad),
+                k_fetch, nprobe=self.ivf_nprobe, r=max(4 * k_eff, 64))
         else:
             scores, rows = ivf_search(cent, members, residual,
-                                      st.emb, mask, S.normalize(q_pad),
+                                      self._emb_logical(st), mask,
+                                      S.normalize(q_pad),
                                       k_fetch, nprobe=self.ivf_nprobe)
         return fetch_packed(scores, rows)      # ONE readback RTT
 
@@ -1958,7 +2152,7 @@ class MemoryIndex:
             # demotion) — never cluster them on garbage; the residency-
             # masked shadow coarse path serves them (ISSUE 12).
             mask_np = mask_np & ~self.tiering.cold_np[:len(mask_np)]
-        ivf = build_ivf(st.emb, mask_np, iters=iters,
+        ivf = build_ivf(self._emb_logical(st), mask_np, iters=iters,
                         member_cap_factor=self.ivf_member_cap_factor)
         routed, in_res = self._routed_bitmaps(ivf)
         # writer-side bookkeeping first, the reader-visible pack LAST — a
@@ -1980,7 +2174,7 @@ class MemoryIndex:
             # ``_pq_encode_rows``, grow-time slab pad) until the next
             # re-seed — this is the ONLY full encode (ISSUE 16).
             from lazzaro_tpu.ops.pq import train_pq
-            self._pq_publish(train_pq(st.emb, mask_np), st)
+            self._pq_publish(train_pq(self._emb_logical(st), mask_np), st)
         return True
 
     def ivf_member_repack(self, hole_frac: float = 0.25) -> bool:
@@ -2040,10 +2234,10 @@ class MemoryIndex:
         from lazzaro_tpu.ops.pq import encode_pq
 
         def _codes(arena):
-            codes = encode_pq(book.centroids, arena.emb)
+            codes = encode_pq(book.centroids, self._emb_logical(arena))
             tm = self.tiering
             if tm is not None and tm.cold_count:
-                rows = np.nonzero(tm.cold_np[:arena.emb.shape[0]])[0]
+                rows = np.nonzero(tm.cold_np[:arena.salience.shape[0]])[0]
                 if len(rows):
                     vecs = jnp.asarray(
                         np.asarray(tm.gather_cold(rows.tolist()),
@@ -2077,7 +2271,8 @@ class MemoryIndex:
         mask = np.asarray(st.alive)
         if self.tiering is not None and self.tiering.cold_count:
             mask = mask & ~self.tiering.cold_np[:len(mask)]
-        frac = assignment_staleness(st.emb, mask, dev[0], dev[1])
+        frac = assignment_staleness(self._emb_logical(st), mask,
+                                    dev[0], dev[1])
         self.telemetry.gauge("ivf.assignment_staleness", frac)
         return frac
 
@@ -2091,9 +2286,9 @@ class MemoryIndex:
         when neither the pack nor the arena moved — never against a newer
         book (r5 review: that pairing scores garbage)."""
         book, codes = pack
-        if codes is None or codes.shape[0] != st.emb.shape[0]:
+        if codes is None or codes.shape[0] != st.salience.shape[0]:
             from lazzaro_tpu.ops.pq import encode_pq
-            codes = encode_pq(book.centroids, st.emb)
+            codes = encode_pq(book.centroids, self._emb_logical(st))
             if self._pq_pack is pack and self.state is st:
                 self._pq_pack = (book, codes)
         return codes
@@ -2141,7 +2336,7 @@ class MemoryIndex:
             return cache[4]
         from lazzaro_tpu.ops.ivf import pack_extras
 
-        n = self.state.emb.shape[0]
+        n = self.state.salience.shape[0]
         dev = jnp.asarray(pack_extras(np.asarray(ivf.residual), fresh,
                                       [r for r in supers if r < n]))
         self._ivf_serve_cache = (ivf, fresh, ivf.residual, supers, dev)
@@ -2200,7 +2395,7 @@ class MemoryIndex:
         pq = self._pq_pack
         if pq is None or pq[1] is None:
             return None
-        if pq[1].shape[0] != self.state.emb.shape[0]:
+        if pq[1].shape[0] != self.state.salience.shape[0]:
             return None
         pack = self._ivf_pack
         if pack is None:
@@ -2229,10 +2424,10 @@ class MemoryIndex:
         with self._state_lock:
             shadow = self._int8_shadow
             if (not self._int8_dirty and shadow is not None
-                    and shadow[0].shape[0] == st.emb.shape[0]):
+                    and shadow[0].shape[0] == st.salience.shape[0]):
                 return shadow[0], shadow[1]
         from lazzaro_tpu.ops.quant import quantize_rows
-        shadow = quantize_rows(st.emb)
+        shadow = quantize_rows(self._emb_logical(st))
         tm = self.tiering
         if tm is not None and tm.cold_count:
             # Cold rows hold ZEROS in the master (their exact bytes live
@@ -2240,7 +2435,7 @@ class MemoryIndex:
             # wipe their codes out of the coarse scan — patch them back
             # from the store (codes travel with the demoted row).
             rows, codes, scales = tm.snapshot_codes()
-            keep = rows < st.emb.shape[0]
+            keep = rows < st.salience.shape[0]
             if keep.any():
                 r = jnp.asarray(rows[keep].astype(np.int32))
                 shadow = (shadow[0].at[r].set(jnp.asarray(codes[keep])),
@@ -2280,7 +2475,7 @@ class MemoryIndex:
         id_to_row) — no device readback — and re-uploaded only after an
         edge-topology change. The dirty flag is cleared BEFORE the build,
         so a writer racing past us re-dirties and the next serve rebuilds."""
-        n = st.emb.shape[0]
+        n = st.salience.shape[0]
         cache = self._csr_cache
         if cache is not None and not self._csr_dirty and cache[0] == n:
             return cache[1], cache[2]
@@ -2342,12 +2537,13 @@ class MemoryIndex:
                  if self.serve_ragged else next_pow2(nq))
         st = self.state
         return Geometry(
-            kind="serve", mode=mode, batch=pad_n, rows=st.emb.shape[0],
+            kind="serve", mode=mode, batch=pad_n, rows=st.salience.shape[0],
             dim=self.dim, k=k_bucket,
             dtype_bytes=int(np.dtype(self.dtype).itemsize),
             mesh_parts=self._n_parts, edge_cap=self.edge_state.capacity,
             nprobe=int(self.ivf_nprobe or 0),
-            slack=int(self.coarse_slack))
+            slack=int(self.coarse_slack),
+            pool_rows=(st.emb.shape[0] if st.row_map is not None else 0))
 
     def search_fused_requests(self, reqs, *, cap_take: int, max_nbr: int,
                               super_gate: float, acc_boost: float,
@@ -3130,7 +3326,7 @@ class MemoryIndex:
         if peak is not None:
             labels = {"mode": mode,
                       "k": str(statics.get("k")),
-                      "rows": str(st.emb.shape[0]),
+                      "rows": str(st.salience.shape[0]),
                       "batch": str(int(args[2].shape[0])),
                       "mesh": (f"{self._n_parts}x{self.shard_axis}"
                                if self.mesh is not None else "1")}
@@ -3148,7 +3344,7 @@ class MemoryIndex:
             self.planner.observe_gauge(
                 Geometry(kind="serve", mode=mode,
                          batch=int(args[2].shape[0]),
-                         rows=int(st.emb.shape[0]), dim=self.dim,
+                         rows=int(st.salience.shape[0]), dim=self.dim,
                          k=int(statics.get("k") or 1),
                          dtype_bytes=int(np.dtype(self.dtype).itemsize),
                          mesh_parts=self._n_parts,
@@ -3261,13 +3457,13 @@ class MemoryIndex:
                         "kernel.peak_hbm_bytes", peak,
                         labels={"mode": f"sharded_{mode}",
                                 "k": str(k_bucket),
-                                "rows": str(st.emb.shape[0]),
+                                "rows": str(st.salience.shape[0]),
                                 "batch": str(int(qp.shape[0])),
                                 "mesh": f"{self._n_parts}x{self.shard_axis}"})
                     self.planner.observe_gauge(
                         Geometry(kind="serve", mode=f"sharded_{mode}",
                                  batch=int(qp.shape[0]),
-                                 rows=int(st.emb.shape[0]), dim=self.dim,
+                                 rows=int(st.salience.shape[0]), dim=self.dim,
                                  k=int(k_bucket),
                                  dtype_bytes=int(
                                      np.dtype(self.dtype).itemsize),
@@ -3489,7 +3685,7 @@ class MemoryIndex:
         # bf16 arena goes in as-is (f32 accumulation happens inside the
         # matmul); the chunked kernel bounds HBM to one [512, N] tile.
         top_s, top_j = graphops.pairwise_merge_candidates(
-            self.state.emb, mask, jnp.float32(threshold), k=4)
+            self._emb_logical(self.state), mask, jnp.float32(threshold), k=4)
         top_s, top_j = fetch_packed(top_s, top_j)      # ONE readback RTT
         out = []
         # Only rows with an above-threshold hit reach Python — at 1M rows
@@ -3525,7 +3721,8 @@ class MemoryIndex:
             return None
         if self.tiering is not None and self.tiering.cold_np[r]:
             return np.asarray(self.tiering.gather_cold([r])[0], np.float32)
-        return np.asarray(self.state.emb[r], np.float32)
+        st = self.state
+        return np.asarray(st.emb[S._phys(st, jnp.int32(r))], np.float32)
 
     def pull_numeric(self) -> Dict[str, np.ndarray]:
         """One bulk device→host transfer of mutable numeric columns, for
@@ -3563,7 +3760,14 @@ class MemoryIndex:
     def _alloc_edge_slots(self, n: int) -> List[int]:
         while len(self._free_edge_slots) < n:
             old = self.edge_state.capacity
-            new = self._grown_capacity(old, block=False)
+            if self._pager is not None:
+                # Paged arena: the edge pool grows by whole pages — the
+                # transient copy is O(old + pages), never a doubling spike.
+                deficit = n - len(self._free_edge_slots)
+                new = self._round_capacity(
+                    old + max(deficit, self.page_rows), block=False)
+            else:
+                new = self._grown_capacity(old, block=False)
             self.edge_state = S.grow_edges(self.edge_state, new)
             self._free_edge_slots = list(range(new - 1, old - 1, -1)) + self._free_edge_slots
         return [self._free_edge_slots.pop() for _ in range(n)]

@@ -178,7 +178,9 @@ class MemorySystem:
                                      cfg.hbm_headroom_fraction),
                                  plan_max_splits=cfg.plan_max_splits,
                                  plan_calibration_path=(
-                                     cfg.plan_calibration_path))
+                                     cfg.plan_calibration_path),
+                                 paged=cfg.paged_arena,
+                                 page_rows=cfg.arena_page_rows)
 
         # Tiered memory (ISSUE 8): a hot-row budget attaches the residency
         # manager and (with async on) the background demotion/promotion
@@ -2878,6 +2880,12 @@ Be clinical yet insightful. Do not include conversational filler."""
             # view (None when tiering is off).
             "tier": (self.index.tiering.stats()
                      if self.index.tiering is not None else None),
+            # Paged arena (ISSUE 17): page occupancy + free-list traffic
+            # headline (None when the index is dense). The same gauges/
+            # counters live in the registry snapshot above.
+            "paged_arena": (self.index._page_block()
+                            if getattr(self.index, "_pager", None)
+                            is not None else None),
             "pad_waste_fraction": ((1.0 - live / padded) if padded else 0.0),
             "queue_wait_ms_p50": (float(np.percentile(qw, 50)) if qw
                                   else None),

@@ -1,0 +1,176 @@
+"""Host-side mirror of the device page allocator (ISSUE 17).
+
+The paged arena keeps its free-list ON DEVICE (``state.PageTable``): the
+fused ingest dispatch pops slots with a prefix-sum, and the demote/delete
+kernels push them back — zero extra dispatches. But the host still needs
+to answer, WITHOUT a readback:
+
+- "does the pool have room for this batch?" (pre-dispatch grow decision);
+- "which pages are empty / how fragmented is the pool?" (the
+  ``arena.pages_*`` gauges);
+- "what does the free stack look like?" (checkpoint save without a
+  device fetch, and the parity check against the device's ``free_top``
+  riding the ingest readback tail).
+
+So ``PageAllocator`` REPLAYS every free-list operation at dispatch time,
+under the index's ``_state_lock``, in dispatch order. The device kernels
+were written so each op's effect is computable from host-known inputs
+alone (the dedup ingest allocates for every valid row, dup or not,
+precisely so the host doesn't need the device's dup verdicts to replay
+the pop) — mirror and device therefore agree pop-for-pop, push-for-push,
+and the ``free_top`` parity check is an invariant assertion, not a sync.
+
+Pure numpy; no jax, no state.py import (checkpoint/tests can use it
+standalone).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+class PageAllocator:
+    """LIFO free-stack + row→slot map mirroring the device ``PageTable``.
+
+    ``pool_slots`` usable slots (the device pool has one extra all-zero
+    sentinel slot at index ``pool_slots``). The fresh stack pops slot 0
+    first — matching ``state.init_arena_paged``'s stack layout.
+    ``page_rows`` is the page granularity: pool growth is requested in
+    whole pages and the occupancy gauges aggregate per page.
+    """
+
+    def __init__(self, capacity: int, pool_slots: int, page_rows: int):
+        assert pool_slots >= 1 and page_rows >= 1
+        self.page_rows = int(page_rows)
+        self.pool_slots = int(pool_slots)
+        self.capacity = int(capacity)
+        # stack[i] for i < top are free slots; stack[top-1] pops first
+        self.stack: List[int] = list(range(pool_slots - 1, -1, -1))
+        self.row_slot = np.full((capacity + 1,), -1, np.int64)
+        self.pops_total = 0
+        self.pushes_total = 0
+
+    # ------------------------------------------------------------- state
+    @property
+    def free_top(self) -> int:
+        return len(self.stack)
+
+    @property
+    def bound(self) -> int:
+        return self.pool_slots - len(self.stack)
+
+    def slot_of(self, row: int) -> int:
+        return int(self.row_slot[row])
+
+    # ------------------------------------------------------------ replay
+    def alloc(self, rows: Sequence[int]) -> int:
+        """Replay a device ``_page_alloc`` over ``rows`` (the UNPADDED
+        valid rows of one dispatch, in batch order). Rows already bound
+        are skipped exactly like the kernel's ``need`` mask. Returns the
+        pop count. Raises if the stack runs dry — callers pre-check
+        ``free_top`` and grow the pool BEFORE dispatching."""
+        pops = 0
+        for r in rows:
+            r = int(r)
+            if r >= self.capacity or self.row_slot[r] >= 0:
+                continue
+            if not self.stack:
+                raise RuntimeError(
+                    "paged arena free stack exhausted on the host mirror "
+                    "(pre-dispatch grow check missed)")
+            self.row_slot[r] = self.stack.pop()
+            pops += 1
+        self.pops_total += pops
+        return pops
+
+    def free(self, rows: Sequence[int]) -> int:
+        """Replay a device ``_page_free`` (delete / tier-demote): first
+        occurrence of each bound row pushes its slot; unbound rows and
+        intra-batch duplicates are no-ops, mirroring the kernel's
+        dup-suppression tri-mask. Returns the push count."""
+        pushes = 0
+        seen = set()
+        for r in rows:
+            r = int(r)
+            if r >= self.capacity or r in seen:
+                continue
+            seen.add(r)
+            s = self.row_slot[r]
+            if s < 0:
+                continue
+            self.stack.append(int(s))
+            self.row_slot[r] = -1
+            pushes += 1
+        self.pushes_total += pushes
+        return pushes
+
+    def grow_capacity(self, new_capacity: int) -> None:
+        """Logical growth (mirrors ``grow_arena_paged``): the row→slot
+        map extends unbound; the pool is untouched."""
+        assert new_capacity > self.capacity
+        ext = np.full((new_capacity + 1,), -1, np.int64)
+        ext[: self.capacity] = self.row_slot[: self.capacity]
+        self.row_slot = ext
+        self.capacity = int(new_capacity)
+
+    def grow_pool(self, new_pool_slots: int) -> None:
+        """Pool growth (mirrors ``state.grow_pool``): the old device
+        sentinel slot (index ``pool_slots``) becomes an ordinary free
+        slot, then the brand-new slots — pushed in the SAME deepest-first
+        order as the device, so pop order stays identical."""
+        assert new_pool_slots > self.pool_slots
+        old = self.pool_slots
+        self.stack.append(old)          # the old sentinel slot, reusable
+        self.stack.extend(range(old + 1, new_pool_slots))
+        self.pool_slots = int(new_pool_slots)
+
+    # ------------------------------------------------------------ sizing
+    def slots_for_rows(self, rows: int) -> int:
+        """Round a slot demand up to whole pages."""
+        pages = -(-max(1, int(rows)) // self.page_rows)
+        return pages * self.page_rows
+
+    def need_grow(self, batch_rows: int) -> int:
+        """0 if the free stack covers ``batch_rows`` new bindings, else
+        the new pool_slots target (whole pages, at least doubling the
+        page count so growth stays amortized O(1))."""
+        if len(self.stack) >= batch_rows:
+            return 0
+        deficit = batch_rows - len(self.stack)
+        grown = self.pool_slots + max(self.slots_for_rows(deficit),
+                                      self.pool_slots)
+        return self.slots_for_rows(grown)
+
+    # ------------------------------------------------------- page gauges
+    def page_stats(self) -> Tuple[int, int, float]:
+        """(pages_total, pages_free, fragmentation). A page is FREE when
+        none of its slots is bound — reclaimed capacity the next grow
+        never has to allocate. Fragmentation is the unusable fraction of
+        PARTIALLY-used pages: 1 - bound / (used_pages * page_rows)."""
+        pages_total = -(-self.pool_slots // self.page_rows)
+        if self.bound == 0:
+            return pages_total, pages_total, 0.0
+        bound_rows = np.nonzero(self.row_slot >= 0)[0]
+        slots = self.row_slot[bound_rows]
+        used_pages = np.unique(slots // self.page_rows)
+        pages_free = pages_total - len(used_pages)
+        frag = 1.0 - self.bound / float(len(used_pages) * self.page_rows)
+        return int(pages_total), int(pages_free), float(max(frag, 0.0))
+
+    # --------------------------------------------------- checkpoint glue
+    def export_arrays(self) -> dict:
+        return {
+            "page_stack": np.asarray(self.stack, np.int32),
+            "page_row_slot": self.row_slot.astype(np.int32),
+        }
+
+    @classmethod
+    def from_arrays(cls, capacity: int, pool_slots: int, page_rows: int,
+                    stack: np.ndarray, row_slot: np.ndarray
+                    ) -> "PageAllocator":
+        pa = cls(capacity, pool_slots, page_rows)
+        pa.stack = [int(x) for x in np.asarray(stack).tolist()]
+        pa.row_slot = np.asarray(row_slot, np.int64).copy()
+        return pa

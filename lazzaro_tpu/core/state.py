@@ -68,9 +68,17 @@ TYPE_NAMES = {v: k for k, v in TYPE_IDS.items()}
 @struct.dataclass
 class ArenaState:
     """Node arena. All arrays have leading dim ``capacity + 1`` (last row is
-    the sentinel scratch row)."""
+    the sentinel scratch row).
 
-    emb: jax.Array            # [cap+1, d]  L2-normalized embeddings
+    Paged mode (ISSUE 17): when ``row_map``/``inv_map`` are set, ONLY ``emb``
+    is pool-shaped ``[pool_n, d]`` — every other column stays logical
+    ``[cap+1]``. ``row_map[logical] -> pool slot`` (unmapped rows point at
+    the pool sentinel slot ``pool_n - 1``, which is all-zeros) and
+    ``inv_map[slot] -> logical`` (free slots hold -1; the sentinel slot
+    holds ``capacity``). Dense mode keeps both maps ``None`` and every
+    kernel below reduces to the identity indirection."""
+
+    emb: jax.Array            # [cap+1, d] dense / [pool_n, d] paged
     salience: jax.Array       # [cap+1] f32 in [0, 1]
     timestamp: jax.Array      # [cap+1] f32 seconds (host-epoch offset)
     last_accessed: jax.Array  # [cap+1] f32
@@ -80,14 +88,40 @@ class ArenaState:
     tenant_id: jax.Array      # [cap+1] i32
     alive: jax.Array          # [cap+1] bool
     is_super: jax.Array       # [cap+1] bool
+    row_map: Optional[jax.Array] = None   # [cap+1] i32 logical -> pool slot
+    inv_map: Optional[jax.Array] = None   # [pool_n] i32 pool slot -> logical
 
     @property
     def capacity(self) -> int:
-        return self.emb.shape[0] - 1
+        # salience (not emb): emb is pool-shaped under paging
+        return self.salience.shape[0] - 1
 
     @property
     def dim(self) -> int:
         return self.emb.shape[1]
+
+    @property
+    def pool_rows(self) -> int:
+        """Physical embedding rows (== capacity + 1 when dense)."""
+        return self.emb.shape[0]
+
+
+@struct.dataclass
+class PageTable:
+    """Device-side free-list for the paged embedding pool (ISSUE 17).
+
+    ``free_slots`` is a LIFO stack of pool slot indices with one trailing
+    scratch entry (index ``pool_n - 1``) that absorbs masked pushes, so
+    every push/pop runs with full static-size scatters and no branches.
+    ``free_top`` is the live stack depth (entries below it are free pool
+    slots; the newest free slot — popped first — sits at ``free_top - 1``)."""
+
+    free_slots: jax.Array     # [pool_n] i32 (last entry = scratch)
+    free_top: jax.Array       # [] i32
+
+    @property
+    def stack_cap(self) -> int:
+        return self.free_slots.shape[0] - 1
 
 
 @struct.dataclass
@@ -186,6 +220,228 @@ def grow_edges(state: EdgeState, new_capacity: int) -> EdgeState:
 
 
 # ---------------------------------------------------------------------------
+# Paged arena (ISSUE 17): pool init/growth + the logical<->physical
+# indirection helpers every kernel routes its emb access through. All
+# helpers are the identity when ``row_map`` is None, so dense arenas trace
+# exactly the same programs as before.
+# ---------------------------------------------------------------------------
+
+
+def init_arena_paged(capacity: int, dim: int, pool_slots: int,
+                     dtype=jnp.float32) -> Tuple[ArenaState, PageTable]:
+    """Paged arena: logical columns at ``[cap+1]``, emb pool at
+    ``[pool_slots + 1, d]`` (last slot = all-zero pool sentinel). The free
+    stack starts full, ordered so slot 0 pops first (host mirror parity)."""
+    n = capacity + 1
+    pool_n = pool_slots + 1
+    base = init_arena(capacity, dim, dtype)
+    state = base.replace(
+        emb=jnp.zeros((pool_n, dim), dtype=dtype),
+        row_map=jnp.full((n,), pool_n - 1, jnp.int32),
+        inv_map=jnp.full((pool_n,), -1, jnp.int32).at[pool_n - 1]
+                   .set(capacity),
+    )
+    ptable = PageTable(
+        free_slots=jnp.concatenate([
+            jnp.arange(pool_n - 2, -1, -1, dtype=jnp.int32),
+            jnp.zeros((1,), jnp.int32)]),
+        free_top=jnp.int32(pool_n - 1),
+    )
+    return state, ptable
+
+
+def grow_arena_paged(state: ArenaState, new_capacity: int) -> ArenaState:
+    """Logical growth WITHOUT touching the embedding pool: metadata columns
+    realloc+copy (a few MB), ``row_map`` extends with pool-sentinel fill,
+    and the ``[pool_n, d]`` emb buffer — the term that dominates arena
+    bytes — is carried over by reference. This is the copy-free growth
+    claim: O(metadata), never O(N·d). The pool grows independently (and by
+    page multiples) via ``grow_pool`` when free slots run out."""
+    old = state.capacity
+    assert new_capacity > old
+    assert state.row_map is not None
+    pool_sent = state.emb.shape[0] - 1
+    fresh = init_arena(new_capacity, state.dim, state.emb.dtype)
+
+    def copy(new, cur):
+        return new.at[:old].set(cur[:old])
+
+    n = new_capacity + 1
+    return state.replace(
+        salience=copy(fresh.salience, state.salience),
+        timestamp=copy(fresh.timestamp, state.timestamp),
+        last_accessed=copy(fresh.last_accessed, state.last_accessed),
+        access_count=copy(fresh.access_count, state.access_count),
+        type_id=copy(fresh.type_id, state.type_id),
+        shard_id=copy(fresh.shard_id, state.shard_id),
+        tenant_id=copy(fresh.tenant_id, state.tenant_id),
+        alive=copy(fresh.alive, state.alive),
+        is_super=copy(fresh.is_super, state.is_super),
+        row_map=jnp.full((n,), pool_sent, jnp.int32)
+                   .at[:old].set(state.row_map[:old]),
+        inv_map=jnp.where(state.inv_map == old, new_capacity,
+                          state.inv_map),
+    )
+
+
+def grow_pool(state: ArenaState, ptable: PageTable, new_pool_slots: int
+              ) -> Tuple[ArenaState, PageTable]:
+    """Grow the physical embedding pool by whole pages (host-side, rare).
+    Copies the OLD pool rows only (pool ≈ live set, not logical capacity),
+    rebinds the sentinel slot to the new last index, converts the old
+    sentinel slot into an ordinary free slot (it is all-zero and unbound),
+    and pushes the freed slots in ONE fixed order (old sentinel first,
+    then the new slots ascending) — the host mirror replays the same
+    order, so device and mirror stay pop-for-pop identical."""
+    assert state.row_map is not None
+    old_pool_n = state.emb.shape[0]
+    new_pool_n = new_pool_slots + 1
+    assert new_pool_n > old_pool_n
+    old_sent = old_pool_n - 1
+    new_sent = new_pool_n - 1
+    cap = state.capacity
+    emb = jnp.zeros((new_pool_n, state.dim), state.emb.dtype)
+    emb = emb.at[:old_pool_n].set(state.emb)
+    row_map = jnp.where(state.row_map == old_sent, new_sent, state.row_map)
+    inv_map = jnp.full((new_pool_n,), -1, jnp.int32)
+    inv_map = inv_map.at[:old_pool_n].set(state.inv_map)
+    inv_map = inv_map.at[old_sent].set(-1).at[new_sent].set(cap)
+    # new free slots, deepest-first push order: old sentinel, then the
+    # new slots ascending (so the highest new slot pops first)
+    added = np.concatenate([
+        np.asarray([old_sent], np.int32),
+        np.arange(old_pool_n, new_sent, dtype=np.int32)])
+    top = int(ptable.free_top)
+    free = np.full((new_pool_n,), 0, np.int32)
+    free[:top] = np.asarray(ptable.free_slots)[:top]
+    free[top:top + len(added)] = added
+    return (state.replace(emb=emb, row_map=row_map, inv_map=inv_map),
+            PageTable(free_slots=jnp.asarray(free),
+                      free_top=jnp.int32(top + len(added))))
+
+
+def _nrows(state: ArenaState) -> int:
+    """Logical row count ``cap + 1`` (emb.shape[0] is pool-shaped when
+    paged — every full-corpus scan sizes by a logical column instead)."""
+    return state.salience.shape[0]
+
+
+def _phys(state: ArenaState, rows: jax.Array) -> jax.Array:
+    """Logical row indices -> physical emb rows (identity when dense).
+    Unbound logical rows — including the logical sentinel — land on the
+    all-zero pool sentinel slot, so stray gathers read zeros and stray
+    scatters are absorbed exactly like the dense scratch row."""
+    if state.row_map is None:
+        return rows
+    return state.row_map[rows]
+
+
+def _pool_mask(state: ArenaState, mask: jax.Array) -> jax.Array:
+    """Re-index a logical ``[cap+1]`` bool mask into pool space
+    ``[pool_n]`` for whole-corpus scans over the paged emb. Free pool
+    slots (inv_map == -1) are masked off."""
+    if state.row_map is None:
+        return mask
+    inv = state.inv_map
+    return mask[jnp.maximum(inv, 0)] & (inv >= 0)
+
+
+def _pool_col(state: ArenaState, col: jax.Array) -> jax.Array:
+    """Re-index a logical per-row column (e.g. shard_id) into pool space
+    so row-wise compares line up with a pool-space scan. Free slots read
+    row 0's value — callers must pair this with a ``_pool_mask``-derived
+    validity mask."""
+    if state.row_map is None:
+        return col
+    return col[jnp.maximum(state.inv_map, 0)]
+
+
+def _pool_to_logical(state: ArenaState, rows: jax.Array) -> jax.Array:
+    """Pool-space top-k survivor indices -> logical rows (identity when
+    dense). Free slots map to the logical sentinel ``capacity``."""
+    if state.row_map is None:
+        return rows
+    inv = state.inv_map[rows]
+    return jnp.where(inv >= 0, inv, jnp.int32(state.capacity))
+
+
+def _page_alloc(state: ArenaState, ptable: PageTable, rows: jax.Array,
+                live: jax.Array
+                ) -> Tuple[ArenaState, PageTable, jax.Array, jax.Array]:
+    """Bind pool slots to logical ``rows`` inside a fused dispatch:
+    prefix-sum pop from the free stack (the PR 3 edge-slot compactor
+    idiom). Rows already bound, sentinel-padded rows, and ``~live`` rows
+    allocate nothing. Returns ``(state, ptable, pops, overflow)`` — the
+    pop count and an exhaustion flag ride the packed readback tail; the
+    host pre-grows the pool so overflow is a can't-happen guard, not a
+    recovery path (an exhausted pop leaves the row unbound, its scatters
+    absorbed by the pool sentinel)."""
+    cap = state.capacity
+    pool_sent = state.emb.shape[0] - 1
+    # suppress duplicate rows within the batch: only the FIRST occurrence
+    # pops (same tri-mask as _page_free — keeps the host mirror's replay
+    # pop-for-pop when one batch names a row twice)
+    eq = rows[:, None] == rows[None, :]
+    first = ~jnp.any(eq & (jnp.arange(rows.shape[0])[:, None]
+                           > jnp.arange(rows.shape[0])[None, :]), axis=1)
+    need = (first & live & (rows < cap)
+            & (state.row_map[rows] == pool_sent))
+    rank = jnp.cumsum(need.astype(jnp.int32)) - 1
+    idx = ptable.free_top - 1 - rank
+    ok = need & (idx >= 0)
+    slots = jnp.where(ok, ptable.free_slots[jnp.maximum(idx, 0)],
+                      pool_sent)
+    rows_b = jnp.where(ok, rows, cap)
+    row_map = state.row_map.at[rows_b].set(slots.astype(jnp.int32))
+    inv_map = state.inv_map.at[slots].set(rows_b.astype(jnp.int32))
+    # re-pin the sentinel bindings every masked scatter routed through them
+    row_map = row_map.at[cap].set(pool_sent)
+    inv_map = inv_map.at[pool_sent].set(cap)
+    pops = ok.sum().astype(jnp.int32)
+    overflow = (need & ~ok).any()
+    return (state.replace(row_map=row_map, inv_map=inv_map),
+            ptable.replace(free_top=ptable.free_top - pops),
+            pops, overflow)
+
+
+def _page_free(state: ArenaState, ptable: PageTable, rows: jax.Array
+               ) -> Tuple[ArenaState, PageTable, jax.Array]:
+    """Unbind logical ``rows`` from their pool slots and push the slots
+    back on the free stack (delete + tier-demote reclamation). Freed
+    slots' emb rows are ZEROED — bit-parity with the dense
+    commit-then-zero demote, and re-allocation hands out clean rows.
+    Unbound/sentinel rows and intra-batch duplicates push nothing (their
+    scatters land on the stack scratch entry)."""
+    cap = state.capacity
+    pool_sent = state.emb.shape[0] - 1
+    slots = state.row_map[rows]
+    # suppress duplicate rows within the batch: only the FIRST occurrence
+    # pushes (a tri-mask over pairwise equality, B is a padded bucket)
+    eq = rows[:, None] == rows[None, :]
+    first = ~jnp.any(eq & (jnp.arange(rows.shape[0])[:, None]
+                           > jnp.arange(rows.shape[0])[None, :]), axis=1)
+    do = first & (rows < cap) & (slots < pool_sent)
+    rank = jnp.cumsum(do.astype(jnp.int32)) - 1
+    stack_cap = ptable.free_slots.shape[0] - 1
+    pos = jnp.where(do, jnp.minimum(ptable.free_top + rank, stack_cap),
+                    stack_cap)
+    slots_b = jnp.where(do, slots, pool_sent)
+    rows_b = jnp.where(do, rows, cap)
+    free_slots = ptable.free_slots.at[pos].set(
+        jnp.where(do, slots, ptable.free_slots[stack_cap]).astype(jnp.int32))
+    row_map = state.row_map.at[rows_b].set(pool_sent)
+    inv_map = state.inv_map.at[slots_b].set(-1)
+    row_map = row_map.at[cap].set(pool_sent)
+    inv_map = inv_map.at[pool_sent].set(cap)
+    emb = state.emb.at[slots_b].set(0)
+    pushes = do.sum().astype(jnp.int32)
+    return (state.replace(emb=emb, row_map=row_map, inv_map=inv_map),
+            ptable.replace(free_slots=free_slots,
+                           free_top=ptable.free_top + pushes),
+            pushes)
+
+
+# ---------------------------------------------------------------------------
 # Jitted mutation kernels. Index vectors are sentinel-padded on the host
 # (see pad_rows) so shapes bucket to powers of two. Each kernel is one impl
 # jitted twice: the donated default (zero-copy in-place scatter) and a
@@ -239,8 +495,15 @@ def _arena_add(
     is_super: jax.Array,    # [B] bool
 ) -> ArenaState:
     emb = normalize(emb).astype(state.emb.dtype)
+    new_emb = state.emb.at[_phys(state, rows)].set(emb)
+    if state.row_map is not None:
+        # the pool sentinel absorbs padded/dup scatters but must STAY
+        # all-zero: every unbound logical row aliases it, and tiered
+        # rescore reads those zeros for bit-parity with the dense
+        # demote-zeroed rows
+        new_emb = new_emb.at[state.emb.shape[0] - 1].set(0)
     return state.replace(
-        emb=state.emb.at[rows].set(emb),
+        emb=new_emb,
         salience=state.salience.at[rows].set(salience),
         timestamp=state.timestamp.at[rows].set(timestamp),
         last_accessed=state.last_accessed.at[rows].set(timestamp),
@@ -409,7 +672,9 @@ def arena_search(
     (pallas_call has no GSPMD partitioning rule) or go through the
     shard_map composition in ``ops/topk.make_sharded_topk``."""
     q = normalize(jnp.atleast_2d(query)).astype(state.emb.dtype)
-    mask = arena_mask(state, tenant, super_filter)
+    # paged arenas scan the emb POOL: the logical mask re-indexes into pool
+    # space (free slots masked off) and survivors map back to logical rows
+    mask = _pool_mask(state, arena_mask(state, tenant, super_filter))
     n, nq = state.emb.shape[0], q.shape[0]
     use_pallas = impl == "pallas" or (
         impl == "auto"
@@ -421,12 +686,13 @@ def arena_search(
         top_scores, top_rows = masked_topk_arena(state.emb, mask, q, k)
     else:
         def chunk(q_c):
-            scores = nt_dot(q_c, state.emb)                       # [C, cap+1]
+            scores = nt_dot(q_c, state.emb)                       # [C, pool]
             return jax.lax.top_k(jnp.where(mask[None, :], scores, NEG_INF), k)
 
         # Big query fleets stream through [512, cap+1] tiles inside ONE
         # dispatch (HBM-bounded; one host round trip for the whole batch).
         top_scores, top_rows = chunked_map(chunk, q)
+    top_rows = _pool_to_logical(state, top_rows)
     if query.ndim == 1:
         return top_scores[0], top_rows[0]
     return top_scores, top_rows
@@ -455,14 +721,15 @@ def _arena_link_candidates_multi(
     backend charges ~70 ms per readback, r4 measurement; the old host-side
     chunk loop paid it per 512 rows). Returns ``(scores, rows)`` pairs
     flattened in ``shard_modes`` order."""
-    mask = state.alive & (state.tenant_id == tenant) & ~state.is_super
+    lmask = state.alive & (state.tenant_id == tenant) & ~state.is_super
     # exclude the new rows themselves from candidates
-    excl = jnp.zeros((state.emb.shape[0],), bool).at[excl_rows].set(True)
-    mask = mask & ~excl
+    excl = jnp.zeros((_nrows(state),), bool).at[excl_rows].set(True)
+    mask = _pool_mask(state, lmask & ~excl)       # pool-space scan mask
+    shard_pool = _pool_col(state, state.shard_id)
 
     def chunk(rows_c):
-        q = state.emb[rows_c]                     # [C, d]
-        scores = nt_dot(q, state.emb)             # [C, cap+1]
+        q = state.emb[_phys(state, rows_c)]       # [C, d]
+        scores = nt_dot(q, state.emb)             # [C, pool]
         same = None
         outs = []
         for sm in shard_modes:
@@ -470,9 +737,10 @@ def _arena_link_candidates_multi(
             if sm != 0:
                 if same is None:
                     same = (state.shard_id[rows_c][:, None]
-                            == state.shard_id[None, :])
+                            == shard_pool[None, :])
                 full_mask = full_mask & (same if sm == 1 else ~same)
-            outs.extend(jax.lax.top_k(jnp.where(full_mask, scores, NEG_INF), k))
+            s, r = jax.lax.top_k(jnp.where(full_mask, scores, NEG_INF), k)
+            outs.extend((s, _pool_to_logical(state, r)))
         return tuple(outs)
 
     return chunked_map(chunk, new_rows)
@@ -528,7 +796,7 @@ def arena_mean_embedding(state: ArenaState, rows: jax.Array) -> jax.Array:
     """Mean of child embeddings → super-node centroid (memory_system.py:916-917).
     Sentinel-padded rows contribute zero weight."""
     valid = (rows < state.capacity)[:, None].astype(jnp.float32)
-    embs = state.emb[rows].astype(jnp.float32) * valid
+    embs = state.emb[_phys(state, rows)].astype(jnp.float32) * valid
     mean = embs.sum(0) / jnp.maximum(valid.sum(), 1.0)
     return normalize(mean)
 
@@ -759,6 +1027,7 @@ def _ingest_fused(
     shadow,                  # (q8 [cap+1, d] i8, scale [cap+1] f32) or None
     ivf,                     # (cent [C,d], members [C,M], counts [C]) or None
     pq,                      # (book_cent [m,256,dsub], codes [cap+1,m]) or None
+    ptable,                  # PageTable or None (dense arena)
     rows: jax.Array,         # [B] i32 new-node rows, sentinel-padded
     emb: jax.Array,          # [B, d]
     salience: jax.Array,     # [B] f32
@@ -806,9 +1075,20 @@ def _ingest_fused(
     (``_ivf_online_update``; the extra readback leaves trail the link
     counters). With PQ serving on, the written rows' m-byte codes are
     re-encoded against the frozen codebook in the same program
-    (``_pq_scatter``) — no extra dispatches, no extra readback leaves."""
+    (``_pq_scatter``) — no extra dispatches, no extra readback leaves.
+    With a paged arena (``ptable`` threaded), every valid row binds a pool
+    slot via the prefix-sum free-stack pop FIRST (``_page_alloc``), and
+    the pop count / post-pop stack depth / overflow flag ride the SAME
+    packed readback as trailing leaves (``PAGE_INGEST_TAIL``) — paging
+    adds an int32 gather to the scatters and scans, never a dispatch."""
     qf = normalize(emb)
     emb_stored = qf.astype(arena.emb.dtype)
+    valid_q = rows < arena.capacity        # sentinel-padded rows make no edges
+    page_tail = ()
+    if ptable is not None:
+        arena, ptable, pops, p_over = _page_alloc(arena, ptable, rows,
+                                                  valid_q)
+        page_tail = (pops, ptable.free_top, p_over.astype(jnp.int32))
     arena = _arena_add(arena, rows, emb, salience, timestamp, type_id,
                        shard_id, tenant_id, is_super)
     shadow = _shadow_scatter(shadow, rows, emb_stored)
@@ -820,7 +1100,6 @@ def _ingest_fused(
     edges = _edges_add(edges, chain_slots, chain_src, chain_tgt, chain_w,
                        jnp.ones((n_chain,), jnp.int32), now, tenant,
                        chain_src >= 0)
-    valid_q = rows < arena.capacity        # sentinel-padded rows make no edges
     edges, outs = _gated_link_insert(edges, link_flat, link_pool, pool_len,
                                      rows, valid_q, now, tenant, link_gate,
                                      link_scale, shard_modes)
@@ -831,7 +1110,10 @@ def _ingest_fused(
         outs = outs + tuple(
             jnp.broadcast_to(x[:, None], leaf) for x in (a_rb, p_rb)
         ) + tuple(jnp.broadcast_to(t, leaf) for t in tail)
-    return arena, edges, shadow, ivf, pq, outs
+    if page_tail:
+        leaf = outs[0].shape
+        outs = outs + tuple(jnp.broadcast_to(t, leaf) for t in page_tail)
+    return arena, edges, shadow, ivf, pq, ptable, outs
 
 
 def _gated_link_insert(edges, link_flat, link_pool, pool_len, src_rows,
@@ -913,8 +1195,10 @@ def _gated_link_insert(edges, link_flat, link_pool, pool_len, src_rows,
     return edges, tuple(outs)
 
 
+PAGE_INGEST_TAIL = 3  # trailing paged leaves: pops, free_top, overflow
+
 ingest_fused, ingest_fused_copy = _donated_pair(
-    _ingest_fused, donate=(0, 1, 2, 3, 4),
+    _ingest_fused, donate=(0, 1, 2, 3, 4, 5),
     static_argnames=("k", "shard_modes"))
 
 
@@ -961,23 +1245,27 @@ def _ingest_scan_core(state: ArenaState, qd: jax.Array, q_shard: jax.Array,
     ``with_probe=False`` (the non-dedup sharded program) skips the probe
     group — the link modes alone, post-add semantics — and then
     ``probe_excl`` only shapes the link mask."""
-    pmask = (state.alive & (state.tenant_id == tenant)
-             & ~state.is_super & ~probe_excl)
-    lmask = pmask & ~link_excl
+    pmask = _pool_mask(state, state.alive & (state.tenant_id == tenant)
+                       & ~state.is_super & ~probe_excl)
+    lmask = pmask & ~_pool_mask(state, link_excl)
+    shard_pool = _pool_col(state, state.shard_id)
 
     def body(q_c, qs_c):
-        scores = nt_dot(q_c, state.emb)               # [C, rows] f32
-        outs = (list(jax.lax.top_k(
-            jnp.where(pmask[None, :], scores, NEG_INF), 1))
-            if with_probe else [])
+        scores = nt_dot(q_c, state.emb)               # [C, pool rows] f32
+        outs = []
+        if with_probe:
+            s, r = jax.lax.top_k(
+                jnp.where(pmask[None, :], scores, NEG_INF), 1)
+            outs.extend((s, _pool_to_logical(state, r)))
         same = None
         for sm in shard_modes:
             m = lmask[None, :]
             if sm != 0:
                 if same is None:
-                    same = qs_c[:, None] == state.shard_id[None, :]
+                    same = qs_c[:, None] == shard_pool[None, :]
                 m = m & (same if sm == 1 else ~same)
-            outs.extend(jax.lax.top_k(jnp.where(m, scores, NEG_INF), k))
+            s, r = jax.lax.top_k(jnp.where(m, scores, NEG_INF), k)
+            outs.extend((s, _pool_to_logical(state, r)))
         return tuple(outs)
 
     return chunked_map_multi(body, (qd, q_shard), chunk=chunk)
@@ -1030,6 +1318,7 @@ def _ingest_dedup_fused(
     shadow,                  # (q8 [cap+1, d] i8, scale [cap+1] f32) or None
     ivf,                     # (cent [C,d], members [C,M], counts [C]) or None
     pq,                      # (book_cent [m,256,dsub], codes [cap+1,m]) or None
+    ptable,                  # PageTable or None (dense arena)
     rows: jax.Array,         # [B] i32 candidate row per fact, sentinel-padded
     emb: jax.Array,          # [B, d]
     salience: jax.Array,     # [B] f32 (doubles as the merge-touch candidate)
@@ -1067,6 +1356,19 @@ def _ingest_dedup_fused(
     valid = rows < cap
     qf = normalize(emb)                    # f32 — intra gram parity w/ host
     qd = qf.astype(arena.emb.dtype)        # arena dtype — probe parity
+
+    # Paged arena: bind a pool slot to EVERY valid row up front — dup
+    # verdicts aren't known until the resolve, and allocating for the
+    # whole batch keeps the free-stack op replayable on the host mirror
+    # at dispatch time (LIFO order parity under concurrent demote pushes).
+    # Dup rows keep their slots bound-but-dead: their logical rows are
+    # never alive, the host reuses them first (its row free-list is LIFO
+    # too), so over-residency is bounded by one batch.
+    page_tail = ()
+    if ptable is not None:
+        arena, ptable, pops, p_over = _page_alloc(arena, ptable, rows,
+                                                  valid)
+        page_tail = (pops, ptable.free_top, p_over.astype(jnp.int32))
 
     # ONE whole-arena score matrix feeds BOTH the pre-add dedup probe and
     # the per-mode link scans (_ingest_scan_core): the probe sees the same
@@ -1112,15 +1414,17 @@ def _ingest_dedup_fused(
         outs = outs + tuple(
             jnp.broadcast_to(x[:, None], (b, k)) for x in (a_rb, p_rb)
         ) + tuple(jnp.broadcast_to(t, (b, k)) for t in tail)
+    if page_tail:
+        outs = outs + tuple(jnp.broadcast_to(t, (b, k)) for t in page_tail)
     # [B] verdicts broadcast to [B, k] so every readback leaf has one shape
     # and the host fetches them all in ONE packed transfer
     wide = tuple(jnp.broadcast_to(a[:, None], (b, k))
                  for a in (dup.astype(jnp.int32), target, chain_src))
-    return arena, edges, shadow, ivf, pq, wide + outs
+    return arena, edges, shadow, ivf, pq, ptable, wide + outs
 
 
 ingest_dedup_fused, ingest_dedup_fused_copy = _donated_pair(
-    _ingest_dedup_fused, donate=(0, 1, 2, 3, 4),
+    _ingest_dedup_fused, donate=(0, 1, 2, 3, 4, 5),
     static_argnames=("k", "shard_modes"))
 
 
@@ -1668,14 +1972,17 @@ def _exact_two_tier(state: ArenaState, q_c: jax.Array, tenant_c: jax.Array,
     XLA (CPU at least) splits the consumers into two full [C, cap] sorts —
     measured 2.4× on the whole fused program at 65k rows."""
     qn = normalize(q_c).astype(state.emb.dtype)
-    scores = nt_dot(qn, state.emb)                        # [C, rows] f32
-    alive_t = state.alive[None, :] & (
-        state.tenant_id[None, :] == tenant_c[:, None])
-    sup = state.is_super[None, :]
+    scores = nt_dot(qn, state.emb)                        # [C, pool rows] f32
+    alive_p = _pool_mask(state, state.alive)
+    ten_p = _pool_col(state, state.tenant_id)
+    alive_t = alive_p[None, :] & (ten_p[None, :] == tenant_c[:, None])
+    sup = _pool_col(state, state.is_super)[None, :]
     gate_s, gate_r = jax.lax.top_k(
         jnp.where(alive_t & sup, scores, NEG_INF), k_gate)
     ann_s, ann_r = jax.lax.top_k(
         jnp.where(alive_t & ~sup, scores, NEG_INF), k_ann)
+    gate_r = _pool_to_logical(state, gate_r)
+    ann_r = _pool_to_logical(state, ann_r)
     return jax.lax.optimization_barrier((gate_s, gate_r, ann_s, ann_r))
 
 
@@ -1771,7 +2078,7 @@ def _boost_scatter(state: ArenaState, acc_rows: jax.Array,
     count); the shard-local scatters route non-owned rows OUT of range
     instead — XLA drops out-of-bounds scatter updates — so they pass
     ``zero_last=False``."""
-    n = state.emb.shape[0]
+    n = _nrows(state)
     acc_cnt = jnp.zeros((n,), jnp.int32).at[acc_rows.reshape(-1)].add(1)
     nbr_cnt = jnp.zeros((n,), jnp.int32).at[nbr_rows.reshape(-1)].add(1)
     if zero_last:
@@ -1886,7 +2193,7 @@ def _quant_two_tier(state: ArenaState, q8a: jax.Array, scale_a: jax.Array,
     the threshold comparison itself."""
     from lazzaro_tpu.ops.quant import quantize_rows
 
-    n = state.emb.shape[0]
+    n = _nrows(state)
     k_fetch = min(k + slack, n)
     g_fetch = min(1 + slack, n)
     qn = normalize(q_c)                                   # [C, d] f32
@@ -1911,7 +2218,7 @@ def _quant_two_tier(state: ArenaState, q8a: jax.Array, scale_a: jax.Array,
     qd = qn.astype(state.emb.dtype)
 
     def rescore(rows_c, coarse_s):
-        g = state.emb[rows_c]                             # [C, kf, d]
+        g = state.emb[_phys(state, rows_c)]               # [C, kf, d]
         ex = jnp.einsum("cd,ckd->ck", qd, g,
                         preferred_element_type=jnp.float32)
         return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
@@ -2073,6 +2380,70 @@ def _tier_promote(state: ArenaState, rows: jax.Array,
 tier_promote, tier_promote_copy = _donated_pair(_tier_promote)
 
 
+def _tier_demote_paged(state: ArenaState, ptable: PageTable,
+                       rows: jax.Array
+                       ) -> Tuple[ArenaState, PageTable, jax.Array]:
+    """Paged demote: surrender the rows' pool slots back to the free
+    stack (``_page_free`` zeroes the slots — the paged analogue of the
+    dense zero-scatter, except the bytes become REUSABLE capacity instead
+    of dead zeros). Emptied pages are real reclaimed HBM the next grow
+    never has to allocate."""
+    return _page_free(state, ptable, rows)
+
+
+tier_demote_paged, tier_demote_paged_copy = _donated_pair(
+    _tier_demote_paged, donate=(0, 1))
+
+
+def _tier_promote_paged(state: ArenaState, ptable: PageTable,
+                        rows: jax.Array, vecs: jax.Array
+                        ) -> Tuple[ArenaState, PageTable, jax.Array]:
+    """Paged promote: re-bind pool slots (prefix-sum pop; the host
+    pre-checks its mirror so the stack never runs dry mid-dispatch) and
+    scatter the cold store's exact bytes at the fresh physical rows."""
+    valid = rows < state.capacity
+    state, ptable, pops, _ = _page_alloc(state, ptable, rows, valid)
+    state = state.replace(emb=state.emb.at[_phys(state, rows)].set(
+        vecs.astype(state.emb.dtype)))
+    return state, ptable, pops
+
+
+tier_promote_paged, tier_promote_paged_copy = _donated_pair(
+    _tier_promote_paged, donate=(0, 1))
+
+
+def _arena_delete_paged(state: ArenaState, ptable: PageTable,
+                        rows: jax.Array
+                        ) -> Tuple[ArenaState, PageTable, jax.Array]:
+    """Delete + free: the dense ``_arena_delete`` column scrub plus the
+    pool-slot push — deleted rows' HBM is immediately reusable."""
+    state = _arena_delete(state, rows)
+    return _page_free(state, ptable, rows)
+
+
+arena_delete_paged, arena_delete_paged_copy = _donated_pair(
+    _arena_delete_paged, donate=(0, 1))
+
+
+def _arena_add_paged(state: ArenaState, ptable: PageTable, rows: jax.Array,
+                     emb: jax.Array, salience: jax.Array,
+                     timestamp: jax.Array, type_id: jax.Array,
+                     shard_id: jax.Array, tenant_id: jax.Array,
+                     is_super: jax.Array
+                     ) -> Tuple[ArenaState, PageTable, jax.Array]:
+    """Direct (non-fused) paged add: bind slots, then the usual column
+    scatters with the emb write routed through ``row_map``."""
+    valid = rows < state.capacity
+    state, ptable, pops, _ = _page_alloc(state, ptable, rows, valid)
+    state = _arena_add(state, rows, emb, salience, timestamp, type_id,
+                       shard_id, tenant_id, is_super)
+    return state, ptable, pops
+
+
+arena_add_paged, arena_add_paged_copy = _donated_pair(
+    _arena_add_paged, donate=(0, 1))
+
+
 def _tiered_two_tier(state: ArenaState, q8a: jax.Array, scale_a: jax.Array,
                      cold: jax.Array, q_c: jax.Array, tenant_c: jax.Array,
                      k: int, slack: int):
@@ -2085,7 +2456,7 @@ def _tiered_two_tier(state: ArenaState, q8a: jax.Array, scale_a: jax.Array,
     final re-rank) over the SAME candidate set without re-running the
     scan, plus the per-query cold flag. Super rows are pinned hot by the
     tiering policy, so the gate verdict is always exact."""
-    n = state.emb.shape[0]
+    n = _nrows(state)
     k_fetch = min(k + slack, n)
     g_fetch = min(1 + slack, n)
     qn = normalize(q_c)                                   # [C, d] f32
@@ -2110,7 +2481,10 @@ def _tiered_two_tier(state: ArenaState, q8a: jax.Array, scale_a: jax.Array,
     qd = qn.astype(state.emb.dtype)
 
     def rescore(rows_c, coarse_s):
-        g = state.emb[rows_c]                             # [C, kf, d]
+        # cold rows are UNBOUND under paging: _phys routes them to the
+        # all-zero pool sentinel, so their exact rescore is 0 — exactly
+        # the dense demote-zeroed read (the blend keeps coarse either way)
+        g = state.emb[_phys(state, rows_c)]               # [C, kf, d]
         ex = jnp.einsum("cd,ckd->ck", qd, g,
                         preferred_element_type=jnp.float32)
         return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
@@ -2473,13 +2847,13 @@ def _ivf_two_tier(state: ArenaState, shadow, centroids: jax.Array,
     qd = qn.astype(state.emb.dtype)
 
     def rescore(rows_c, coarse_s):
-        g = state.emb[rows_c]                         # [C, kf, d]
+        g = state.emb[_phys(state, rows_c)]           # [C, kf, d]
         ex = jnp.einsum("cd,ckd->ck", qd, g,
                         preferred_element_type=jnp.float32)
         return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
 
     if shadow is None:
-        vecs = state.emb[safe]                        # [C, L, d]
+        vecs = state.emb[_phys(state, safe)]          # [C, L, d]
         sc = jnp.einsum("cd,cld->cl", qd, vecs,
                         preferred_element_type=jnp.float32)
         a_s0, a_pos = jax.lax.top_k(
@@ -2884,7 +3258,7 @@ def _ivf_tiered_two_tier(state: ArenaState, q8a: jax.Array,
     from lazzaro_tpu.ops.quant import quantize_rows
 
     cap = state.capacity
-    n = state.emb.shape[0]
+    n = _nrows(state)
     L = nprobe * members.shape[1] + extras.shape[0]
     k_fetch = min(k + slack, L + n)
     k_hot = min(k + slack, L)
@@ -2902,7 +3276,7 @@ def _ivf_tiered_two_tier(state: ArenaState, q8a: jax.Array,
         valid = valid & (~in_members[None, :]
                          | (rank[None, :] < nprobe_c[:, None]))
     sup = state.is_super[safe]
-    vecs = state.emb[safe]                                # [C, L, d]
+    vecs = state.emb[_phys(state, safe)]                  # [C, L, d]
     sc = jnp.einsum("cd,cld->cl", qd, vecs,
                     preferred_element_type=jnp.float32)
     h_s, h_pos = jax.lax.top_k(jnp.where(valid & ~sup, sc, NEG_INF), k_hot)
@@ -3199,7 +3573,7 @@ def _pq_two_tier(state: ArenaState, book_cent: jax.Array, codes: jax.Array,
     # exact rescore of the few survivors from the master — scores and the
     # gate verdict never see ADC error (same contract as the int8 path)
     def rescore(rows_c, coarse_s):
-        g = state.emb[rows_c]                         # [C, kf, d]
+        g = state.emb[_phys(state, rows_c)]           # [C, kf, d]
         ex = jnp.einsum("cd,ckd->ck", qd, g,
                         preferred_element_type=jnp.float32)
         return jnp.where(coarse_s > NEG_INF / 2, ex, NEG_INF)
@@ -3428,7 +3802,7 @@ def _pq_tiered_two_tier(state: ArenaState, book_cent: jax.Array,
     from lazzaro_tpu.ops.ivf import gather_rows
 
     cap = state.capacity
-    n = state.emb.shape[0]
+    n = _nrows(state)
     L = nprobe * members.shape[1] + extras.shape[0]
     k_fetch = min(k + slack, L + n)
     k_hot = min(k + slack, L)
@@ -3446,7 +3820,7 @@ def _pq_tiered_two_tier(state: ArenaState, book_cent: jax.Array,
         valid = valid & (~in_members[None, :]
                          | (rank[None, :] < nprobe_c[:, None]))
     sup = state.is_super[safe]
-    vecs = state.emb[safe]                                # [C, L, d]
+    vecs = state.emb[_phys(state, safe)]                  # [C, L, d]
     sc = jnp.einsum("cd,cld->cl", qd, vecs,
                     preferred_element_type=jnp.float32)
     h_s, h_pos = jax.lax.top_k(jnp.where(valid & ~sup, sc, NEG_INF), k_hot)
@@ -3701,8 +4075,8 @@ def _globalize_rows(rows: jax.Array, scores: jax.Array, shard: jax.Array,
 
 def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
                        max_nbr: int, mode: str = "exact", slack: int = 0,
-                       nprobe: int = 0,
-                       ragged: bool = False) -> FusedShardedKernels:
+                       nprobe: int = 0, ragged: bool = False,
+                       scan_chunk: int = 0) -> FusedShardedKernels:
     """Build the distributed fused chat-turn serving program for ``mesh``.
 
     ``mode`` picks the shard-local coarse stage:
@@ -3752,7 +4126,14 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     (the shard-local scans and the all_gather merge run to the ceiling;
     each query masks at its own boundaries, ``ops.topk.sharded_topk_merge``
     applying the k mask at the merge). ``nprobe_q`` is accepted and
-    ignored by the dense modes so every mode shares one ragged ABI."""
+    ignored by the dense modes so every mode shares one ragged ABI.
+
+    ``scan_chunk > 0`` (ISSUE 17 satellite — the pod twin of the ISSUE 11
+    single-chip override) narrows every chip's shard-local streaming tile:
+    the planner can fit an over-budget pod geometry by shrinking the
+    ``[chunk, local_rows]`` score transient instead of splitting the turn
+    into extra dispatches. Bit-identical results — only the streaming
+    granularity changes — and still ONE distributed dispatch."""
     from jax.sharding import PartitionSpec as P
 
     from lazzaro_tpu.ops.topk import sharded_topk_merge
@@ -3763,8 +4144,9 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     if cap_take > k:
         raise ValueError("cap_take must not exceed k")
     n_shards = mesh.shape[axis]
-    chunk = (IVF_SERVE_CHUNK if mode.startswith("ivf") or mode == "pq"
-             else QUERY_CHUNK)
+    chunk = scan_chunk or (IVF_SERVE_CHUNK
+                           if mode.startswith("ivf") or mode == "pq"
+                           else QUERY_CHUNK)
     # Tiered mode (ISSUE 8): the merged candidate block stays k+slack wide
     # so the host can finish cold-hit queries (exact rescore of host-
     # gathered rows + final re-rank) over the same window.

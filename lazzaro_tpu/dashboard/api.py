@@ -127,6 +127,16 @@ class DashboardHandler(BaseHTTPRequestHandler):
                     val = summary.get(key)
                     if val is not None:
                         extra.append(f"lazzaro_{key} {val}")
+                # Paged arena (ISSUE 17): page occupancy headline — the
+                # arena.pages_* gauges also ride the registry exposition
+                # above; these derived rows carry the free-list totals.
+                paged = summary.get("paged_arena")
+                if paged:
+                    for key in ("pages_total", "pages_free",
+                                "fragmentation", "pops_total",
+                                "pushes_total"):
+                        extra.append(
+                            f"lazzaro_arena_{key} {paged[key]}")
                 body = ms.telemetry.prometheus()
                 if extra:
                     body += "\n".join(extra) + "\n"

@@ -1377,18 +1377,24 @@ class ShardedMemoryIndex:
         return tabs
 
     def _fused_kernels(self, mode: str, k_bucket: int, nprobe: int,
-                       ragged: bool = False) -> S.FusedShardedKernels:
+                       ragged: bool = False, scan_chunk: int = 0
+                       ) -> S.FusedShardedKernels:
         # With ragged kernels k_bucket/nprobe are the fixed per-mode
         # ceilings, so the cache key collapses to one entry per mode.
+        # A planner scan_chunk override keys separately: same ONE
+        # dispatch, smaller in-kernel score tile (ISSUE 17 satellite —
+        # the pod path chunks the scan instead of splitting batches).
         key = ((mode, "ragged", k_bucket, nprobe) if ragged
                else (mode, k_bucket, nprobe))
+        if scan_chunk:
+            key = key + ("chunk", scan_chunk)
         kern = self._fused_cache.get(key)
         if kern is None:
             kern = S.make_fused_sharded(
                 self.mesh, self.axis, k=k_bucket,
                 cap_take=min(self.cap_take, k_bucket), max_nbr=self.max_nbr,
                 mode=mode, slack=self.coarse_slack, nprobe=nprobe,
-                ragged=ragged)
+                ragged=ragged, scan_chunk=scan_chunk)
             self._fused_cache.put(key, kern)
             self.telemetry.gauge("kernel.cache_entries",
                                  len(self._fused_cache),
@@ -1459,7 +1465,11 @@ class ShardedMemoryIndex:
         check_not_poisoned(self._poisoned)
         mode, k_bucket = self._serve_mode_hint(reqs)
         geom = self._serve_geometry(nq, mode, k_bucket)
-        decision = planner.check_feasible(geom, chunkable=False)
+        # chunkable: an over-budget pod geometry first shrinks the
+        # in-kernel scan tile (STILL one distributed dispatch) and only
+        # then splits the batch (ISSUE 17 satellite — previously the pod
+        # path could only split).
+        decision = planner.check_feasible(geom, chunkable=True)
         return self._serve_planned(reqs, geom, decision, replanned=False)
 
     def _serve_planned(self, reqs, geom, decision,
@@ -1473,12 +1483,15 @@ class ShardedMemoryIndex:
             tel.bump("plan.planned_turns", labels={"path": "serve"})
             tel.bump("plan.split_dispatches", len(groups),
                      labels={"path": "serve"})
+        if decision.scan_chunk:
+            tel.bump("plan.scan_chunked_turns", labels={"path": "serve"})
         out: List = []
         done = 0
         try:
             for g in groups:
                 out.extend(self._serve_requests_once(
-                    g, force_copy=replanned))
+                    g, force_copy=replanned,
+                    scan_chunk=decision.scan_chunk))
                 done += len(g)
         except Exception as e:      # noqa: BLE001 — OOM-only replan below
             if not is_resource_exhausted(e):
@@ -1491,7 +1504,7 @@ class ShardedMemoryIndex:
                     f"{e}") from e
             self.planner.note_oom(geom)
             harder = self.planner.replan_after_oom(geom, decision,
-                                                   chunkable=False)
+                                                   chunkable=True)
             if harder is None:
                 tel.bump("plan.infeasible", labels={"path": "serve"})
                 raise PlanInfeasible(
@@ -1503,7 +1516,8 @@ class ShardedMemoryIndex:
                                            replanned=True))
         return out
 
-    def _serve_requests_once(self, reqs, force_copy: bool = False) -> List:
+    def _serve_requests_once(self, reqs, force_copy: bool = False,
+                             scan_chunk: int = 0) -> List:
         """``serve.QueryScheduler`` executor for the pod-sharded path: one
         coalesced batch of :class:`serve.RetrievalRequest`s becomes ONE
         distributed dispatch + ONE packed readback running the FULL
@@ -1613,7 +1627,8 @@ class ShardedMemoryIndex:
             nprobe = 0
             mode = "quant" if use_quant else "exact"
             tables = self._int8_shadow_for() if use_quant else ()
-        kern = self._fused_kernels(mode, k_bucket, nprobe, ragged=ragged)
+        kern = self._fused_kernels(mode, k_bucket, nprobe, ragged=ragged,
+                                   scan_chunk=scan_chunk)
         csr_i, csr_n = self._csr_sharded()
         args = (tables, csr_i, csr_n, jnp.asarray(qp),
                 jnp.asarray(padb(valid)),

@@ -64,7 +64,11 @@ class Geometry:
     ``mesh_parts`` divides it into the per-chip slice the shard-local
     cores scan. ``batch`` is the PADDED query (or fact) batch.
     ``scan_chunk = 0`` means the kernel's default chunk structure
-    (``QUERY_CHUNK``, or ``IVF_SERVE_CHUNK`` for the IVF gather)."""
+    (``QUERY_CHUNK``, or ``IVF_SERVE_CHUNK`` for the IVF gather).
+    ``pool_rows`` (ISSUE 17) is the PHYSICAL embedding pool length of a
+    paged arena — 0 means dense (pool == rows). Only the embedding slab
+    and the scan tiles that stream it scale with the pool; every other
+    column stays logical-length."""
 
     kind: str = "serve"          # "serve" | "ingest"
     mode: str = "exact"          # exact | quant | ivf | pq | tiered
@@ -77,6 +81,7 @@ class Geometry:
     edge_cap: int = 0
     nprobe: int = 0
     scan_chunk: int = 0
+    pool_rows: int = 0           # paged arena: physical emb pool length
     link_k: int = 3              # ingest link-scan width per shard mode
     # Online-IVF maintenance rides the ingest dispatch (ISSUE 12): 1 adds
     # the centroid block + member/counts tables to the resident set and
@@ -137,7 +142,15 @@ class CostModel:
         the feasibility floor."""
         rows_pc = -(-g.rows // max(1, g.mesh_parts))
         fam = _mode_family(g.mode)
-        total = rows_pc * (g.dim * g.dtype_bytes + ARENA_META_BYTES)
+        # Paged arena (ISSUE 17): the embedding slab is pool-shaped —
+        # pages-in-use, not N — while the metadata columns stay logical.
+        emb_rows_pc = (-(-g.pool_rows // max(1, g.mesh_parts))
+                       if g.pool_rows else rows_pc)
+        total = emb_rows_pc * g.dim * g.dtype_bytes \
+            + rows_pc * ARENA_META_BYTES
+        if g.pool_rows:
+            # row_map (logical, i32) + inv_map/free-stack (pool, i32 each)
+            total += rows_pc * 4 + emb_rows_pc * 8
         if fam in ("quant", "tiered", "ivf") or g.kind == "ingest":
             # int8 shadow codes + f32 scales (maintained in-kernel by the
             # fused ingest; streamed by every coarse stage). The exact
@@ -186,6 +199,10 @@ class CostModel:
         batch-linear query/readback/top-k terms. THIS is what batch
         splitting and scan chunking shrink."""
         rows_pc = -(-g.rows // max(1, g.mesh_parts))
+        # The dense/link scans stream the PHYSICAL embedding pool of a
+        # paged arena (scores land in pool space, decoded via inv_map).
+        scan_rows_pc = (-(-g.pool_rows // max(1, g.mesh_parts))
+                        if g.pool_rows else rows_pc)
         fam = _mode_family(g.mode)
         default_chunk = (IVF_SERVE_CHUNK if fam in ("ivf", "pq")
                          else QUERY_CHUNK)
@@ -216,7 +233,7 @@ class CostModel:
         elif fam == "ingest":
             # the multi-mode link/dedup scan streams [chunk, rows] f32
             # once (PR 9 single-stream refactor) + candidate triples
-            tile = chunk * (rows_pc + 1) * 4 \
+            tile = chunk * (scan_rows_pc + 1) * 4 \
                 + chunk * max(1, g.link_k) * 3 * 4 * 2
             if g.ivf:
                 # the [batch, C] assignment tile, the [C, d] centroid
@@ -233,7 +250,7 @@ class CostModel:
         else:
             # dense scan: [chunk, rows] f32 scores + the two mask tiles
             # and the top-k workspace XLA materializes beside them
-            tile = chunk * (rows_pc + 1) * 4 * 3
+            tile = chunk * (scan_rows_pc + 1) * 4 * 3
         q_bytes = g.batch * g.dim * 4 * 2              # query + normalized
         readback = g.batch * (3 + 2 * g.k + 4) * 4 * 2
         sidecars = g.batch * 4 * 6                     # k/cap/nprobe/flags
@@ -249,7 +266,8 @@ class CostModel:
     def _res_key(g: Geometry) -> str:
         return (f"{g.kind}:{g.mode}:b{g.batch}:r{g.rows}:k{g.k}"
                 f":m{g.mesh_parts}" + (":ivf" if g.ivf else "")
-                + (":pq" if g.pq else ""))
+                + (":pq" if g.pq else "")
+                + (f":p{g.pool_rows}" if g.pool_rows else ""))
 
     def observe(self, g: Geometry, measured_bytes: float) -> bool:
         """Fold one measured AOT ``memory_analysis()`` peak back in.

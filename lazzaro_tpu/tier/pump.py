@@ -76,7 +76,7 @@ class TierManager:
         self.w_recency = float(w_recency)
         self.cold_dir = cold_dir
 
-        n = index.state.emb.shape[0]
+        n = index.state.salience.shape[0]
         self._n_parts = int(getattr(index, "_n_parts",
                                     getattr(index, "n_parts", 1)) or 1)
         self.stores: List[ColdStore] = [
@@ -209,7 +209,7 @@ class TierManager:
             st = idx.state
             rows_dev = jnp.asarray(np.asarray(chunk, np.int32))
             gen = getattr(idx, "_emb_gen", 0)
-            vec_dev = st.emb[rows_dev]
+            vec_dev = st.emb[S._phys(st, rows_dev)]
             q_dev, s_dev = quantize_rows(vec_dev)
             return chunk, gen, vec_dev, q_dev, s_dev
 
@@ -250,8 +250,18 @@ class TierManager:
                     faults.fire("pump.mid_chunk", chunk=len(chunk))
                     padded = S.pad_rows(np.asarray(chunk, np.int32),
                                         idx.state.capacity)
-                    idx._apply_arena(S.tier_demote, S.tier_demote_copy,
-                                     jnp.asarray(padded))
+                    if getattr(idx, "_pager", None) is not None:
+                        # Paged arena (ISSUE 17): the zero-scatter ALSO
+                        # pushes the rows' pool slots back on the free
+                        # list — demotion reclaims real HBM capacity.
+                        pushes = idx._apply_arena_paged(
+                            S.tier_demote_paged, S.tier_demote_paged_copy,
+                            jnp.asarray(padded),
+                            replay=lambda p: p.free(chunk))
+                        idx.telemetry.bump("arena.page_pushes", pushes)
+                    else:
+                        idx._apply_arena(S.tier_demote, S.tier_demote_copy,
+                                         jnp.asarray(padded))
                 except BaseException:
                     # zero-scatter never ran (or failed with the master
                     # intact): the rows are still HOT — drop the cold
@@ -275,6 +285,16 @@ class TierManager:
                                   labels={"dir": "demote"})
             self.telemetry.gauge("tier.pump_chunk_ms", ms)
         self.demoted_total += moved
+        if moved:
+            # ISSUE 17 satellite: demote scrubs member slots to -1 — run
+            # the hole compactor so reclaimed member capacity is reusable
+            # now, not only at the next re-seed (no-op below hole_frac).
+            repack = getattr(idx, "ivf_member_repack", None)
+            if repack is not None:
+                try:
+                    repack()
+                except Exception:       # noqa: BLE001 — pump must survive
+                    logger.exception("tier: ivf member repack failed")
         self.update_gauges()
         return moved
 
@@ -296,6 +316,10 @@ class TierManager:
             for i in range(0, len(todo), self.chunk_rows):
                 chunk = todo[i:i + self.chunk_rows]
                 t0 = time.perf_counter()
+                if getattr(idx, "_pager", None) is not None:
+                    # pre-grow the pool BEFORE capturing the generation:
+                    # a grow bumps _emb_gen and must not abort this chunk
+                    idx._ensure_pool(chunk)
                 gen = getattr(idx, "_emb_gen", 0)
                 vecs = self.gather_cold(chunk)
                 padded = S.pad_rows(np.asarray(chunk, np.int32),
@@ -307,8 +331,18 @@ class TierManager:
                         # a concurrent embedding write may have re-homed
                         # one of these rows — retry next pass
                         continue
-                    idx._apply_arena(S.tier_promote, S.tier_promote_copy,
-                                     jnp.asarray(padded), jnp.asarray(vp))
+                    if getattr(idx, "_pager", None) is not None:
+                        # re-bind pool slots for the returning rows
+                        pops = idx._apply_arena_paged(
+                            S.tier_promote_paged, S.tier_promote_paged_copy,
+                            jnp.asarray(padded), jnp.asarray(vp),
+                            replay=lambda p: p.alloc(chunk))
+                        idx.telemetry.bump("arena.page_pops", pops)
+                    else:
+                        idx._apply_arena(S.tier_promote,
+                                         S.tier_promote_copy,
+                                         jnp.asarray(padded),
+                                         jnp.asarray(vp))
                     for r in chunk:
                         s = self._find_store(r)
                         if s is not None:

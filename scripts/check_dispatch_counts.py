@@ -81,6 +81,15 @@ the paths passed as arguments) and exits nonzero if:
     the hot-only probe's ``dispatches_per_turn`` stays pinned to 1 by
     the generic dispatch gate). Earlier artifacts never carry the flag,
     so they are grandfathered by construction,
+  - (ISSUE 17) a PAGED-ARENA artifact (any dict with ``"paged": true``)
+    does not record a measured ``dispatches_per_turn`` (gated == 1 by
+    the generic rule — the free-list pop/push and the row_map gather
+    ride INSIDE the fused programs, never as sibling dispatches), lacks
+    a ``paged_qps_ratio``/``paged_qps_floor`` pair or records the ratio
+    below its floor (the indirection gather must stay within 10% of the
+    dense scan), or records a missing/nonzero ``mirror_mismatches``
+    (the host free-list mirror must agree with the device readback tail
+    on every pop — a drifted mirror silently corrupts slot reuse),
   - (ISSUE 16) a FUSED-PQ artifact (any dict with ``"pq_fused": true``)
     does not record a measured ``dispatches_per_turn`` (gated == 1 by
     the generic rule — the m-byte ADC member scan, exact rescore, and
@@ -125,7 +134,7 @@ _DISPATCH_KEYS = ("dispatches_per_turn", "dispatches_per_conversation")
 
 
 def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
-          tiereds, ingests, online_ivfs, pq_fuseds):
+          tiereds, ingests, online_ivfs, pq_fuseds, pageds):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -148,6 +157,8 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             online_ivfs.append((path, obj))
         if obj.get("pq_fused") is True:
             pq_fuseds.append((path, obj))
+        if obj.get("paged") is True:
+            pageds.append((path, obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k in _DISPATCH_KEYS:
@@ -156,12 +167,13 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
                 hits.append((here, v, obj.get("planned_" + k)))
             else:
                 _walk(v, here, hits, recalls, speedups, meshes, tel_blocks,
-                      raggeds, tiereds, ingests, online_ivfs, pq_fuseds)
+                      raggeds, tiereds, ingests, online_ivfs, pq_fuseds,
+                      pageds)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
             _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
                   tel_blocks, raggeds, tiereds, ingests, online_ivfs,
-                  pq_fuseds)
+                  pq_fuseds, pageds)
 
 
 def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
@@ -281,6 +293,33 @@ def _check_pq_fused(loc, obj, bad):
                              f"the PQ footprint advantage regressed"))
 
 
+def _check_paged(loc, obj, bad):
+    """The ISSUE 17 paged-arena gate on one ``"paged": true`` dict."""
+    if "dispatches_per_turn" not in obj:
+        bad.append((loc, "paged-arena artifact must record a measured "
+                         "'dispatches_per_turn' (page maintenance must "
+                         "ride inside the fused program)"))
+    ratio = obj.get("paged_qps_ratio")
+    floor = obj.get("paged_qps_floor")
+    if ratio is None or floor is None:
+        bad.append((loc, "paged-arena artifact must record both "
+                         "'paged_qps_ratio' and 'paged_qps_floor'"))
+    else:
+        try:
+            ok = float(ratio) >= float(floor)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            bad.append((loc, f"paged_qps_ratio == {ratio!r} < "
+                             f"paged_qps_floor {floor!r} (the row_map "
+                             f"gather cost regressed past the floor)"))
+    mism = obj.get("mirror_mismatches")
+    if mism != 0:
+        bad.append((loc, f"mirror_mismatches == {mism!r} (must record a "
+                         f"measured 0 — the host free-list mirror drifted "
+                         f"from the device page table)"))
+
+
 def _check_ingest(loc, obj, bad):
     """The ISSUE 9 sharded-ingest gate on one ``"ingest_sharded": true``
     dict."""
@@ -343,6 +382,7 @@ def main(argv):
     checked_ingest = 0
     checked_online_ivf = 0
     checked_pq = 0
+    checked_paged = 0
     bad = []
     for p in paths:
         try:
@@ -352,11 +392,12 @@ def main(argv):
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
         (hits, recalls, speedups, meshes, tel_blocks, raggeds, tiereds,
-         ingests, online_ivfs, pq_fuseds) = ([], [], [], [], [], [], [],
-                                             [], [], [])
+         ingests, online_ivfs, pq_fuseds, pageds) = ([], [], [], [], [],
+                                                     [], [], [], [], [],
+                                                     [])
         _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
               tel_blocks, raggeds, tiereds, ingests, online_ivfs,
-              pq_fuseds)
+              pq_fuseds, pageds)
         grandfathered = os.path.basename(p).startswith(
             _PRE_TELEMETRY_PREFIXES)
         for loc, measured_fused, block in tel_blocks:
@@ -378,6 +419,9 @@ def main(argv):
         for loc, obj in pq_fuseds:
             checked_pq += 1
             _check_pq_fused(loc, obj, bad)
+        for loc, obj in pageds:
+            checked_paged += 1
+            _check_paged(loc, obj, bad)
         for loc, v, planned in hits:
             checked += 1
             if v == 1:
@@ -427,8 +471,9 @@ def main(argv):
           f"{checked_ragged} ragged gate(s), "
           f"{checked_tiered} tiered gate(s), "
           f"{checked_ingest} sharded-ingest gate(s), "
-          f"{checked_online_ivf} online-ivf gate(s), and "
-          f"{checked_pq} fused-pq gate(s) across "
+          f"{checked_online_ivf} online-ivf gate(s), "
+          f"{checked_pq} fused-pq gate(s), and "
+          f"{checked_paged} paged-arena gate(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 

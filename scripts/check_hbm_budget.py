@@ -34,6 +34,15 @@ loop around it. It walks the checked-in artifacts and
   ``resource_exhausted_crashes == 0``, and a
   ``planned_dispatches_per_turn`` matching the measured count — a
   planned multi-dispatch turn is recorded, never silent;
+- GATES ``"paged": true`` artifacts (the BENCH_PAGED_ARENA stage,
+  ISSUE 17): post-demote ``pages_free`` must be measured > 0 (demotion
+  really PUSHED slots back — reclaimed capacity, not an accounting
+  fiction), the re-ingest after it must NOT have grown the pool (the
+  freed pages were actually reused), the growth step must record
+  ``grow_copied_pool == false`` (logical growth reuses the emb pool
+  buffer by reference), and the planner's post-growth paged
+  resident-bytes prediction must stay at or below the dense twin's —
+  the copy-free-growth claim in admission-model terms;
 - RECORDS the headroom back into each artifact (an ``hbm_budget``
   block). ``--no-write`` skips the write-back.
 
@@ -147,7 +156,8 @@ def _geometry_from_dict(plan_mod, d: dict):
             nprobe=int(d.get("nprobe", 0)),
             ivf=int(d.get("ivf", 0)),
             pq=int(d.get("pq", 0)),
-            slack=int(d.get("slack", 8)))
+            slack=int(d.get("slack", 8)),
+            pool_rows=int(d.get("pool_rows", 0)))
     except (TypeError, ValueError):
         return None
 
@@ -240,6 +250,58 @@ def _check_hbm_plan_root(loc, root, bad):
                          "'geometries_exercised' sweep list"))
 
 
+def _paged_roots(obj, path, roots):
+    if isinstance(obj, dict):
+        if obj.get("paged") is True:
+            roots.append((path, obj))
+        for k, v in obj.items():
+            _paged_roots(v, f"{path}.{k}", roots)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _paged_roots(v, f"{path}[{i}]", roots)
+
+
+def _check_paged_root(loc, root, bad):
+    """The ISSUE 17 gate on one ``"paged": true`` dict."""
+    after = root.get("page_stats_after_demote")
+    free = after.get("pages_free") if isinstance(after, dict) else None
+    try:
+        free_ok = float(free) > 0
+    except (TypeError, ValueError):
+        free_ok = False
+    if not free_ok:
+        bad.append((loc, f"post-demote pages_free == {free!r} (demotion "
+                         f"must measurably push slots back to the free "
+                         f"list)"))
+    if root.get("reingest_grew_pool") is not False:
+        bad.append((loc, f"reingest_grew_pool == "
+                         f"{root.get('reingest_grew_pool')!r} (the "
+                         f"re-ingest after demotion must reuse the "
+                         f"reclaimed pages, not grow the pool)"))
+    growth = root.get("growth")
+    copied = growth.get("grow_copied_pool") if isinstance(growth, dict) \
+        else None
+    if copied is not False:
+        bad.append((loc, f"growth.grow_copied_pool == {copied!r} (logical "
+                         f"growth must keep the emb pool buffer by "
+                         f"reference — zero bytes copied)"))
+    plan = root.get("planner")
+    if not isinstance(plan, dict):
+        bad.append((loc, "paged artifact must record a 'planner' block "
+                         "(resident-bytes predictions, dense vs paged)"))
+        return
+    paged_b = plan.get("resident_bytes_paged_after_grow")
+    dense_b = plan.get("resident_bytes_dense_after_grow")
+    try:
+        ok = float(paged_b) <= float(dense_b)
+    except (TypeError, ValueError):
+        ok = False
+    if not ok:
+        bad.append((loc, f"resident_bytes_paged_after_grow {paged_b!r} > "
+                         f"dense {dense_b!r} (growth must not drag the "
+                         f"pool along with logical capacity)"))
+
+
 def check_artifact(path: str, budget_bytes: float, write: bool):
     try:
         with open(path) as f:
@@ -300,6 +362,7 @@ def main(argv):
     checked_sound = 0
     checked_swept = 0
     checked_plan_roots = 0
+    checked_paged_roots = 0
     breaches = []
     unsound = []
     infeasible = []
@@ -350,6 +413,11 @@ def main(argv):
         for loc, rootd in roots:
             checked_plan_roots += 1
             _check_hbm_plan_root(loc, rootd, bad_plan)
+        proots: list = []
+        _paged_roots(data, base, proots)
+        for loc, rootd in proots:
+            checked_paged_roots += 1
+            _check_paged_root(loc, rootd, bad_plan)
     if args.calibrate:
         model.save(args.calibration)
         print(f"[hbm] calibration persisted to {args.calibration} "
@@ -376,7 +444,8 @@ def main(argv):
           f"across {with_gauges}/{len(paths)} artifact(s) checked against "
           f"{args.budget_gb} GiB; {checked_sound} soundness check(s), "
           f"{checked_swept} geometry sweep(s), {checked_plan_roots} "
-          f"hbm_plan gate(s); {n_bad} failure(s)")
+          f"hbm_plan gate(s), {checked_paged_roots} paged-arena "
+          f"gate(s); {n_bad} failure(s)")
     return 1 if n_bad else 0
 
 

@@ -164,8 +164,9 @@ def test_sharded_ingest_bit_identical_to_single_chip(n_dev):
     single-chip ``ingest_dedup_fused`` bit for bit."""
     arena, edges, shadow = _prefilled()
     args = _batch_args(arena)
-    a1, e1, sh1, _, _, out1 = S.ingest_dedup_fused_copy(
-        arena, edges, shadow, None, None, *args, k=K, shard_modes=(1, 0))
+    a1, e1, sh1, _, _, _, out1 = S.ingest_dedup_fused_copy(
+        arena, edges, shadow, None, None, None, *args, k=K,
+        shard_modes=(1, 0))
     dup = np.asarray(out1[0])[:10, 0]
     assert dup.sum() == 3, dup                 # the scenario does real work
     assert int(np.asarray(out1[10])[0, 0]) > 0  # some links accepted
@@ -194,8 +195,9 @@ def test_sharded_ingest_overflow_parity():
     state on both paths."""
     arena, edges, shadow = _prefilled()
     args = _batch_args(arena, pool_len=2)      # force overflow
-    a1, e1, _, _, _, out1 = S.ingest_dedup_fused_copy(
-        arena, edges, None, None, None, *args, k=K, shard_modes=(1, 0))
+    a1, e1, _, _, _, _, out1 = S.ingest_dedup_fused_copy(
+        arena, edges, None, None, None, None, *args, k=K,
+        shard_modes=(1, 0))
     assert int(np.asarray(out1[9])[0, 0]) == 1  # overflow flag set
     mesh = _mesh(4)
     kern = S.make_ingest_fused_sharded(mesh, "data", k=K,
