@@ -1619,6 +1619,200 @@ def bench_fused_sharded(on_tpu: bool, rows: int, reps: int = 3,
     return out
 
 
+def bench_replica_serving(on_tpu: bool, rows: int, reps: int = 16,
+                          group_counts=(1, 2, 4), dim: int = None,
+                          recall_floor: float = 0.97,
+                          qps_scaling_floor: float = 2.5,
+                          staleness_bound_s: float = 5.0):
+    """Replica-group serving acceptance (ISSUE 18): aggregate QPS vs
+    group count on the SAME device fleet, with freshness floors.
+
+    For each G in ``group_counts`` the fleet is partitioned into G
+    replica groups (``ReplicaPlacement``), each holding a FULL copy of
+    the corpus row-sharded over ``chips/G`` devices, and the rig drives
+    routed batch-64 turns through ``ReplicaPlacement.serve`` —
+    tenant-affine/least-loaded routing, ONE group-local dispatch + ONE
+    packed readback per turn (MEASURED by counting every group's
+    ``_dispatch`` entries). Aggregate QPS = routed turns served per
+    wall-second; ``qps_scaling`` = aggregate at max(G) over the 1-group
+    baseline. The rig is a single host, so the measured scaling is the
+    latency-bound regime's: a group-local turn pays the dispatch fan-out
+    + ``sharded_topk_merge`` of chips/G devices instead of the whole
+    fleet (on a real pod the groups ALSO overlap across hosts — the rig
+    number is the conservative floor). ``dim`` defaults to
+    min(BENCH_DIM, 128) to stay in that regime: at CPU-compute-bound
+    sizes the one-core rig serializes all groups and measures its own
+    matmul throughput, not the placement.
+
+    Freshness cells (largest G): recall@10 of routed turns vs the exact
+    numpy oracle; a deferred-replication write burst whose measured
+    ``staleness()`` window must close under ``staleness_bound_s``
+    (mirrors config ``serve_replica_staleness_s``); an overlay tenant
+    whose rows exist ONLY on its home group; and a crash injected
+    mid-replay (``replica.mid_replay``) that must recover by journal
+    catch-up with zero lost and zero double-ingested facts."""
+    import jax as _jax
+    from lazzaro_tpu.parallel.replica import ReplicaPlacement
+    from lazzaro_tpu.reliability import faults as _faults
+    from lazzaro_tpu.reliability.faults import InjectedFault
+    from lazzaro_tpu.serve import RetrievalRequest
+    from lazzaro_tpu.utils.telemetry import Telemetry
+
+    dim_ = dim or min(DIM, 128)
+    n_dev = len(_jax.devices())
+    counts = [g for g in group_counts if g <= n_dev and n_dev % g == 0]
+    if counts != list(group_counts):
+        print(f"[bench] replica: {n_dev} devices support groups {counts} "
+              f"(wanted {list(group_counts)}); set XLA_FLAGS="
+              f"--xla_force_host_platform_device_count=8 for the CPU "
+              f"mesh", file=sys.stderr, flush=True)
+    B = 64
+    rng = np.random.default_rng(53)
+    emb = rng.standard_normal((rows, dim_)).astype(np.float32)
+    ids = [f"f{i}" for i in range(rows)]
+    queries = rng.standard_normal((B, dim_)).astype(np.float32)
+    reqs = [RetrievalRequest(query=queries[i], tenant="u0", k=10)
+            for i in range(B)]
+    # exact numpy oracle over the fill corpus (cosine top-10); the bf16
+    # arena rounds, so near-ties may swap — hence the 0.97 floor
+    embn = emb / np.maximum(
+        np.linalg.norm(emb, axis=1, keepdims=True), 1e-9)
+    qn = queries / np.maximum(
+        np.linalg.norm(queries, axis=1, keepdims=True), 1e-9)
+    oracle = np.argsort(-(qn @ embn.T), axis=1)[:, :10]
+
+    per_group, qps_by_g, geoms = [], {}, []
+    keep = {}                      # largest-G placement: freshness cells
+    for G in counts:
+        tel = Telemetry()
+        # +192 headroom: the staleness/overlay/crash cells add 112 rows
+        # on top of the fill, and the tenant-affine partitioner needs
+        # spill room past the probe tenants' home partitions
+        pl = ReplicaPlacement(G, dim_, capacity=rows + 192,
+                              dtype=jnp.bfloat16, k=10, cap_take=5,
+                              max_nbr=16, telemetry=tel,
+                              telemetry_hbm=True)
+        t0 = time.perf_counter()
+        for c in range(0, rows, 2048):
+            pl.ingest(ids[c:c + 2048], emb[c:c + 2048], "u0")
+        fill_s = time.perf_counter() - t0
+        for g in pl.groups:
+            g.serve_requests(reqs)               # warm/compile every group
+        turns = reps * G
+        t0 = time.perf_counter()
+        for _ in range(turns):
+            res = pl.serve(reqs)
+        wall = time.perf_counter() - t0
+        qps = turns * B / wall
+        qps_by_g[G] = qps
+        # measured dispatch count: EVERY group's entries over routed turns
+        calls = {"n": 0}
+        origs = [g._dispatch for g in pl.groups]
+
+        def counting_wrap(orig):
+            def counting(fn, *a, **kw):
+                calls["n"] += 1
+                return orig(fn, *a, **kw)
+            return counting
+
+        for g, orig in zip(pl.groups, origs):
+            g._dispatch = counting_wrap(orig)
+        for _ in range(reps):
+            pl.serve(reqs)
+        for g, orig in zip(pl.groups, origs):
+            g._dispatch = orig
+        dpt = calls["n"] / reps
+        hits = sum(len(set(r.ids[:10])
+                       & {f"f{j}" for j in oracle[i]})
+                   for i, r in enumerate(res))
+        recall = hits / (B * 10)
+        per_group.append({
+            "groups": G, "devices_per_group": n_dev // G,
+            "routed_turns": turns, "aggregate_qps": round(qps, 1),
+            "turn_batch64_ms": round(wall * 1e3 / turns, 3),
+            "measured_dispatches_per_turn": dpt,
+            "recall_at_10": round(recall, 4),
+            "fill_s": round(fill_s, 1),
+            "journal_pending_after_fill": pl.journal.pending_count,
+        })
+        geoms.append({"kind": "serve", "mode": "exact", "batch": B,
+                      "rows": rows + 193, "dim": dim_, "k": 16,
+                      "dtype_bytes": 2, "mesh_parts": n_dev // G,
+                      "replica_groups": G})
+        if G == counts[-1]:
+            keep = {"pl": pl, "tel": tel}
+        else:
+            del pl
+
+    pl, tel = keep["pl"], keep["tel"]
+    Gmax = counts[-1]
+    # --- bounded staleness: defer the fan-out, measure the open window
+    st_emb = rng.standard_normal((64, dim_)).astype(np.float32)
+    pl.ingest([f"st{i}" for i in range(64)], st_emb, "staleness-probe",
+              replicate=False)
+    time.sleep(0.05)
+    staleness_open = pl.staleness()          # window while replicas lag
+    lag_open = pl.lag()
+    pl.catch_up()
+    staleness_closed = pl.staleness()
+    # --- overlay tenant: rows exist ONLY on the home group
+    ov_emb = rng.standard_normal((16, dim_)).astype(np.float32)
+    pl.ingest([f"ov{i}" for i in range(16)], ov_emb, "agent-ov",
+              overlay=True)
+    home = pl.group_for_tenant("agent-ov")
+    ov_copies = sum(1 for g in pl.groups
+                    if any(i.startswith("ov") for i in g.id_to_row))
+    # --- crash mid-replay: recovery must lose and double NOTHING
+    cr_emb = rng.standard_normal((32, dim_)).astype(np.float32)
+    cr_ids = [f"cr{i}" for i in range(32)]
+    crashed = False
+    with _faults.INJECTOR.armed("replica.mid_replay", times=1):
+        try:
+            pl.ingest(cr_ids, cr_emb, "crash-probe")
+        except InjectedFault:
+            crashed = True
+    lag_after_crash = pl.lag()
+    pl.catch_up()
+    lost = sum(1 for g in pl.groups for i in cr_ids if i not in g.id_to_row)
+    doubled = sum(1 for g in pl.groups
+                  if len(g.row_to_id) != len(g.id_to_row))
+    scaling = qps_by_g[Gmax] / qps_by_g[counts[0]]
+
+    out = {
+        "replica": True,
+        "group_counts": counts,
+        "devices": n_dev,
+        "arena_rows": rows,
+        "dim": dim_,
+        "batch": B,
+        "reps": reps,
+        "per_group": per_group,
+        "qps_scaling": round(scaling, 2),
+        "qps_scaling_floor": qps_scaling_floor,
+        "recall_at_10": min(p["recall_at_10"] for p in per_group),
+        "recall_floor": recall_floor,
+        "dispatches_per_turn": max(p["measured_dispatches_per_turn"]
+                                   for p in per_group),
+        "replica_staleness_s": round(staleness_open, 3),
+        "staleness_bound_s": staleness_bound_s,
+        "staleness_after_catchup_s": round(staleness_closed, 3),
+        "lag_during_window": lag_open,
+        "overlay": {"home_group": home, "groups_holding_rows": ov_copies},
+        "crash_replay": {"fault_fired": crashed,
+                         "lag_after_crash": lag_after_crash,
+                         "lost_facts": lost, "doubled_facts": doubled},
+        "geometries_exercised": geoms,
+        "telemetry": _telemetry_block(tel),
+        "roofline": {
+            "routed_turn_batch64": _roofline(
+                rows, dim_, 2,
+                per_group[-1]["turn_batch64_ms"], B, on_tpu),
+        },
+    }
+    del pl, keep
+    return out
+
+
 def bench_sharded_ingest(on_tpu: bool, rows: int, n_parts: int = 4,
                          batch: int = 1024, reps: int = 3,
                          speedup_floor: float = 1.5,
@@ -3564,6 +3758,45 @@ def paged_arena_stage_main():
                           if k not in ("telemetry",)}}}))
 
 
+def replica_stage_main():
+    """Standalone replica-serving acceptance stage (BENCH_REPLICA=<rows>
+    or =1 for the default 512): aggregate routed QPS over 1→2→4 replica
+    groups of the 8-device CPU mesh, recall / staleness / crash-replay
+    freshness cells, and the measured one-dispatch-per-routed-turn
+    count. Writes bench_artifacts/pr18_replica_serving_<size>_<dev>.json
+    (gated in CI by scripts/check_dispatch_counts.py and swept by
+    check_hbm_budget.py via the replica_groups geometry label).
+    BENCH_REPLICA_DIM pins the serving dim (default min(BENCH_DIM, 128)
+    — the scaling claim lives in the latency-bound regime; see
+    bench_replica_serving)."""
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    spec = os.environ.get("BENCH_REPLICA", "1")
+    rows = 512 if spec.strip() in ("", "1") else int(spec)
+    dim = int(os.environ.get("BENCH_REPLICA_DIM", "0")) or None
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    dev_tag = "tpu" if on_tpu else "cpu"
+    print(f"[bench] replica-serving stage at {rows} rows", file=sys.stderr,
+          flush=True)
+    t0 = time.perf_counter()
+    out = bench_replica_serving(on_tpu, rows, dim=dim)
+    out["stage_total_s"] = round(time.perf_counter() - t0, 1)
+    size_tag = "1m" if rows >= 1_000_000 else f"{rows}"
+    path = os.path.join(art_dir,
+                        f"pr18_replica_serving_{size_tag}_{dev_tag}.json")
+    with open(path, "w") as f:
+        json.dump({"metric": "replica_qps_scaling",
+                   "value": out["qps_scaling"], "unit": "x",
+                   "device": dev_tag, "sizes": {size_tag: out}},
+                  f, indent=1)
+    print(f"[bench] wrote {path}", file=sys.stderr, flush=True)
+    print(json.dumps({"metric": "replica_qps_scaling",
+                      "sizes": {size_tag: {
+                          k: v for k, v in out.items()
+                          if k not in ("telemetry",)}}}))
+
+
 def bench_fault_recovery(on_tpu: bool, rows: int = 8192, faults_n: int = 20,
                          flood: int = 512):
     """Fault-recovery acceptance stage (ISSUE 10): measures what failure
@@ -4286,6 +4519,9 @@ if __name__ == "__main__":
             sys.exit(0)
         if os.environ.get("BENCH_PAGED_ARENA"):
             paged_arena_stage_main()
+            sys.exit(0)
+        if os.environ.get("BENCH_REPLICA"):
+            replica_stage_main()
             sys.exit(0)
         if os.environ.get("BENCH_RAGGED"):
             ragged_stage_main()

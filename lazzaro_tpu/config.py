@@ -233,6 +233,21 @@ class MemoryConfig:
     # 0 = unlimited.
     serve_shed_depth: int = 0
     serve_shed_bytes: int = 0
+    # --- replica-group serving (ISSUE 18) ----------------------------------
+    # Partition the mesh into this many replica groups, each holding a
+    # FULL copy of the hot arena (master emb, int8 shadow, live IVF/PQ
+    # tables, edge CSR) over a group-local sub-mesh. Every coalesced
+    # mega-batch routes to exactly ONE group — tenant-affine for overlay
+    # reads (read-your-writes), least-loaded for shared-tier reads — so
+    # aggregate QPS scales with group count while each turn stays ONE
+    # dispatch + ONE packed readback. 1 = classic single-copy serving.
+    serve_replica_groups: int = 1
+    # Bounded-staleness window for non-primary groups: writes apply to
+    # the tenant's home group synchronously and replay to the others via
+    # the IngestJournal; the oldest journal entry not yet applied on
+    # every group must be younger than this (journal.replica_lag /
+    # serve.replica_staleness_s gauges measure it).
+    serve_replica_staleness_s: float = 5.0
     # Donation-safe dispatch recovery (reliability.guard): a failed
     # donated dispatch whose input survived retries through the
     # non-donating *_copy twin this many times with exponential backoff

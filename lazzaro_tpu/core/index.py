@@ -2022,8 +2022,16 @@ class MemoryIndex:
             scores, rows = quantized_topk(q8, qscale, mask,
                                           S.normalize(q_pad), k_eff)
         elif self.mesh is None:
+            # Dense-layout demotion zero-fills the master row but leaves it
+            # alive; pass the residency column so cold rows mask to -inf
+            # instead of surfacing as a score-0.0 tail (exact parity with
+            # the paged layout, which frees the slot — ISSUE 18).
+            cold = (self.tiering.cold_mask_dev()
+                    if self.tiering is not None and self.tiering.cold_count
+                    else None)
             scores, rows = S.arena_search(self.state, q_pad, jnp.int32(tid),
-                                          k_eff, super_filter, impl="auto")
+                                          k_eff, super_filter, impl="auto",
+                                          cold=cold)
         else:
             # pallas_call has no GSPMD partitioning rule, so the blocked
             # kernel can't run on the sharded global array directly — but
@@ -2039,6 +2047,12 @@ class MemoryIndex:
                 scores, rows = self._mesh_searcher(k_eff, int8=True)(
                     q8, qscale, mask, S.normalize(q_pad))
             else:
+                if self.tiering is not None and self.tiering.cold_count:
+                    # same residency parity fix as the single-chip exact
+                    # path: a demoted row's zeroed master must never score
+                    # as 0.0 (the int8 branch above keeps cold rows — the
+                    # shadow codes are preserved across demotion)
+                    mask = mask & ~self.tiering.cold_mask_dev()
                 scores, rows = self._mesh_searcher(k_eff)(
                     st.emb, mask, S.normalize(q_pad))
         h_scores, h_rows = fetch_packed(scores, rows)

@@ -654,6 +654,7 @@ def arena_search(
     k: int,
     super_filter: int = 0,  # 0: any, 1: only super nodes, -1: exclude super
     impl: str = "auto",     # "auto" | "xla" | "pallas"
+    cold: Optional[jax.Array] = None,  # [cap+1] bool residency column
 ) -> Tuple[jax.Array, jax.Array]:
     """Masked cosine top-k over the whole arena. Replaces
     ``LanceDBStore.search_nodes`` (vector_store.py:132-140) AND the super-node
@@ -672,9 +673,17 @@ def arena_search(
     (pallas_call has no GSPMD partitioning rule) or go through the
     shard_map composition in ``ops/topk.make_sharded_topk``."""
     q = normalize(jnp.atleast_2d(query)).astype(state.emb.dtype)
+    lmask = arena_mask(state, tenant, super_filter)
+    # Tier residency (ISSUE 18 parity fix): a DENSE-layout demote zero-fills
+    # the master row but leaves it alive, so without this mask a cold row
+    # would surface as a score-0.0 top-k tail — while the PAGED layout frees
+    # the slot and `_pool_mask` drops it. Masking cold rows to -inf here
+    # makes the two layouts bit-identical (no-op under paging).
+    if cold is not None:
+        lmask = lmask & ~cold
     # paged arenas scan the emb POOL: the logical mask re-indexes into pool
     # space (free slots masked off) and survivors map back to logical rows
-    mask = _pool_mask(state, arena_mask(state, tenant, super_filter))
+    mask = _pool_mask(state, lmask)
     n, nq = state.emb.shape[0], q.shape[0]
     use_pallas = impl == "pallas" or (
         impl == "auto"

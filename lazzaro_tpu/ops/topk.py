@@ -6,6 +6,14 @@ across the mesh ('data' axis) so each chip scores its local rows on the MXU,
 takes a local top-k, and the k·n_chips candidates are combined with one
 ``all_gather`` over ICI followed by a final top-k. For 1M×768 bf16 the whole
 index is ~1.5 GB — resident in HBM across a v5e-8 with room to spare.
+
+Replica-group serving (ISSUE 18) composes with every kernel here UNCHANGED:
+each replica group holds a full arena copy row-sharded over a GROUP-LOCAL
+sub-mesh (``parallel.mesh.replica_group_meshes``), so the ``axis`` these
+merges bind is the group's own data axis — the ``all_gather`` spans only
+the group's chips and never crosses groups. Scaling serving throughput by
+adding groups therefore needs no new collective: the merge narrows
+automatically because the mesh it was compiled against is narrower.
 """
 
 from __future__ import annotations

@@ -1,6 +1,7 @@
-from lazzaro_tpu.parallel.mesh import make_mesh, single_device_mesh, spec
+from lazzaro_tpu.parallel.mesh import (make_mesh, replica_group_meshes,
+                                       single_device_mesh, spec)
 from lazzaro_tpu.parallel.ring_attention import make_ring_attention
 from lazzaro_tpu.parallel.ulysses import make_ulysses_attention
 
-__all__ = ["make_mesh", "single_device_mesh", "spec",
-           "make_ring_attention", "make_ulysses_attention"]
+__all__ = ["make_mesh", "replica_group_meshes", "single_device_mesh",
+           "spec", "make_ring_attention", "make_ulysses_attention"]

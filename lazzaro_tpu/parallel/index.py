@@ -143,6 +143,12 @@ class ShardedMemoryIndex:
         self.axis = axis
         self.dim = dim
         self.n_parts = mesh.shape[axis]
+        # Replica-group serving (ISSUE 18): set >1 by ReplicaPlacement on
+        # each group's index — this index then owns one FULL arena copy
+        # row-sharded over a group-local sub-mesh, and the group count
+        # rides into geometry admission and the peak-HBM gauge labels so
+        # the planner/CI can see the fleet-wide replication factor.
+        self.replica_groups = 1
         # Row geometry: the arena carries capacity+1 rows (last = the
         # sentinel scratch row, core.state contract) and the TOTAL must
         # divide the mesh axis — capacity is rounded UP, never rejected.
@@ -911,7 +917,8 @@ class ShardedMemoryIndex:
             link_k=max(1, int(link_k)),
             ivf=1 if (self.ivf_online and self._ivf_dev is not None)
             else 0,
-            pq=1 if self._pq_pack is not None else 0)
+            pq=1 if self._pq_pack is not None else 0,
+            replica_groups=self.replica_groups)
 
     def plan_ingest(self, n: int, link_k: int = 3):
         """Pod twin of ``MemoryIndex.plan_ingest`` (ISSUE 11): admission
@@ -955,6 +962,8 @@ class ShardedMemoryIndex:
                 labels["ivf"] = "true"
             if with_pq:
                 labels["pq"] = "true"
+            if self.replica_groups > 1:
+                labels["groups"] = str(self.replica_groups)
             self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
                                  labels=labels)
             self.planner.observe_gauge(self._ingest_geometry(b), peak)
@@ -1043,6 +1052,23 @@ class ShardedMemoryIndex:
             saliences = [0.5] * n
         if supers is None:
             supers = [False] * n
+        # Happy path (ISSUE 18 satellite): with live online-IVF tables, an
+        # all-fresh add() rides the fused ingest program — same one-dispatch
+        # write, and the in-kernel assignment routes the rows into member
+        # slots instead of spilling them to the exact-scan extras
+        # (``ivf.add_extras_spills`` stops counting here). The gates are
+        # pinned so ingest() IS add(): dedup_gate above max cosine so no
+        # fact ever merges (every id keeps its own row), link_gate above
+        # max cosine so no edge inserts. Re-adds (overwrite in place) and
+        # super-node adds keep the classic scatter below — ingest() owns
+        # neither semantics.
+        if (self.ingest_fused and self.ivf_online
+                and self._ivf_dev is not None and not any(supers)
+                and all(i not in self.id_to_row for i in ids)):
+            self.ingest(ids, embeddings, tenant, saliences,
+                        dedup_gate=1.5, link_k=1, link_gate=1.5,
+                        link_accept_hint=0.0)
+            return [self.id_to_row[i] for i in ids]
         rows = []
         fresh = self._alloc(tenant,
                             sum(1 for i in ids if i not in self.id_to_row))
@@ -1434,7 +1460,8 @@ class ShardedMemoryIndex:
             dim=self.dim, k=k_bucket,
             dtype_bytes=int(np.dtype(self.dtype).itemsize),
             mesh_parts=self.n_parts, edge_cap=self.edge_capacity,
-            nprobe=int(self._ivf[3] if self._ivf is not None else 0))
+            nprobe=int(self._ivf[3] if self._ivf is not None else 0),
+            replica_groups=self.replica_groups)
 
     def serve_requests(self, reqs) -> List:
         """Memory-safe entry point of the pod serving path (ISSUE 11):
@@ -1743,6 +1770,8 @@ class ShardedMemoryIndex:
                       "mesh": f"{self.n_parts}x{self.axis}"}
             if mode == "pq":
                 labels["pq"] = "true"
+            if self.replica_groups > 1:
+                labels["groups"] = str(self.replica_groups)
             self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
                                  labels=labels)
             self.planner.observe_gauge(
@@ -1752,7 +1781,8 @@ class ShardedMemoryIndex:
                          k=int(k_bucket),
                          dtype_bytes=int(np.dtype(self.dtype).itemsize),
                          mesh_parts=self.n_parts,
-                         edge_cap=self.edge_capacity),
+                         edge_cap=self.edge_capacity,
+                         replica_groups=self.replica_groups),
                 peak)
 
     def warmup_serving(self, geometries=(8, 64),

@@ -41,6 +41,33 @@ def single_device_mesh() -> Mesh:
     return make_mesh(("data",), (1,), devices=jax.devices()[:1])
 
 
+def replica_group_meshes(n_groups: int, axis: str = "data",
+                         devices: Optional[Sequence[jax.Device]] = None
+                         ) -> Tuple[Mesh, ...]:
+    """Partition the device fleet into ``n_groups`` contiguous group-local
+    sub-meshes (replica-group serving, ISSUE 18): each group holds a FULL
+    copy of the arena row-sharded over its own ``len(devices)/n_groups``
+    chips, so the fused serving program compiled per group keeps the exact
+    single-group structure — the ``sharded_topk_merge`` all_gather simply
+    narrows to the group's sub-mesh and never crosses groups. Contiguous
+    device ranges keep each group's merge collective on neighboring chips
+    (the same locality argument as ``make_hybrid_mesh``'s ICI-inside
+    layout).
+
+    ``n_groups`` must divide the device count; 1 returns the classic
+    whole-fleet mesh unchanged."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    n_groups = int(n_groups)
+    if n_groups < 1 or n % n_groups:
+        raise ValueError(
+            f"replica_groups={n_groups} must divide the {n}-device fleet")
+    per = n // n_groups
+    return tuple(
+        make_mesh((axis,), (per,), devices=devices[g * per:(g + 1) * per])
+        for g in range(n_groups))
+
+
 def make_hybrid_mesh(ici_axes: Sequence[str], ici_sizes: Sequence[int],
                      dcn_axis: str = "slice",
                      num_slices: Optional[int] = None) -> Mesh:

@@ -1,0 +1,310 @@
+"""Replica-group serving: QPS that scales with chip count (ISSUE 18).
+
+The row-sharded pod index turns chips into CAPACITY: every fused serving
+dispatch scans every chip and pays the all_gather merge, so an 8-chip
+fleet serves ONE mega-batch at a time and aggregate QPS is flat in chip
+count (PR 5's 4-way rig measures 47.2 QPS vs 65.1 single-chip — the
+merge + dispatch overhead eats the fan-out on small corpora). The north
+star is read-dominated traffic from millions of users; for that,
+Pancake's placement (PAPERS.md) is the right shape: replicate the shared
+hot tier across serving groups, partition the per-agent overlays.
+
+``ReplicaPlacement`` partitions the fleet into ``n_groups`` contiguous
+group-local sub-meshes (``parallel.mesh.replica_group_meshes``), each
+holding a FULL :class:`~lazzaro_tpu.parallel.index.ShardedMemoryIndex` —
+master emb, int8 shadow, live IVF/PQ tables, edge CSR — row-sharded over
+its own ``chips/n_groups`` devices. Every serving kernel compiles per
+group against the group's sub-mesh, so the shard-local two-tier cores
+and the ``sharded_topk_merge`` combine reuse UNCHANGED: the merge
+collective narrows to the group axis automatically. Each routed turn is
+still exactly ONE distributed dispatch + ONE packed readback — but a
+turn now pays the dispatch fan-out and merge of ``chips/G`` devices
+instead of the whole fleet, and independent groups serve independent
+turn streams, so aggregate QPS scales with G (BENCH_REPLICA measures
+the 1→2→4-group aggregate on the CPU mesh rig).
+
+Writes are a fan-out of the PR 10 :class:`IngestJournal` — a replica
+group is just a journal SUBSCRIBER:
+
+- ``ingest()`` durably appends the fact batch, applies it to the
+  tenant's HOME group through the normal fused ingest dispatch, then
+  replays it per group through the SAME path. Replay is idempotent: ids
+  a group already registered are filtered host-side, and content-level
+  duplicates resolve through the in-dispatch dedup probe — a crash
+  anywhere in the fan-out (the ``replica.mid_replay`` fault point)
+  recovers by replaying ``journal.pending()`` past each group's
+  applied-seq cursor, with zero lost and zero double-ingested facts.
+- ``commit()`` happens only once EVERY group's cursor passed a seq, so
+  the journal always holds whatever some subscriber still needs.
+- **overlay tenants** (``overlay=True``) partition instead of
+  replicating: their facts carry an overlay marker in the journal and
+  apply ONLY to the home group — tenant isolation by placement, and the
+  replay filter keeps it through crash recovery too.
+
+Staleness is bounded and MEASURED, not assumed: ``append()`` stamps each
+seq, ``staleness()`` reports the age of the oldest batch any group has
+not yet applied (gauged per group as ``serve.replica_staleness_s``
+alongside the ``journal.replica_lag`` seqno gap), and callers compare it
+against the configured ``serve_replica_staleness_s`` window.
+
+Reads route each coalesced mega-batch to exactly ONE group:
+tenant-affine for overlay tenants (their rows exist nowhere else —
+which is also read-your-writes), least-loaded for shared-tier traffic.
+``make_router()`` wires the policy into per-group
+:class:`~lazzaro_tpu.serve.scheduler.QueryScheduler` instances via
+:class:`~lazzaro_tpu.serve.scheduler.ReplicaRouter` — per-group
+admission queues and circuit breakers, so one sick group degrades or
+sheds alone instead of the fleet.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from lazzaro_tpu.parallel.index import ShardedMemoryIndex
+from lazzaro_tpu.parallel.mesh import replica_group_meshes
+from lazzaro_tpu.reliability import faults
+from lazzaro_tpu.reliability.journal import IngestJournal
+from lazzaro_tpu.utils.telemetry import default_registry
+
+
+class ReplicaPlacement:
+    """G replica groups over the device fleet, each a full pod index on a
+    group-local sub-mesh, kept fresh by journal-replay subscription."""
+
+    def __init__(self, n_groups: int, dim: int, *,
+                 journal: Optional[IngestJournal] = None,
+                 journal_path: Optional[str] = None,
+                 staleness_s: float = 5.0,
+                 axis: str = "data", devices=None,
+                 telemetry=None, **index_kw):
+        self.telemetry = telemetry if telemetry is not None \
+            else default_registry()
+        meshes = replica_group_meshes(n_groups, axis, devices)
+        self.n_groups = len(meshes)
+        self.dim = dim
+        self.staleness_s = float(staleness_s)
+        self.groups: List[ShardedMemoryIndex] = []
+        for mesh in meshes:
+            idx = ShardedMemoryIndex(mesh, dim, axis=axis,
+                                     telemetry=self.telemetry, **index_kw)
+            idx.replica_groups = self.n_groups
+            self.groups.append(idx)
+        if journal is None:
+            if journal_path is None:
+                journal_path = os.path.join(
+                    tempfile.mkdtemp(prefix="lz-replica-"), "ingest.waljournal")
+            journal = IngestJournal(journal_path)
+        self.journal = journal
+        # Per-group applied-seq cursor: group g has applied every journal
+        # batch with seq <= _applied[g]. Starts at 0 so batches left
+        # pending by a previous process replay to EVERY group on the
+        # first replicate()/catch_up() — the idempotence filters make
+        # that safe regardless of which groups had applied them.
+        self._applied: List[int] = [0] * self.n_groups
+        self.overlay_tenants: set = set()
+        self._turns: List[int] = [0] * self.n_groups
+        self._route_lock = threading.Lock()
+        self._rr = 0
+
+    # ------------------------------------------------------------- placement
+    def group_for_tenant(self, tenant: str) -> int:
+        """Stable home-group assignment (same idiom as the pod index's
+        row-partition affinity): a tenant's overlay rows live only here,
+        and its shared writes run their PRIMARY fused ingest here."""
+        return abs(hash(tenant)) % self.n_groups
+
+    @property
+    def dispatch_count(self) -> int:
+        return sum(g.dispatch_count for g in self.groups)
+
+    # ----------------------------------------------------------------- write
+    def ingest(self, ids: Sequence[str], embeddings: np.ndarray,
+               tenant: str, saliences: Optional[Sequence[float]] = None, *,
+               overlay: bool = False, replicate: bool = True,
+               **ingest_kw) -> Dict:
+        """Journal-append → primary fused ingest on the tenant's home
+        group → replay fan-out to every subscriber group → commit.
+        Returns the PRIMARY group's ingest result (rows are home-group
+        row ids; replicas allocate their own). ``overlay=True`` marks the
+        tenant overlay from here on: this and future batches for it
+        apply to the home group ONLY and reads pin there.
+        ``replicate=False`` defers the fan-out (the batch stays pending
+        in the journal until the next ``replicate()``/``catch_up()``) —
+        the bounded-staleness window a deployment would open by batching
+        subscriber replays, measured by ``staleness()``."""
+        n = len(ids)
+        if n == 0:
+            return {"rows": [], "created": [], "merged": {}, "links": [],
+                    "chains": [], "counters": {}}
+        if overlay:
+            self.overlay_tenants.add(tenant)
+        ov = tenant in self.overlay_tenants
+        emb = np.asarray(embeddings, np.float32).reshape(n, self.dim)
+        if saliences is None:
+            saliences = [0.5] * n
+        facts = [{"id": str(i), "emb": e.tolist(), "tenant": tenant,
+                  "salience": float(s), "overlay": ov}
+                 for i, e, s in zip(ids, emb, saliences)]
+        seq = self.journal.append(facts)
+        home = self.group_for_tenant(tenant)
+        out = self._apply_batch(home, facts, **ingest_kw)
+        self._applied[home] = max(self._applied[home], seq)
+        self.telemetry.bump(
+            "serve.replica_overlay_writes" if ov else "serve.replica_writes",
+            labels={"group": str(home)})
+        if replicate:
+            self.replicate()
+        else:
+            self._update_gauges()
+        return out
+
+    def _apply_batch(self, g: int, facts: List[dict], **ingest_kw) -> Dict:
+        """Apply one journal batch to group ``g`` through its normal
+        ingest path. Idempotence is two-layer: ids the group already
+        registered are filtered HERE (exact — covers a replayed batch
+        whose dispatch finished before the crash), and facts whose
+        content already landed under a merged id resolve through the
+        in-dispatch dedup probe (covers everything else)."""
+        idx = self.groups[g]
+        out = {"rows": [], "created": [], "merged": {}, "links": [],
+               "chains": [], "counters": {}}
+        by_tenant: Dict[str, List[dict]] = {}
+        for f in facts:
+            if f.get("overlay") and self.group_for_tenant(
+                    f.get("tenant", "")) != g:
+                continue            # overlay fact: home group only
+            if f["id"] in idx.id_to_row:
+                self.telemetry.bump("journal.replica_replay_skipped",
+                                    labels={"group": str(g)})
+                continue            # already applied here: exact replay skip
+            by_tenant.setdefault(f.get("tenant", ""), []).append(f)
+        for tenant, fs in by_tenant.items():
+            got = idx.ingest([f["id"] for f in fs],
+                             np.asarray([f["emb"] for f in fs], np.float32),
+                             tenant, [f["salience"] for f in fs],
+                             **ingest_kw)
+            out["rows"].extend(got["rows"])
+            out["created"].extend(got["created"])
+            out["merged"].update(got["merged"])
+            out["links"].extend(got["links"])
+            out["chains"].extend(got["chains"])
+        return out
+
+    def replicate(self) -> int:
+        """Drain the journal to every subscriber group past its cursor,
+        then commit whatever EVERY group has applied. This is both the
+        steady-state fan-out (called by every ``ingest``) and the crash
+        recovery path (``catch_up``) — same code, same idempotence.
+        Returns the number of per-group batch applications performed."""
+        applied_n = 0
+        for g in range(self.n_groups):
+            for seq, facts in self.journal.pending():
+                if seq <= self._applied[g]:
+                    continue
+                # Fault point "replica.mid_replay": a raise here models
+                # the fan-out dying with the batch applied on SOME groups
+                # and the cursor/commit not yet advanced — recovery is
+                # simply calling this method again.
+                faults.fire("replica.mid_replay", group=g, seq=seq)
+                self._apply_batch(g, facts)
+                self._applied[g] = seq
+                applied_n += 1
+                self.telemetry.bump("journal.replica_replayed",
+                                    labels={"group": str(g)})
+        self.journal.commit(min(self._applied))
+        self._update_gauges()
+        return applied_n
+
+    catch_up = replicate
+
+    # ------------------------------------------------------------ staleness
+    def lag(self) -> int:
+        """Worst per-group journal seqno gap (``journal.replica_lag``)."""
+        return max(self.journal.lag(a) for a in self._applied)
+
+    def staleness(self) -> float:
+        """Age of the oldest journal batch some group has not applied —
+        the measured bounded-staleness window, to compare against the
+        configured ``serve_replica_staleness_s``."""
+        return max(self.journal.oldest_age(a) for a in self._applied)
+
+    def _update_gauges(self) -> None:
+        for g, applied in enumerate(self._applied):
+            self.telemetry.gauge("journal.replica_lag",
+                                 self.journal.lag(applied),
+                                 labels={"group": str(g)})
+            self.telemetry.gauge("serve.replica_staleness_s",
+                                 self.journal.oldest_age(applied),
+                                 labels={"group": str(g)})
+        if self.staleness() > self.staleness_s:
+            self.telemetry.bump("serve.replica_staleness_violations")
+
+    # ----------------------------------------------------------------- read
+    def route_batch(self, reqs) -> int:
+        """The group ONE coalesced mega-batch routes to: the home group
+        when the batch carries overlay tenants (they must agree — the
+        per-request router in :meth:`make_router` never mixes homes),
+        least-loaded round-robin otherwise."""
+        homes = {self.group_for_tenant(r.tenant) for r in reqs
+                 if r.tenant in self.overlay_tenants}
+        if len(homes) > 1:
+            raise ValueError(
+                "one mega-batch mixes overlay tenants with different home "
+                "groups — route per request (make_router) instead")
+        if homes:
+            return homes.pop()
+        with self._route_lock:
+            lo = min(self._turns)
+            candidates = [g for g, t in enumerate(self._turns) if t == lo]
+            g = candidates[self._rr % len(candidates)]
+            self._rr += 1
+            return g
+
+    def serve(self, reqs) -> List:
+        """Serve one coalesced mega-batch on exactly one group: ONE
+        distributed dispatch + ONE packed readback, group-local."""
+        g = self.route_batch(reqs)
+        with self._route_lock:
+            self._turns[g] += 1
+        self.telemetry.bump("serve.replica_routed_turns",
+                            labels={"group": str(g)})
+        return self.groups[g].serve_requests(reqs)
+
+    def make_router(self, **sched_kw):
+        """Per-group :class:`QueryScheduler`s behind the routing policy —
+        the production wiring (per-group admission + breaker state).
+        Shares ``overlay_tenants`` by reference, so a tenant that turns
+        overlay after router construction pins immediately."""
+        from lazzaro_tpu.serve.scheduler import ReplicaRouter
+
+        return ReplicaRouter([g.serve_requests for g in self.groups],
+                             affine_tenants=self.overlay_tenants,
+                             telemetry=self.telemetry, **sched_kw)
+
+    # ------------------------------------------------------------- maintain
+    def ivf_build(self, **kw) -> None:
+        for g in self.groups:
+            g.ivf_build(**kw)
+
+    def warmup_serving(self, *a, **kw) -> None:
+        for g in self.groups:
+            g.warmup_serving(*a, **kw)
+
+    def stats(self) -> dict:
+        return {
+            "n_groups": self.n_groups,
+            "applied_seq": list(self._applied),
+            "last_seq": self.journal.last_seq,
+            "pending": self.journal.pending_count,
+            "lag": self.lag(),
+            "staleness_s": self.staleness(),
+            "staleness_bound_s": self.staleness_s,
+            "overlay_tenants": len(self.overlay_tenants),
+            "turns": list(self._turns),
+        }

@@ -55,6 +55,15 @@ EDGE_SLOT_BYTES = 7 * 4
 # see; these start conservative and only ever grow under calibration.
 _DEFAULT_MULTIPLIER = 1.25
 
+# Fixed per-dispatch workspace floor. XLA's AOT peak carries a
+# size-independent temp-buffer floor (alignment slop, collective
+# scratch, the sort workspace's minimum granule) that dominates TINY
+# geometries — a multiplicative model can only cover it by inflating
+# the family multiplier far past what real sizes need, so it is a
+# constant term instead (ISSUE 18: surfaced by the replica bench's
+# 706-row ingest gauges).
+DISPATCH_WORKSPACE_BYTES = 2 << 20
+
 
 @dataclass(frozen=True)
 class Geometry:
@@ -100,6 +109,14 @@ class Geometry:
     # per query, so the transient term is LINEAR in it — a per-family
     # multiplier cannot absorb a knob the operator can turn.
     slack: int = 8
+    # Replica-group serving (ISSUE 18): the mesh is partitioned into G
+    # groups that each hold a FULL copy of the arena, so ``mesh_parts``
+    # here is already the per-GROUP shard count (chips // groups) and the
+    # per-chip byte terms need no change — but admission must label the
+    # geometry so a planner sweep can see that G groups multiply the
+    # fleet-wide resident footprint while leaving the per-chip slice
+    # rows / (chips/G).
+    replica_groups: int = 1
 
     def with_(self, **kw) -> "Geometry":
         d = asdict(self)
@@ -254,7 +271,8 @@ class CostModel:
         q_bytes = g.batch * g.dim * 4 * 2              # query + normalized
         readback = g.batch * (3 + 2 * g.k + 4) * 4 * 2
         sidecars = g.batch * 4 * 6                     # k/cap/nprobe/flags
-        return int(tile + q_bytes + readback + sidecars)
+        return int(tile + q_bytes + readback + sidecars
+                   + DISPATCH_WORKSPACE_BYTES)
 
     def predict(self, g: Geometry) -> int:
         """Calibrated upper bound on the compiled program's peak HBM."""
@@ -267,7 +285,8 @@ class CostModel:
         return (f"{g.kind}:{g.mode}:b{g.batch}:r{g.rows}:k{g.k}"
                 f":m{g.mesh_parts}" + (":ivf" if g.ivf else "")
                 + (":pq" if g.pq else "")
-                + (f":p{g.pool_rows}" if g.pool_rows else ""))
+                + (f":p{g.pool_rows}" if g.pool_rows else "")
+                + (f":g{g.replica_groups}" if g.replica_groups > 1 else ""))
 
     def observe(self, g: Geometry, measured_bytes: float) -> bool:
         """Fold one measured AOT ``memory_analysis()`` peak back in.

@@ -32,6 +32,13 @@ Injection points (grep for ``faults.fire`` to find the exact sites):
                       planner's prediction missed; recovery is ONE
                       replan into split sub-dispatches via the copy
                       twins (ISSUE 11)
+``replica.mid_replay``  ReplicaPlacement.replicate, between a
+                      subscriber group's per-batch ingest replays — a
+                      raise here models the fan-out dying with the
+                      journal batch applied on SOME groups but not
+                      committed; recovery is the journal replay on the
+                      next write/catch-up, idempotent via the
+                      in-dispatch dedup probe (ISSUE 18)
 ====================  =====================================================
 
 Arming is process-global (the injected sites live on background threads),

@@ -26,12 +26,25 @@ commit discipline over the same CRC-framed record format as the turn WAL:
 
 The log compacts (resets to empty) whenever a commit retires everything
 outstanding, so steady-state size is one drain's worth of facts.
+
+Replica serving (ISSUE 18) layers on the same discipline without any
+format change: a replica group is just a journal SUBSCRIBER. Writes
+apply to a primary group through the normal fused ingest, then each
+other group replays the same ``(seq, facts)`` batches through its own
+normal path (idempotent via the in-dispatch dedup probe); the placement
+layer keeps a per-group applied-seq cursor and only ``commit()``s once
+EVERY group has applied. ``append()`` additionally stamps an in-memory
+wall-clock per seq so ``oldest_age()`` / ``lag()`` can measure the
+bounded-staleness window (``serve_replica_staleness_s``) and the
+``journal.replica_lag`` gauge — purely in-memory observability, never
+persisted (a restart re-replays pending batches anyway).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, List, Tuple
 
 from lazzaro_tpu.native import WriteAheadLog
@@ -46,6 +59,9 @@ class IngestJournal:
         self._wal = WriteAheadLog(path, fsync=fsync)
         self._lock = threading.Lock()
         self._pending: Dict[int, List[dict]] = {}
+        # seq -> append wall-time (in-memory only; staleness observability
+        # for replica subscribers — see the module docstring)
+        self._append_ts: Dict[int, float] = {}
         self._next_seq = 1
         self._replay_into_memory()
 
@@ -83,6 +99,7 @@ class IngestJournal:
             self._wal.append(json.dumps(
                 {"op": "add", "seq": seq, "facts": facts}).encode("utf-8"))
             self._pending[seq] = facts
+            self._append_ts[seq] = time.time()
             return seq
 
     def commit(self, seq: int) -> None:
@@ -93,6 +110,8 @@ class IngestJournal:
         with self._lock:
             for s in [s for s in self._pending if s <= seq]:
                 del self._pending[s]
+            for s in [s for s in self._append_ts if s <= seq]:
+                del self._append_ts[s]
             if not self._pending:
                 # everything retired: truncating IS the commit record
                 self._wal.reset()
@@ -117,11 +136,35 @@ class IngestJournal:
 
     def pending(self) -> List[Tuple[int, List[dict]]]:
         """Uncommitted (seq, facts) batches in append order — the startup
-        replay set."""
+        replay set (and each replica subscriber's replay feed, filtered
+        past its applied-seq cursor)."""
         with self._lock:
             return sorted(self._pending.items())
+
+    # ------------------------------------------------- replica observability
+    def lag(self, applied_seq: int) -> int:
+        """How many appended batches a subscriber at ``applied_seq`` has
+        not yet applied — the ``journal.replica_lag`` gauge per group."""
+        with self._lock:
+            return sum(1 for s in self._pending if s > applied_seq)
+
+    def oldest_age(self, applied_seq: int, now: float = None) -> float:
+        """Age (seconds) of the OLDEST appended batch a subscriber at
+        ``applied_seq`` has not yet applied — 0.0 when fully caught up.
+        This is the measured bounded-staleness window a replica group
+        exposes (compare against ``serve_replica_staleness_s``). Batches
+        appended before this process started carry no timestamp and
+        count as age 0 (they are replayed immediately on startup)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            ts = [self._append_ts[s] for s in self._pending
+                  if s > applied_seq and s in self._append_ts]
+            if not ts:
+                return 0.0
+            return max(0.0, now - min(ts))
 
     def reset(self) -> None:
         with self._lock:
             self._pending.clear()
+            self._append_ts.clear()
             self._wal.reset()

@@ -1,6 +1,7 @@
 """Serving-path scheduling: cross-request query batching for fused retrieval."""
 
-from lazzaro_tpu.serve.scheduler import (QueryScheduler, RetrievalRequest,
-                                         RetrievalResult)
+from lazzaro_tpu.serve.scheduler import (QueryScheduler, ReplicaRouter,
+                                         RetrievalRequest, RetrievalResult)
 
-__all__ = ["QueryScheduler", "RetrievalRequest", "RetrievalResult"]
+__all__ = ["QueryScheduler", "ReplicaRouter", "RetrievalRequest",
+           "RetrievalResult"]

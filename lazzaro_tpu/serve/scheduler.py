@@ -73,7 +73,7 @@ import threading
 import time
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -466,6 +466,12 @@ class QueryScheduler:
         for (_, fut, _), res in zip(batch, results):
             _set_future(fut, res)
 
+    def load(self) -> int:
+        """Instantaneous queue depth + in-flight dispatches — the
+        least-loaded routing signal :class:`ReplicaRouter` reads."""
+        with self._cond:
+            return len(self._pending) + self._inflight
+
     # ----------------------------------------------------------- lifecycle
     def flush(self, timeout: float = 30.0) -> None:
         """Block until everything submitted so far has been executed."""
@@ -504,3 +510,112 @@ class QueryScheduler:
                                if sizes else None),
                 "max_batch_seen": max(sizes) if sizes else None,
             }
+
+
+class ReplicaRouter:
+    """Group-aware routing in front of per-group :class:`QueryScheduler`s
+    (replica-group serving, ISSUE 18).
+
+    One scheduler per replica group — each with its OWN worker thread,
+    admission queue, and circuit breaker, so a sick group degrades (or
+    sheds) alone while the others keep serving at full quality — and a
+    routing policy in front that assigns every submitted request to
+    exactly one group:
+
+    - **tenant-affine**: tenants named in ``affine_tenants`` (the
+      placement layer registers every overlay tenant it ingests) always
+      route to ``hash(tenant) % n_groups`` — their private rows exist
+      ONLY on that home group, and the pinning also buys read-your-writes
+      for shared-tier tenants that opt in;
+    - **least-loaded**: everything else routes to the group whose
+      scheduler reports the smallest queue depth + in-flight count
+      (:meth:`QueryScheduler.load`), ties broken round-robin so an idle
+      fleet still spreads.
+
+    Because routing happens at submission, each group's scheduler
+    coalesces ITS stream into mega-batches independently — every flushed
+    mega-batch lands on exactly one group as ONE distributed dispatch +
+    ONE packed readback, which is what makes aggregate QPS scale with
+    group count instead of every dispatch sweeping every chip."""
+
+    def __init__(self, executors: Sequence[Executor],
+                 affine_tenants: Optional[set] = None,
+                 telemetry=None, name: str = "lz-replica-router", **sched_kw):
+        if not executors:
+            raise ValueError("ReplicaRouter needs at least one executor")
+        self.telemetry = telemetry if telemetry is not None \
+            else default_registry()
+        # a set passed in is kept BY REFERENCE: the placement layer shares
+        # its live overlay-tenant set, so tenants that turn overlay after
+        # router construction pin immediately
+        self.affine_tenants = (affine_tenants if isinstance(affine_tenants,
+                                                            set)
+                               else set(affine_tenants or ()))
+        self.schedulers = [
+            QueryScheduler(ex, name=f"{name}-g{g}",
+                           telemetry=self.telemetry, **sched_kw)
+            for g, ex in enumerate(executors)]
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.schedulers)
+
+    def pin_tenant(self, tenant: str) -> int:
+        """Register a tenant as overlay/affine; returns its home group."""
+        self.affine_tenants.add(tenant)
+        return self.group_for_tenant(tenant)
+
+    def group_for_tenant(self, tenant: str) -> int:
+        """The tenant's home group (stable hash — the same assignment the
+        write-side placement uses, so affine reads land where the
+        tenant's overlay rows live)."""
+        return abs(hash(tenant)) % len(self.schedulers)
+
+    def route(self, request: RetrievalRequest) -> int:
+        if request.tenant in self.affine_tenants:
+            g = self.group_for_tenant(request.tenant)
+            self.telemetry.bump("serve.replica_affine_routed",
+                                labels={"group": str(g)})
+            return g
+        loads = [s.load() for s in self.schedulers]
+        lo = min(loads)
+        candidates = [g for g, v in enumerate(loads) if v == lo]
+        with self._rr_lock:
+            g = candidates[self._rr % len(candidates)]
+            self._rr += 1
+        self.telemetry.bump("serve.replica_routed",
+                            labels={"group": str(g)})
+        return g
+
+    def submit(self, request: RetrievalRequest) -> "Future[RetrievalResult]":
+        return self.schedulers[self.route(request)].submit(request)
+
+    def submit_many(self, requests: Sequence[RetrievalRequest]
+                    ) -> List["Future[RetrievalResult]"]:
+        """Route a group of requests; each sub-group stays contiguous on
+        its scheduler (the atomic-group property per group)."""
+        by_group: Dict[int, List[int]] = {}
+        for i, req in enumerate(requests):
+            by_group.setdefault(self.route(req), []).append(i)
+        futures: List[Optional[Future]] = [None] * len(requests)
+        for g, idxs in by_group.items():
+            got = self.schedulers[g].submit_many(
+                [requests[i] for i in idxs])
+            for i, fut in zip(idxs, got):
+                futures[i] = fut
+        return futures
+
+    def flush(self, timeout: float = 30.0) -> None:
+        for s in self.schedulers:
+            s.flush(timeout)
+
+    def close(self) -> None:
+        for s in self.schedulers:
+            s.close()
+
+    def stats(self) -> dict:
+        return {"n_groups": len(self.schedulers),
+                "affine_tenants": len(self.affine_tenants),
+                "groups": [s.stats() for s in self.schedulers]}

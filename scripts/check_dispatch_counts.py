@@ -100,6 +100,21 @@ the paths passed as arguments) and exits nonzero if:
     reason to exist — must stay measured, and below the int8 shadow's
     when both are present as ``bytes_per_row``/``int8_bytes_per_row``),
 
+  - (ISSUE 18) a REPLICA artifact (any dict with ``"replica": true``)
+    does not record a measured ``dispatches_per_turn`` (gated == 1 by
+    the generic rule — a routed turn must cost ONE group-local dispatch
+    fleet-wide, no stray dispatch on any other group), lacks a
+    ``qps_scaling``/``qps_scaling_floor`` pair or records the scaling
+    below its floor (adding replica groups must keep buying aggregate
+    QPS — the whole reason the placement layer exists), lacks a
+    ``recall_at_10``/``recall_floor`` pair (the generic recall gate then
+    enforces it — group-local serving must stay exact), records a
+    missing/over-bound ``replica_staleness_s`` vs its
+    ``staleness_bound_s`` (the journal fan-out's bounded-staleness
+    window is a measured promise, not an assumption), or records a
+    crash-replay cell with ``lost_facts`` or ``doubled_facts`` != 0
+    (journal-subscriber recovery must converge exactly),
+
 so any of these regressions turns red in CI instead of shipping.
 
 Usage:
@@ -134,7 +149,7 @@ _DISPATCH_KEYS = ("dispatches_per_turn", "dispatches_per_conversation")
 
 
 def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
-          tiereds, ingests, online_ivfs, pq_fuseds, pageds):
+          tiereds, ingests, online_ivfs, pq_fuseds, pageds, replicas):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -159,6 +174,8 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             pq_fuseds.append((path, obj))
         if obj.get("paged") is True:
             pageds.append((path, obj))
+        if obj.get("replica") is True:
+            replicas.append((path, obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k in _DISPATCH_KEYS:
@@ -168,12 +185,12 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             else:
                 _walk(v, here, hits, recalls, speedups, meshes, tel_blocks,
                       raggeds, tiereds, ingests, online_ivfs, pq_fuseds,
-                      pageds)
+                      pageds, replicas)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
             _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
                   tel_blocks, raggeds, tiereds, ingests, online_ivfs,
-                  pq_fuseds, pageds)
+                  pq_fuseds, pageds, replicas)
 
 
 def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
@@ -320,6 +337,61 @@ def _check_paged(loc, obj, bad):
                          f"from the device page table)"))
 
 
+def _check_replica(loc, obj, bad):
+    """The ISSUE 18 replica-serving gate on one ``"replica": true``
+    dict."""
+    if "dispatches_per_turn" not in obj:
+        bad.append((loc, "replica artifact must record a measured "
+                         "'dispatches_per_turn' (one group-local dispatch "
+                         "per routed turn, fleet-wide)"))
+    if "recall_at_10" not in obj or "recall_floor" not in obj:
+        bad.append((loc, "replica artifact must record a recall_at_10/"
+                         "recall_floor pair"))
+    for i, grp in enumerate(obj.get("per_group") or []):
+        measured = grp.get("measured_dispatches_per_turn")
+        if measured != 1.0:
+            bad.append((f"{loc}.per_group[{i}]",
+                        f"measured_dispatches_per_turn == {measured!r} "
+                        f"(every group count must serve a routed turn in "
+                        f"exactly ONE group-local dispatch)"))
+    scaling = obj.get("qps_scaling")
+    floor = obj.get("qps_scaling_floor")
+    if scaling is None or floor is None:
+        bad.append((loc, "replica artifact must record both 'qps_scaling' "
+                         "and 'qps_scaling_floor'"))
+    else:
+        try:
+            ok = float(scaling) >= float(floor)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            bad.append((loc, f"qps_scaling == {scaling!r} < "
+                             f"qps_scaling_floor {floor!r} (adding replica "
+                             f"groups stopped buying aggregate QPS)"))
+    stale = obj.get("replica_staleness_s")
+    bound = obj.get("staleness_bound_s", 5.0)
+    try:
+        stale_ok = float(stale) <= float(bound)
+    except (TypeError, ValueError):
+        stale_ok = False
+    if not stale_ok:
+        bad.append((loc, f"replica_staleness_s == {stale!r} (must record "
+                         f"a measured value <= {bound!r} — the journal "
+                         f"fan-out's bounded-staleness window broke)"))
+    crash = obj.get("crash_replay")
+    if not isinstance(crash, dict):
+        bad.append((loc, "replica artifact must record a 'crash_replay' "
+                         "cell (injected mid-replay crash + journal "
+                         "catch-up)"))
+    else:
+        for key in ("lost_facts", "doubled_facts"):
+            if crash.get(key) != 0:
+                bad.append((loc, f"crash_replay.{key} == "
+                                 f"{crash.get(key)!r} (must record a "
+                                 f"measured 0 — journal-subscriber "
+                                 f"recovery diverged)"))
+
+
 def _check_ingest(loc, obj, bad):
     """The ISSUE 9 sharded-ingest gate on one ``"ingest_sharded": true``
     dict."""
@@ -383,6 +455,7 @@ def main(argv):
     checked_online_ivf = 0
     checked_pq = 0
     checked_paged = 0
+    checked_replica = 0
     bad = []
     for p in paths:
         try:
@@ -392,12 +465,11 @@ def main(argv):
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
         (hits, recalls, speedups, meshes, tel_blocks, raggeds, tiereds,
-         ingests, online_ivfs, pq_fuseds, pageds) = ([], [], [], [], [],
-                                                     [], [], [], [], [],
-                                                     [])
+         ingests, online_ivfs, pq_fuseds, pageds, replicas) = (
+            [], [], [], [], [], [], [], [], [], [], [], [])
         _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
               tel_blocks, raggeds, tiereds, ingests, online_ivfs,
-              pq_fuseds, pageds)
+              pq_fuseds, pageds, replicas)
         grandfathered = os.path.basename(p).startswith(
             _PRE_TELEMETRY_PREFIXES)
         for loc, measured_fused, block in tel_blocks:
@@ -422,6 +494,9 @@ def main(argv):
         for loc, obj in pageds:
             checked_paged += 1
             _check_paged(loc, obj, bad)
+        for loc, obj in replicas:
+            checked_replica += 1
+            _check_replica(loc, obj, bad)
         for loc, v, planned in hits:
             checked += 1
             if v == 1:
@@ -472,8 +547,9 @@ def main(argv):
           f"{checked_tiered} tiered gate(s), "
           f"{checked_ingest} sharded-ingest gate(s), "
           f"{checked_online_ivf} online-ivf gate(s), "
-          f"{checked_pq} fused-pq gate(s), and "
-          f"{checked_paged} paged-arena gate(s) across "
+          f"{checked_pq} fused-pq gate(s), "
+          f"{checked_paged} paged-arena gate(s), and "
+          f"{checked_replica} replica gate(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 

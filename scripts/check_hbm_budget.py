@@ -128,19 +128,23 @@ def _geometry_from_gauge(plan_mod, key: str, artifact: dict):
     # in-kernel code maintenance) sweep through the PQ resident/transient
     # terms of the cost model.
     pq = 1 if lab.get("pq") == "true" else 0
+    # ISSUE 18: replica-group placements label their gauges with the
+    # fleet-wide replication factor; mesh_parts is already per-GROUP.
+    groups = int(lab.get("groups") or 1)
     if lab.get("path") == "ingest":
         return plan_mod.Geometry(
             kind="ingest", mode="ingest",
             batch=int(lab.get("batch") or 256), rows=rows, dim=int(dim),
             k=3, dtype_bytes=dtype_bytes,
             mesh_parts=_mesh_parts(lab.get("mesh", "1")),
-            ivf=1 if lab.get("ivf") == "true" else 0, pq=pq)
+            ivf=1 if lab.get("ivf") == "true" else 0, pq=pq,
+            replica_groups=groups)
     return plan_mod.Geometry(
         kind="serve", mode=lab.get("mode", "exact"),
         batch=int(lab.get("batch") or 128), rows=rows, dim=int(dim),
         k=int(lab.get("k") or 128), dtype_bytes=dtype_bytes,
         mesh_parts=_mesh_parts(lab.get("mesh", "1")), pq=pq,
-        slack=int(lab.get("slack") or 8))
+        slack=int(lab.get("slack") or 8), replica_groups=groups)
 
 
 def _geometry_from_dict(plan_mod, d: dict):
@@ -157,7 +161,8 @@ def _geometry_from_dict(plan_mod, d: dict):
             ivf=int(d.get("ivf", 0)),
             pq=int(d.get("pq", 0)),
             slack=int(d.get("slack", 8)),
-            pool_rows=int(d.get("pool_rows", 0)))
+            pool_rows=int(d.get("pool_rows", 0)),
+            replica_groups=int(d.get("replica_groups", 1)))
     except (TypeError, ValueError):
         return None
 

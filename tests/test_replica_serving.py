@@ -1,0 +1,228 @@
+"""Replica-group serving (ISSUE 18): placement, routing, freshness.
+
+The tentpole contract: G replica groups over the fleet, each a FULL pod
+index on a group-local sub-mesh; every routed turn is ONE distributed
+dispatch + ONE packed readback on exactly one group and is BIT-IDENTICAL
+to the single-group fused result (the serving program is the same code
+compiled against a narrower mesh); writes fan out through the
+IngestJournal with per-group cursors so a crash anywhere in the replay
+loses nothing and double-ingests nothing; overlay tenants partition
+instead of replicating (tenant isolation by placement). These tests pin
+each of those properties on 2- and 4-group splits of the 8-device host
+mesh, plus the ReplicaRouter's per-group scheduler wiring.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from lazzaro_tpu.parallel.index import ShardedMemoryIndex
+from lazzaro_tpu.parallel.mesh import make_mesh, replica_group_meshes
+from lazzaro_tpu.parallel.replica import ReplicaPlacement
+from lazzaro_tpu.reliability import faults
+from lazzaro_tpu.reliability.faults import InjectedFault
+from lazzaro_tpu.serve.scheduler import ReplicaRouter, RetrievalRequest
+from lazzaro_tpu.utils.telemetry import Telemetry
+
+D = 16
+CAP = 127
+
+
+def _placement(n_groups, tmp_path, **kw):
+    return ReplicaPlacement(
+        n_groups, D, capacity=CAP, dtype=np.float32, epoch=1000.0,
+        journal_path=str(tmp_path / f"journal_g{n_groups}.wal"),
+        telemetry=Telemetry(), **kw)
+
+
+def _corpus(n=48, seed=7):
+    rng = np.random.default_rng(seed)
+    return ([f"n{i}" for i in range(n)],
+            rng.standard_normal((n, D)).astype(np.float32))
+
+
+def _reqs(emb, tenant="shared", nq=6, k=5):
+    return [RetrievalRequest(query=emb[i], tenant=tenant, k=k)
+            for i in range(nq)]
+
+
+def _assert_bit_identical(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert ra.ids == rb.ids
+        np.testing.assert_array_equal(np.asarray(ra.scores, np.float32),
+                                      np.asarray(rb.scores, np.float32))
+
+
+# ----------------------------------------------------------------- meshes
+def test_replica_group_meshes_partition_the_fleet():
+    meshes = replica_group_meshes(4)
+    assert len(meshes) == 4
+    seen = []
+    for m in meshes:
+        assert m.shape["data"] == len(jax.devices()) // 4
+        seen.extend(m.devices.ravel().tolist())
+    assert sorted(d.id for d in seen) == [d.id for d in jax.devices()]
+    with pytest.raises(ValueError):
+        replica_group_meshes(3)     # 3 does not divide 8
+
+
+# ----------------------------------------------------------------- parity
+@pytest.mark.parametrize("n_groups", [2, 4])
+def test_routed_turn_bit_parity_with_single_group(n_groups, tmp_path):
+    """A routed turn served by one replica group is bit-identical to the
+    same corpus served by a standalone single-group index on a mesh of
+    the group's size — and every group agrees with every other."""
+    ids, emb = _corpus()
+    pl = _placement(n_groups, tmp_path)
+    pl.ingest(ids, emb, "shared")
+    per = len(jax.devices()) // n_groups
+    solo = ShardedMemoryIndex(
+        make_mesh(("data",), (per,), devices=jax.devices()[:per]),
+        dim=D, capacity=CAP, dtype=np.float32, epoch=1000.0,
+        telemetry=Telemetry())
+    solo.ingest(ids, emb, "shared")
+    reqs = _reqs(emb)
+    want = solo.serve_requests(reqs)
+    got = pl.serve(reqs)
+    _assert_bit_identical(got, want)
+    for g in pl.groups:                      # replicas agree bitwise too
+        _assert_bit_identical(g.serve_requests(reqs), want)
+
+
+# --------------------------------------------------------------- affinity
+def test_tenant_affinity_isolation(tmp_path):
+    """An overlay tenant's rows exist ONLY on its home group: no other
+    group ever holds (or can serve) them, while shared-tier facts
+    replicate everywhere."""
+    ids, emb = _corpus(32)
+    pl = _placement(4, tmp_path)
+    pl.ingest(ids, emb, "shared")
+    rng = np.random.default_rng(11)
+    ov_emb = rng.standard_normal((8, D)).astype(np.float32)
+    pl.ingest([f"ov{i}" for i in range(8)], ov_emb, "agent-a", overlay=True)
+    home = pl.group_for_tenant("agent-a")
+    for g, idx in enumerate(pl.groups):
+        ov_here = [i for i in idx.id_to_row if i.startswith("ov")]
+        shared_here = [i for i in idx.id_to_row if i.startswith("n")]
+        assert len(shared_here) == len(ids)          # shared: replicated
+        assert len(ov_here) == (8 if g == home else 0)
+    # affine routing: every overlay batch lands on the home group
+    reqs = _reqs(ov_emb, tenant="agent-a", nq=4, k=3)
+    assert pl.route_batch(reqs) == home
+    res = pl.serve(reqs)
+    assert res[0].ids[0] == "ov0"
+    # a mixed batch with overlay requests still pins to the home group
+    mixed = reqs[:2] + _reqs(emb, nq=2)
+    assert pl.route_batch(mixed) == home
+
+
+def test_shared_reads_spread_least_loaded(tmp_path):
+    ids, emb = _corpus(24)
+    pl = _placement(2, tmp_path)
+    pl.ingest(ids, emb, "shared")
+    for _ in range(4):
+        pl.serve(_reqs(emb, nq=2))
+    assert pl._turns == [2, 2]      # idle fleet spreads round-robin
+
+
+# ---------------------------------------------------------------- journal
+def test_crash_mid_replay_loses_nothing_and_doubles_nothing(tmp_path):
+    """The crash-during-replay fault cell: an injected death between two
+    subscriber replays leaves some groups behind — catch_up() replays
+    the journal past each cursor and converges with ZERO lost facts and
+    ZERO double-ingests (the id filter + in-dispatch dedup probe)."""
+    ids, emb = _corpus(20)
+    pl = _placement(4, tmp_path)
+    pl.ingest(ids[:8], emb[:8], "shared")        # healthy baseline batch
+    with faults.INJECTOR.armed("replica.mid_replay", times=1):
+        with pytest.raises(InjectedFault):
+            pl.ingest(ids[8:], emb[8:], "shared")
+    assert faults.INJECTOR.fired("replica.mid_replay") >= 1
+    assert pl.lag() >= 1                         # someone is behind
+    behind = [g for g, idx in enumerate(pl.groups)
+              if len(idx.id_to_row) < len(ids)]
+    assert behind                                # the crash was real
+    pl.catch_up()
+    for idx in pl.groups:
+        assert sorted(idx.id_to_row) == sorted(ids)      # zero lost
+        assert len(idx.row_to_id) == len(ids)            # zero doubled
+    assert pl.lag() == 0 and pl.staleness() == 0.0
+    assert pl.journal.pending_count == 0         # commit retired the drain
+    # replicas converged to the same serving answers as the primary
+    reqs = _reqs(emb, nq=4)
+    base = pl.groups[0].serve_requests(reqs)
+    for g in pl.groups[1:]:
+        _assert_bit_identical(g.serve_requests(reqs), base)
+
+
+def test_replay_is_idempotent_when_repeated(tmp_path):
+    """Replaying an already-applied journal batch is a no-op: cursors
+    reset to 0 (the fresh-process state) must not double-ingest."""
+    ids, emb = _corpus(12)
+    pl = _placement(2, tmp_path)
+    pl.ingest(ids, emb, "shared")
+    before = [dict(idx.id_to_row) for idx in pl.groups]
+    pl.ingest(ids[:0], emb[:0], "shared")        # no-op write
+    pl._applied = [0, 0]                         # model a restarted process
+    pl.catch_up()                                # journal already committed
+    for idx, snap in zip(pl.groups, before):
+        assert idx.id_to_row == snap
+
+
+# --------------------------------------------------------------- dispatch
+def test_one_dispatch_per_routed_turn_with_telemetry_on(tmp_path):
+    """Telemetry fully on, a routed turn costs exactly ONE device
+    dispatch fleet-wide: the serving program runs group-local and no
+    other group is touched."""
+    ids, emb = _corpus(32)
+    pl = _placement(2, tmp_path)
+    pl.ingest(ids, emb, "shared")
+    reqs = _reqs(emb)
+    for g in pl.groups:
+        g.serve_requests(reqs)                   # warm/compile both groups
+    calls = {g: 0 for g in range(pl.n_groups)}
+    for g, idx in enumerate(pl.groups):
+        orig = idx._dispatch
+
+        def counting(fn, *a, _g=g, _orig=orig, **kw):
+            calls[_g] += 1
+            return _orig(fn, *a, **kw)
+
+        idx._dispatch = counting
+    res = pl.serve(reqs)
+    assert len(res) == len(reqs)
+    assert sum(calls.values()) == 1
+
+
+# ----------------------------------------------------------------- router
+def test_replica_router_per_group_schedulers(tmp_path):
+    """ReplicaRouter: overlay tenants pin to their home group's
+    scheduler, shared traffic spreads least-loaded, and each group keeps
+    its OWN breaker/admission state."""
+    ids, emb = _corpus(24)
+    pl = _placement(2, tmp_path)
+    pl.ingest(ids, emb, "shared")
+    rng = np.random.default_rng(5)
+    ov_emb = rng.standard_normal((4, D)).astype(np.float32)
+    pl.ingest([f"ov{i}" for i in range(4)], ov_emb, "agent-b", overlay=True)
+    router = pl.make_router(max_batch=8)
+    try:
+        home = router.group_for_tenant("agent-b")
+        assert home == pl.group_for_tenant("agent-b")
+        futs = router.submit_many(
+            _reqs(ov_emb, tenant="agent-b", nq=3, k=3) + _reqs(emb, nq=3))
+        results = [f.result(timeout=30) for f in futs]
+        assert results[0].ids[0] == "ov0"
+        assert all(r.ids for r in results)
+        st = router.stats()
+        assert st["n_groups"] == 2
+        assert sum(g["requests_served"] for g in st["groups"]) == 6
+        # the overlay sub-group landed on the home scheduler
+        assert st["groups"][home]["requests_served"] >= 3
+        # per-group breakers are independent objects
+        breakers = {id(s.breaker) for s in router.schedulers}
+        assert len(breakers) == 2
+    finally:
+        router.close()

@@ -443,3 +443,47 @@ def test_coalesce_wait_span_recorded():
     assert co.oldest_age_s(103.0) == pytest.approx(3.0)
     co.drain()
     assert co.oldest_age_s(104.0) == 0.0
+
+
+def test_pod_add_rides_fused_ingest_no_extras_spills():
+    """ISSUE 18 satellite: with live online-IVF tables, an all-fresh pod
+    ``add()`` routes through the fused ingest program — the in-kernel
+    assignment lands the rows in member slots, so ``ivf.add_extras_spills``
+    stays flat — while add() semantics are untouched: duplicate
+    embeddings still get their own rows (nothing merges), no similarity
+    edges insert, and re-adds keep the classic overwrite-in-place path."""
+    from lazzaro_tpu.utils.telemetry import Telemetry
+
+    tel = Telemetry()
+    rng = np.random.default_rng(9)
+    idx = ShardedMemoryIndex(_mesh(2), dim=D, capacity=CAP,
+                             dtype=np.float32, telemetry=tel)
+    emb = rng.standard_normal((40, D)).astype(np.float32)
+    idx.add([f"s{i}" for i in range(40)], emb, "u")
+    assert idx.ivf_build(nprobe=4)
+    spills0 = tel.counter_total("ivf.add_extras_spills")
+    ing0 = idx.ingest_dispatch_count
+    edges0 = len(idx.edges)
+    dup = rng.standard_normal((1, D)).astype(np.float32)
+    batch = np.concatenate([dup, dup,
+                            rng.standard_normal((4, D)).astype(np.float32)])
+    rows = idx.add([f"f{i}" for i in range(6)], batch, "u")
+    # happy path: fused write, zero extras spills, rows routed in-kernel
+    assert idx.ingest_dispatch_count == ing0 + 1
+    assert tel.counter_total("ivf.add_extras_spills") == spills0
+    assert all(idx._ivf_routed[r] for r in rows)
+    assert not idx._ivf_fresh
+    # add() semantics intact: 6 distinct rows (the identical pair did NOT
+    # merge), every id registered, and no edges appeared
+    assert len(set(rows)) == 6
+    assert all(idx.id_to_row[f"f{i}"] == r for i, r in enumerate(rows))
+    assert len(idx.edges) == edges0
+    # a re-add of an existing id keeps the classic overwrite path
+    spills1 = tel.counter_total("ivf.add_extras_spills")
+    again = idx.add(["f0"], rng.standard_normal((1, D)).astype(np.float32),
+                    "u")
+    assert again == [rows[0]]
+    assert tel.counter_total("ivf.add_extras_spills") >= spills1
+    # the new facts are servable
+    ids, _ = idx.search(batch[2], "u")
+    assert ids[0] == "f2"

@@ -264,6 +264,35 @@ def test_sharded_index_per_shard_cold_stores():
                           np.asarray(si_h.state.salience))
 
 
+def test_dense_demote_never_surfaces_in_exact_search():
+    """Residency parity (ISSUE 18): a DENSE-layout demote zero-fills the
+    master row but leaves it alive, so the plain exact scan used to
+    surface demoted rows as a score-0.0 top-k tail (the paged layout
+    frees the slot, so the two layouts diverged). With the cold column
+    masked to -inf, demote is indistinguishable from delete on the
+    exact serve — bitwise, full k-list — on one chip and a 2-way mesh."""
+    from lazzaro_tpu.parallel.mesh import make_mesh
+
+    meshes = [None]
+    if len(jax.devices()) >= 2:
+        meshes.append(make_mesh(("data",), (2,), devices=jax.devices()[:2]))
+    demoted = sorted(f"n{i}" for i in range(100, 200))
+    for mesh in meshes:
+        idx_d = MemoryIndex(dim=D, capacity=255, mesh=mesh, epoch=1000.0)
+        emb = _fill(idx_d, edges=False)
+        tm = idx_d.enable_tiering(hot_budget_rows=64, hysteresis_s=0.0)
+        assert tm.demote_rows([idx_d.id_to_row[i] for i in demoted]) == 100
+        idx_x = MemoryIndex(dim=D, capacity=255, mesh=mesh, epoch=1000.0)
+        _fill(idx_x, edges=False)
+        idx_x.delete(demoted)
+        for q in emb[100:106]:      # queries aimed AT the demoted slab
+            ids_d, sc_d = idx_d.search(q, "u0", k=20)
+            ids_x, sc_x = idx_x.search(q, "u0", k=20)
+            assert not (set(ids_d) & set(demoted))
+            assert ids_d == ids_x
+            assert sc_d == sc_x     # bitwise: same masked score vector
+
+
 # --------------------------------------------------------- dispatch counts
 def _count_tier_dispatches(monkeypatch):
     calls = {"scan": 0, "finish": 0}
