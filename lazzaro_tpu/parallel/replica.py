@@ -39,7 +39,9 @@ group is just a journal SUBSCRIBER:
 - **overlay tenants** (``overlay=True``) partition instead of
   replicating: their facts carry an overlay marker in the journal and
   apply ONLY to the home group — tenant isolation by placement, and the
-  replay filter keeps it through crash recovery too.
+  replay filter keeps it through crash recovery too. The registration
+  itself is durable (a journal record that survives commit/compaction),
+  so a restarted process keeps the tenant partitioned and pinned.
 
 Staleness is bounded and MEASURED, not assumed: ``append()`` stamps each
 seq, ``staleness()`` reports the age of the oldest batch any group has
@@ -70,6 +72,7 @@ from lazzaro_tpu.parallel.index import ShardedMemoryIndex
 from lazzaro_tpu.parallel.mesh import replica_group_meshes
 from lazzaro_tpu.reliability import faults
 from lazzaro_tpu.reliability.journal import IngestJournal
+from lazzaro_tpu.utils.hashing import tenant_home_group
 from lazzaro_tpu.utils.telemetry import default_registry
 
 
@@ -107,7 +110,11 @@ class ReplicaPlacement:
         # first replicate()/catch_up() — the idempotence filters make
         # that safe regardless of which groups had applied them.
         self._applied: List[int] = [0] * self.n_groups
-        self.overlay_tenants: set = set()
+        # Overlay registration is DURABLE (journal records that survive
+        # commit/compaction): a new process over the same journal keeps
+        # pinning a previously-overlay tenant's reads to its home group
+        # and keeps its future writes partitioned.
+        self.overlay_tenants: set = set(self.journal.overlay_tenants)
         self._turns: List[int] = [0] * self.n_groups
         self._route_lock = threading.Lock()
         self._rr = 0
@@ -116,8 +123,11 @@ class ReplicaPlacement:
     def group_for_tenant(self, tenant: str) -> int:
         """Stable home-group assignment (same idiom as the pod index's
         row-partition affinity): a tenant's overlay rows live only here,
-        and its shared writes run their PRIMARY fused ingest here."""
-        return abs(hash(tenant)) % self.n_groups
+        and its shared writes run their PRIMARY fused ingest here.
+        Process-stable (CRC32, not the salted builtin ``hash``) so a
+        restarted process re-homes journal replay and overlay reads to
+        the SAME group that holds the surviving rows."""
+        return tenant_home_group(tenant, self.n_groups)
 
     @property
     def dispatch_count(self) -> int:
@@ -144,6 +154,7 @@ class ReplicaPlacement:
                     "chains": [], "counters": {}}
         if overlay:
             self.overlay_tenants.add(tenant)
+            self.journal.register_overlay(tenant)
         ov = tenant in self.overlay_tenants
         emb = np.asarray(embeddings, np.float32).reshape(n, self.dim)
         if saliences is None:
@@ -153,6 +164,15 @@ class ReplicaPlacement:
                  for i, e, s in zip(ids, emb, saliences)]
         seq = self.journal.append(facts)
         home = self.group_for_tenant(tenant)
+        # Catch home up on any OLDER pending batches first (deferred
+        # fan-outs appended by tenants homed elsewhere). A cursor may
+        # only advance over contiguously-applied seqs: jumping it past a
+        # batch home never applied would let commit(min(_applied))
+        # retire that batch from the journal while home still needs it.
+        for pseq, pfacts in self.journal.pending():
+            if self._applied[home] < pseq < seq:
+                self._apply_batch(home, pfacts, **ingest_kw)
+                self._applied[home] = pseq
         out = self._apply_batch(home, facts, **ingest_kw)
         self._applied[home] = max(self._applied[home], seq)
         self.telemetry.bump(
@@ -194,6 +214,8 @@ class ReplicaPlacement:
             out["merged"].update(got["merged"])
             out["links"].extend(got["links"])
             out["chains"].extend(got["chains"])
+            for k, v in got.get("counters", {}).items():
+                out["counters"][k] = out["counters"].get(k, 0) + v
         return out
 
     def replicate(self) -> int:
@@ -250,28 +272,31 @@ class ReplicaPlacement:
         """The group ONE coalesced mega-batch routes to: the home group
         when the batch carries overlay tenants (they must agree — the
         per-request router in :meth:`make_router` never mixes homes),
-        least-loaded round-robin otherwise."""
+        least-loaded round-robin otherwise. Selecting a group RESERVES
+        the turn (``_turns`` bumps under the same lock acquisition), so
+        concurrent callers never all pick the same least-loaded group."""
         homes = {self.group_for_tenant(r.tenant) for r in reqs
                  if r.tenant in self.overlay_tenants}
         if len(homes) > 1:
             raise ValueError(
                 "one mega-batch mixes overlay tenants with different home "
                 "groups — route per request (make_router) instead")
-        if homes:
-            return homes.pop()
         with self._route_lock:
-            lo = min(self._turns)
-            candidates = [g for g, t in enumerate(self._turns) if t == lo]
-            g = candidates[self._rr % len(candidates)]
-            self._rr += 1
+            if homes:
+                g = homes.pop()
+            else:
+                lo = min(self._turns)
+                candidates = [g for g, t in enumerate(self._turns)
+                              if t == lo]
+                g = candidates[self._rr % len(candidates)]
+                self._rr += 1
+            self._turns[g] += 1
             return g
 
     def serve(self, reqs) -> List:
         """Serve one coalesced mega-batch on exactly one group: ONE
         distributed dispatch + ONE packed readback, group-local."""
         g = self.route_batch(reqs)
-        with self._route_lock:
-            self._turns[g] += 1
         self.telemetry.bump("serve.replica_routed_turns",
                             labels={"group": str(g)})
         return self.groups[g].serve_requests(reqs)

@@ -38,6 +38,13 @@ wall-clock per seq so ``oldest_age()`` / ``lag()`` can measure the
 bounded-staleness window (``serve_replica_staleness_s``) and the
 ``journal.replica_lag`` gauge — purely in-memory observability, never
 persisted (a restart re-replays pending batches anyway).
+
+Overlay-tenant registration IS persisted: ``register_overlay()``
+appends an ``{"op": "overlay"}`` record that survives ``commit()``
+(compaction rewrites the registrations into the fresh log), so a new
+process rebuilds the overlay-tenant set from ``overlay_tenants`` and a
+previously-overlay tenant keeps partitioning — its reads keep pinning
+to the home group and its future writes never replicate fleet-wide.
 """
 
 from __future__ import annotations
@@ -62,6 +69,8 @@ class IngestJournal:
         # seq -> append wall-time (in-memory only; staleness observability
         # for replica subscribers — see the module docstring)
         self._append_ts: Dict[int, float] = {}
+        # durable overlay-tenant registrations (survive commit/compaction)
+        self._overlays: set = set()
         self._next_seq = 1
         self._replay_into_memory()
 
@@ -82,6 +91,8 @@ class IngestJournal:
                 pending[seq] = rec["facts"]
             elif op == "commit":
                 committed = max(committed, seq)
+            elif op == "overlay" and isinstance(rec.get("tenant"), str):
+                self._overlays.add(rec["tenant"])
         self._pending = {s: f for s, f in pending.items() if s > committed}
         top = max(pending.keys(), default=0)
         self._next_seq = max(top, committed) + 1
@@ -113,8 +124,14 @@ class IngestJournal:
             for s in [s for s in self._append_ts if s <= seq]:
                 del self._append_ts[s]
             if not self._pending:
-                # everything retired: truncating IS the commit record
+                # everything retired: truncating IS the commit record —
+                # but overlay registrations must outlive compaction, so
+                # rewrite them into the fresh log
                 self._wal.reset()
+                for tenant in sorted(self._overlays):
+                    self._wal.append(json.dumps(
+                        {"op": "overlay",
+                         "tenant": tenant}).encode("utf-8"))
             else:
                 self._wal.append(json.dumps(
                     {"op": "commit", "seq": seq}).encode("utf-8"))
@@ -140,6 +157,25 @@ class IngestJournal:
         past its applied-seq cursor)."""
         with self._lock:
             return sorted(self._pending.items())
+
+    # --------------------------------------------------- replica placement
+    def register_overlay(self, tenant: str) -> None:
+        """Durably mark ``tenant`` as overlay (partitioned, home-group
+        only). The registration survives commit/compaction and restarts,
+        so placement stays correct for the tenant's whole lifetime."""
+        with self._lock:
+            if tenant in self._overlays:
+                return
+            self._overlays.add(tenant)
+            self._wal.append(json.dumps(
+                {"op": "overlay", "tenant": tenant}).encode("utf-8"))
+
+    @property
+    def overlay_tenants(self) -> set:
+        """Copy of the durably-registered overlay tenants (rebuilt from
+        the log on startup)."""
+        with self._lock:
+            return set(self._overlays)
 
     # ------------------------------------------------- replica observability
     def lag(self, applied_seq: int) -> int:
@@ -167,4 +203,5 @@ class IngestJournal:
         with self._lock:
             self._pending.clear()
             self._append_ts.clear()
+            self._overlays.clear()
             self._wal.reset()

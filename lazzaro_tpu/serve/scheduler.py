@@ -83,6 +83,7 @@ from lazzaro_tpu.reliability.errors import (DispatchTimeout, LoadShed,
 from lazzaro_tpu.reliability.watchdog import CircuitBreaker
 from lazzaro_tpu.utils.batching import FlushPolicy
 from lazzaro_tpu.utils.compat import step_trace_annotation
+from lazzaro_tpu.utils.hashing import tenant_home_group
 from lazzaro_tpu.utils.telemetry import default_registry
 
 logger = logging.getLogger("lazzaro_tpu.serve")
@@ -524,9 +525,10 @@ class ReplicaRouter:
 
     - **tenant-affine**: tenants named in ``affine_tenants`` (the
       placement layer registers every overlay tenant it ingests) always
-      route to ``hash(tenant) % n_groups`` — their private rows exist
-      ONLY on that home group, and the pinning also buys read-your-writes
-      for shared-tier tenants that opt in;
+      route to their stable home group (``utils.hashing``'s CRC32-based
+      ``tenant_home_group``, the same assignment the write side uses) —
+      their private rows exist ONLY on that home group, and the pinning
+      also buys read-your-writes for shared-tier tenants that opt in;
     - **least-loaded**: everything else routes to the group whose
       scheduler reports the smallest queue depth + in-flight count
       (:meth:`QueryScheduler.load`), ties broken round-robin so an idle
@@ -568,10 +570,10 @@ class ReplicaRouter:
         return self.group_for_tenant(tenant)
 
     def group_for_tenant(self, tenant: str) -> int:
-        """The tenant's home group (stable hash — the same assignment the
-        write-side placement uses, so affine reads land where the
-        tenant's overlay rows live)."""
-        return abs(hash(tenant)) % len(self.schedulers)
+        """The tenant's home group (process-stable hash — the same
+        assignment the write-side placement uses, so affine reads land
+        where the tenant's overlay rows live, across restarts too)."""
+        return tenant_home_group(tenant, len(self.schedulers))
 
     def route(self, request: RetrievalRequest) -> int:
         if request.tenant in self.affine_tenants:

@@ -1,0 +1,27 @@
+"""Process-stable hashing for placement decisions.
+
+Python's builtin ``hash(str)`` is salted per process (PYTHONHASHSEED),
+so anything derived from it — like a tenant's replica home group —
+silently changes across restarts. Placement must be durable: an overlay
+tenant's rows exist ONLY on its home group, and journal replay after a
+crash must re-home facts to the SAME group that holds the surviving
+rows. Every placement-affecting hash in the tree routes through here.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+
+def stable_str_hash(s: str) -> int:
+    """Deterministic non-negative hash of ``s`` — same value in every
+    process, every PYTHONHASHSEED, every platform."""
+    return zlib.crc32(s.encode("utf-8")) & 0xFFFFFFFF
+
+
+def tenant_home_group(tenant: str, n_groups: int) -> int:
+    """The tenant's stable home replica group in ``[0, n_groups)``. Used
+    by BOTH the write-side placement (ReplicaPlacement) and the
+    read-side router (ReplicaRouter) so affine reads always land where
+    the tenant's overlay rows live — including after a restart."""
+    return stable_str_hash(tenant) % n_groups

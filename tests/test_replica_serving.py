@@ -196,6 +196,92 @@ def test_one_dispatch_per_routed_turn_with_telemetry_on(tmp_path):
     assert sum(calls.values()) == 1
 
 
+# ----------------------------------------------------- review regressions
+def _tenants_with_distinct_homes(pl):
+    """Two tenant names whose stable home groups differ."""
+    by_home = {}
+    for i in range(64):
+        t = f"tenant-{i}"
+        by_home.setdefault(pl.group_for_tenant(t), t)
+        if len(by_home) == pl.n_groups:
+            break
+    homes = sorted(by_home)
+    return by_home[homes[0]], by_home[homes[1]]
+
+
+def test_deferred_fanout_interleaved_homes_loses_nothing(tmp_path):
+    """Cursor contiguity: interleaved ``replicate=False`` ingests from
+    tenants with DIFFERENT home groups must not let any group's cursor
+    jump past a seq it never applied — the later replicate() has to
+    deliver every batch to every group (and only then may commit retire
+    it from the journal)."""
+    pl = _placement(2, tmp_path)
+    ta, tb = _tenants_with_distinct_homes(pl)
+    rng = np.random.default_rng(3)
+    emb_a = rng.standard_normal((6, D)).astype(np.float32)
+    emb_b = rng.standard_normal((6, D)).astype(np.float32)
+    ids_a = [f"a{i}" for i in range(6)]
+    ids_b = [f"b{i}" for i in range(6)]
+    pl.ingest(ids_a, emb_a, ta, replicate=False)     # seq 1, home A
+    pl.ingest(ids_b, emb_b, tb, replicate=False)     # seq 2, home B
+    pl.replicate()
+    for idx in pl.groups:
+        assert sorted(idx.id_to_row) == sorted(ids_a + ids_b)  # zero lost
+        assert len(idx.row_to_id) == 12                        # zero doubled
+    assert pl.journal.pending_count == 0
+    assert pl.lag() == 0
+
+
+def test_home_group_is_process_stable():
+    """Home-group assignment must survive restarts (PYTHONHASHSEED):
+    both the placement and the router derive it from CRC32, never the
+    salted builtin ``hash``."""
+    import zlib
+
+    from lazzaro_tpu.utils.hashing import tenant_home_group
+
+    for tenant in ("agent-a", "agent-b", "shared", "tenant-42"):
+        want = (zlib.crc32(tenant.encode("utf-8")) & 0xFFFFFFFF) % 4
+        assert tenant_home_group(tenant, 4) == want
+
+
+def test_overlay_registration_survives_restart(tmp_path):
+    """A previously-overlay tenant stays partitioned and pinned after a
+    new process reopens the same journal — registration is durable past
+    commit/compaction, not in-memory only."""
+    ids, emb = _corpus(16)
+    pl = _placement(2, tmp_path)
+    pl.ingest(ids, emb, "shared")
+    rng = np.random.default_rng(9)
+    ov1 = rng.standard_normal((4, D)).astype(np.float32)
+    pl.ingest([f"ov{i}" for i in range(4)], ov1, "agent-c", overlay=True)
+    home = pl.group_for_tenant("agent-c")
+    assert pl.journal.pending_count == 0     # committed (and compacted)
+
+    pl2 = _placement(2, tmp_path)            # new process, same journal
+    assert "agent-c" in pl2.overlay_tenants
+    assert pl2.group_for_tenant("agent-c") == home
+    ov2 = rng.standard_normal((4, D)).astype(np.float32)
+    pl2.ingest([f"ow{i}" for i in range(4)], ov2, "agent-c")  # no flag
+    for g, idx in enumerate(pl2.groups):
+        here = [i for i in idx.id_to_row if i.startswith("ow")]
+        assert len(here) == (4 if g == home else 0)
+
+
+def test_ingest_result_merges_counters(tmp_path):
+    """ReplicaPlacement.ingest() surfaces the fused ingest's counter
+    deltas instead of always returning an empty dict."""
+    ids, emb = _corpus(8)
+    pl = _placement(2, tmp_path)
+    out = pl.ingest(ids, emb, "shared")
+    assert out["counters"]
+    assert "dedup_hits" in out["counters"]
+    # new ids, identical content: the in-dispatch dedup probe fires and
+    # the delta must surface through the merged result
+    dup = pl.ingest([f"dup{i}" for i in range(4)], emb[:4], "shared")
+    assert dup["counters"].get("dedup_hits", 0) >= 1
+
+
 # ----------------------------------------------------------------- router
 def test_replica_router_per_group_schedulers(tmp_path):
     """ReplicaRouter: overlay tenants pin to their home group's
