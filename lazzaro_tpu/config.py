@@ -323,6 +323,26 @@ class MemoryConfig:
     # None keeps the cold tier in host RAM.
     tier_cold_dir: Optional[str] = None
 
+    # --- device-side lifecycle (ISSUE 19) ----------------------------------
+    # ``MemorySystem.lifecycle_tick`` runs decay + weak-edge prune +
+    # importance-ranked archive verdicts for ALL tenants as ONE donated
+    # dispatch + ONE packed readback; "archived" means demoted-to-cold
+    # (verdicts feed the TierPump queue), never deleted. False falls back
+    # to the classic host-driven per-tenant loop (the A/B + bit-parity
+    # oracle).
+    lifecycle_fused: bool = True
+    # Background tick cadence; 0 disables the thread (call
+    # ``lifecycle_tick()`` manually — tests and bench do).
+    lifecycle_interval_s: float = 0.0
+    # Bottom-k archive verdicts per tenant per sweep (0 skips the archive
+    # stage's host decode; the readback layout is unchanged).
+    lifecycle_archive_k: int = 8
+    # Scheduler-awareness: a tick defers (lifecycle.deferred_busy) while
+    # the serving scheduler reports more than this many pending+inflight
+    # requests, so maintenance never queues behind — or races — an
+    # in-flight serve/ingest donation.
+    lifecycle_busy_load: int = 0
+
     # --- serving telemetry (ISSUE 6) ---------------------------------------
     # Host spans + device counters: every request records enqueue→flush
     # queue wait (per-tenant label), every coalesced batch records pad

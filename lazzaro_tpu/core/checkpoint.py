@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import ml_dtypes
 
 from lazzaro_tpu.core import state as S
-from lazzaro_tpu.core.index import MemoryIndex
+from lazzaro_tpu.core.index import MemoryIndex, _EdgeSlotMap
 from lazzaro_tpu.reliability import faults
 from lazzaro_tpu.reliability.errors import CheckpointCorrupt
 
@@ -466,11 +466,11 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
     id_by_row[node_rows] = node_ids
     src_ids = id_by_row[np.asarray(edges.src)[live_slots]]
     tgt_ids = id_by_row[np.asarray(edges.tgt)[live_slots]]
-    index.edge_slots = {
+    index.edge_slots = _EdgeSlotMap({
         (s, t): int(slot)
         for s, t, slot in zip(src_ids.tolist(), tgt_ids.tolist(),
                               live_slots.tolist())
-        if s is not None and t is not None}
+        if s is not None and t is not None})
     free_e = np.setdiff1d(np.arange(edges.capacity, dtype=np.int64),
                           np.asarray(sorted(index.edge_slots.values()),
                                      np.int64))

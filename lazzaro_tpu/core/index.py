@@ -43,12 +43,14 @@ from lazzaro_tpu.utils.telemetry import (default_registry, peak_bytes,
                                          record_device_counters)
 
 
-def build_host_csr(edge_keys, id_to_row: Dict[str, int], n: int
-                   ) -> Tuple[np.ndarray, np.ndarray]:
+def build_host_csr(edge_keys, id_to_row: Dict[str, int], n: int,
+                   min_pad: int = 0) -> Tuple[np.ndarray, np.ndarray]:
     """Host-side CSR build shared by the single-chip and pod serving paths:
     ``(indptr [n+1] i32, nbr [E_pad] i32)`` over ``n`` arena rows from an
     iterable of ``(src_id, tgt_id)`` edge keys (bidirectional, -1 padded to
-    a pow2 bucket). Built entirely from host bookkeeping — no device
+    a pow2 bucket, never below ``min_pad`` — callers pass their previous
+    pad so a pruned-down edge set can't shrink the bucket and recompile
+    the serving program). Built entirely from host bookkeeping — no device
     readback."""
     src_l, dst_l = [], []
     for qsrc, qtgt in edge_keys:
@@ -69,7 +71,8 @@ def build_host_csr(edge_keys, id_to_row: Dict[str, int], n: int
         src = dst = np.zeros((0,), np.int64)
     indptr = np.zeros((n + 1,), np.int32)
     indptr[1:] = np.cumsum(np.bincount(src, minlength=n))
-    nbr = np.full((max(8, next_pow2(len(dst))),), -1, np.int32)
+    nbr = np.full((max(8, int(min_pad), next_pow2(len(dst))),), -1,
+                  np.int32)
     nbr[:len(dst)] = dst
     return indptr, nbr
 
@@ -120,6 +123,46 @@ def link_pool_dev(pool: Sequence[int], padded_len: int, ecap: int):
     arr = np.full((padded_len + 1,), ecap, np.int32)
     arr[:len(pool)] = pool
     return jnp.asarray(arr)
+
+
+class _EdgeSlotMap(dict):
+    """``(qsrc, qtgt) -> device slot`` edge map with an inline ``by_slot``
+    reverse index (ISSUE 19): the prune kernels now return the COMPACTED
+    pruned-slot list, and decoding it through ``by_slot`` makes host
+    cleanup O(pruned) — the old path re-scanned every live edge's dict
+    entry per prune. All single-key mutation funnels through
+    ``__setitem__`` / ``__delitem__`` / ``pop``; wholesale replacement
+    (checkpoint load, replica hydration) rebuilds the reverse index in
+    ``__init__``."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.by_slot: Dict[int, Tuple[str, str]] = {
+            slot: key for key, slot in self.items()}
+
+    def __setitem__(self, key, slot) -> None:
+        old = super().get(key)
+        if old is not None:
+            self.by_slot.pop(old, None)
+        super().__setitem__(key, slot)
+        self.by_slot[slot] = key
+
+    def __delitem__(self, key) -> None:
+        slot = dict.pop(self, key)
+        self.by_slot.pop(slot, None)
+
+    def pop(self, key, *default):
+        if key in self:
+            slot = dict.pop(self, key)
+            self.by_slot.pop(slot, None)
+            return slot
+        if default:
+            return default[0]
+        raise KeyError(key)
+
+    def clear(self) -> None:
+        super().clear()
+        self.by_slot.clear()
 
 
 class MemoryIndex:
@@ -289,6 +332,13 @@ class MemoryIndex:
         # kernels) — the measured ``dispatches_per_conversation`` counter
         # bench and the jit-counter tests read.
         self.ingest_dispatch_count = 0
+        # Lifecycle-sweep dispatch counter (ISSUE 19) — one call == one
+        # device program (single chip or distributed); the jit-counter
+        # tests and bench_lifecycle read ``dispatches_per_sweep`` off it.
+        self.lifecycle_dispatch_count = 0
+        # Compaction-bucket high-water mark (see _prune_cap): grows-only
+        # so a draining edge pool never recompile-thrashes the sweep.
+        self._prune_cap_hwm = 0
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             self._row_sharding = NamedSharding(mesh, P(shard_axis))
@@ -335,7 +385,7 @@ class MemoryIndex:
         self._free_edge_slots: List[int] = list(range(edge_capacity - 1, -1, -1))
         self.id_to_row: Dict[str, int] = {}
         self.row_to_id: Dict[int, str] = {}
-        self.edge_slots: Dict[Tuple[str, str], int] = {}
+        self.edge_slots: _EdgeSlotMap = _EdgeSlotMap()
         self._tenants: Dict[str, int] = {}
         self._shards: Dict[str, int] = {}
         self.tenant_nodes: Dict[str, set] = {}
@@ -359,6 +409,10 @@ class MemoryIndex:
         # (ISSUE 7 satellite): mixed-k non-ragged traffic used to grow
         # this without bound while kernel.cache_entries just watched.
         self._fused_sharded_cache = LRUKernelCache(serve_kernel_cache_max)
+        # Distributed lifecycle-sweep programs (ISSUE 19): one per
+        # (prune_cap bucket, archive_k bucket), same LRU discipline as
+        # the serving/ingest factories.
+        self._lifecycle_sharded_cache = LRUKernelCache(serve_kernel_cache_max)
         # CSR adjacency shadow for the fused retrieval kernel: a device
         # (indptr, neighbors) pair built from the HOST edge map (edge_slots
         # + id_to_row — no device readback needed), invalidated by edge
@@ -366,6 +420,10 @@ class MemoryIndex:
         # neighbor-boost semantics don't read).
         self._csr_cache = None             # (rows, indptr_dev, nbr_dev)
         self._csr_dirty = True
+        # Grows-only nbr pad bucket (see build_host_csr): a maintenance
+        # sweep pruning edges must never shrink the serve program's CSR
+        # shape mid-flight — that recompile stalls live serving.
+        self._csr_pad_hwm = 0
         # Tiered memory (ISSUE 8): None until ``enable_tiering`` attaches a
         # ``tier.TierManager`` (residency column + host cold stores + the
         # watermark pump policy). ``_emb_gen`` is the embedding-write
@@ -2495,7 +2553,9 @@ class MemoryIndex:
             return cache[1], cache[2]
         self._csr_dirty = False
         indptr, nbr = build_host_csr(list(self.edge_slots.keys()),
-                                     self.id_to_row, n)
+                                     self.id_to_row, n,
+                                     min_pad=self._csr_pad_hwm)
+        self._csr_pad_hwm = nbr.shape[0]
         if self.mesh is not None:
             # pod path: per-shard CSR slices for the distributed fused
             # kernel, placed so each chip holds its own rows' lists
@@ -3608,13 +3668,25 @@ class MemoryIndex:
             jnp.float32((now if now is not None else time.time()) - self.epoch))
 
     def decay(self, tenant: str, rate: float, salience_floor: float = 0.2) -> None:
+        """Classic per-tenant decay tick — arena salience + edge weights in
+        ONE fused dispatch (ISSUE 19 satellite; this used to be two device
+        round trips per tenant per tick)."""
         tid = self._tenants.get(tenant)
         if tid is None:
             return
-        self._apply_arena(S.arena_decay, S.arena_decay_copy, jnp.int32(tid),
-                          jnp.float32(rate), jnp.float32(salience_floor))
-        self._apply_edges(S.edges_decay, S.edges_decay_copy, jnp.int32(tid),
-                          jnp.float32(rate))
+        with self._state_lock:
+            arena, edges = self._state, self._edge_state
+            sole = (sys.getrefcount(arena) <= self._SOLE_REFS
+                    and sys.getrefcount(edges) <= self._SOLE_REFS)
+            new_arena, new_edges = self._guarded(
+                lambda fn: self._lifecycle_dispatch(
+                    fn, arena, edges, jnp.int32(tid), jnp.float32(rate),
+                    jnp.float32(salience_floor)),
+                S.decay_fused, S.decay_fused_copy, sole, (arena, edges),
+                "decay")
+            del arena, edges
+            self.state = new_arena
+            self.edge_state = new_edges
 
     def evict_candidates(self, tenant: str, k: int, now: Optional[float] = None,
                          weights: Tuple[float, float, float] = (0.5, 0.3, 0.2)
@@ -3639,6 +3711,232 @@ class MemoryIndex:
             if node_id is not None:
                 out.append((node_id, float(imp)))
         return out[:k]
+
+    # ------------------------------------------------ device-side lifecycle
+    def _lifecycle_dispatch(self, fn, *args, **kwargs):
+        """Every lifecycle device program goes through here — bench and
+        the jit-counter tests wrap it (one call == one dispatch, single
+        chip or distributed), mirroring ``_ingest_dispatch``."""
+        self.lifecycle_dispatch_count += 1
+        return fn(*args, **kwargs)
+
+    def _lifecycle_sharded_kernels(self, prune_cap: int, archive_k: int
+                                   ) -> S.LifecycleShardedKernels:
+        """Cached distributed lifecycle-sweep programs per (prune_cap,
+        archive_k) bucket — both are pow2-bucketed by the caller, so the
+        cache stays tiny."""
+        key = (prune_cap, archive_k)
+        kern = self._lifecycle_sharded_cache.get(key)
+        if kern is None:
+            kern = S.make_lifecycle_sharded(
+                self.mesh, self.shard_axis, prune_cap=prune_cap,
+                archive_k=archive_k)
+            self._lifecycle_sharded_cache.put(key, kern)
+            self.telemetry.gauge("kernel.cache_entries",
+                                 len(self._lifecycle_sharded_cache),
+                                 labels={"surface": "lifecycle_sharded"})
+        return kern
+
+    def _apply_lifecycle(self, *args, prune_cap: int, archive_k: int):
+        """Combined arena+edges donation gate for the all-tenant sweep:
+        BOTH states hand off through ONE ``_guarded`` dispatch (compound
+        sole check, mirror of ``_apply_fused``); returns the packed
+        payload. Under a mesh the program is the ``make_lifecycle_sharded``
+        composition — still ONE distributed dispatch."""
+        sharded = self.mesh is not None
+        with self._state_lock:
+            arena, edges = self._state, self._edge_state
+            sole = (sys.getrefcount(arena) <= self._SOLE_REFS
+                    and sys.getrefcount(edges) <= self._SOLE_REFS)
+            if sharded:
+                kern = self._lifecycle_sharded_kernels(prune_cap, archive_k)
+                new_arena, new_edges, payload = self._guarded(
+                    lambda fn: self._lifecycle_dispatch(
+                        fn, arena, edges, *args),
+                    kern.sweep, kern.sweep_copy, sole, (arena, edges),
+                    "lifecycle")
+            else:
+                new_arena, new_edges, payload = self._guarded(
+                    lambda fn: self._lifecycle_dispatch(
+                        fn, arena, edges, *args, prune_cap=prune_cap,
+                        archive_k=archive_k),
+                    S.lifecycle_sweep, S.lifecycle_sweep_copy, sole,
+                    (arena, edges), "lifecycle")
+            del arena, edges
+            self.state = new_arena
+            self.edge_state = new_edges
+        return payload
+
+    def _prune_cap(self) -> int:
+        """Static compaction-buffer bucket for the prune kernels: pow2 of
+        the live host edge count (so the cap can never bind — every weak
+        edge fits), floored to bound jit specializations, capped at the
+        pool size. The bucket only ever GROWS (high-water mark): a
+        draining edge population crossing pow2 boundaries downward would
+        otherwise recompile the fused sweep on every crossing — an
+        oversized compaction buffer costs a few KiB of readback, a
+        recompile stalls live serving for hundreds of ms."""
+        cap = min(self.edge_state.capacity,
+                  max(256, next_pow2(max(1, len(self.edge_slots))),
+                      self._prune_cap_hwm))
+        self._prune_cap_hwm = cap
+        return cap
+
+    def _lifecycle_geometry(self, tv: int, archive_k: int) -> Geometry:
+        """The sweep's planner geometry: ``batch`` carries the verdict-
+        tenant count (the [Tv, rows] masked-importance tile is the
+        transient high-water mark), ``k`` the archive depth."""
+        return Geometry(
+            kind="lifecycle", mode="lifecycle", batch=max(1, int(tv)),
+            rows=self.state.salience.shape[0], dim=self.dim,
+            k=max(1, int(archive_k)),
+            dtype_bytes=int(np.dtype(self.dtype).itemsize),
+            mesh_parts=self._n_parts, edge_cap=self.edge_state.capacity,
+            pool_rows=(self.state.emb.shape[0]
+                       if self.state.row_map is not None else 0))
+
+    def _maybe_record_lifecycle_hbm(self, dev_args, prune_cap: int,
+                                    archive_k: int, tv: int) -> None:
+        """Opt-in peak-HBM gauge for one sweep geometry (maintenance twin
+        of ``_maybe_record_ingest_hbm``): AOT-lower the non-donating twin
+        once per (tenants, k, prune_cap, rows, mesh) key and record
+        ``kernel.peak_hbm_bytes{path="lifecycle",...}`` so
+        ``scripts/check_hbm_budget.py`` sweeps maintenance geometries
+        too. One extra compile, zero extra dispatches."""
+        if not self.telemetry_hbm or not self.telemetry.enabled:
+            return
+        key = ("lifecycle", tv, archive_k, prune_cap,
+               self.state.salience.shape[0])
+        if key in self._hbm_recorded:
+            return
+        self._hbm_recorded.add(key)
+        try:
+            with self._state_lock:
+                arena, edges = self._state, self._edge_state
+                if self.mesh is not None:
+                    kern = self._lifecycle_sharded_kernels(prune_cap,
+                                                           archive_k)
+                    lowered = kern.sweep_copy.lower(arena, edges, *dev_args)
+                else:
+                    lowered = S.lifecycle_sweep_copy.lower(
+                        arena, edges, *dev_args, prune_cap=prune_cap,
+                        archive_k=archive_k)
+            peak = peak_bytes(lowered.compile().memory_analysis())
+        except Exception:  # noqa: BLE001 — observability must never block
+            return
+        if peak is not None:
+            self.telemetry.gauge(
+                "kernel.peak_hbm_bytes", peak,
+                labels={"path": "lifecycle", "tenants": str(tv),
+                        "k": str(archive_k),
+                        "edge_cap": str(self.edge_state.capacity),
+                        "rows": str(self.state.salience.shape[0]),
+                        "mesh": (f"{self._n_parts}x{self.shard_axis}"
+                                 if self.mesh is not None else "1")})
+            self.planner.observe_gauge(
+                self._lifecycle_geometry(tv, archive_k), peak)
+
+    def _reclaim_pruned_slots(self, pruned_slots: np.ndarray
+                              ) -> List[Tuple[str, str]]:
+        """Decode a compacted pruned-slot vector (ascending, -1 padded)
+        through the ``by_slot`` reverse index — O(pruned) host cleanup
+        (ISSUE 19 satellite; the old path scanned the whole edge map)."""
+        removed = []
+        by_slot = self.edge_slots.by_slot
+        for slot in pruned_slots.tolist():
+            if slot < 0:
+                break                      # compacted prefix ends here
+            key = by_slot.get(int(slot))
+            if key is None:
+                continue                   # device-only edge, no mirror
+            removed.append(key)
+            self._free_edge_slots.append(self.edge_slots.pop(key))
+        if removed:
+            self._csr_dirty = True
+        return removed
+
+    def lifecycle_sweep(self, passes: Dict[str, int], *, rate: float,
+                        salience_floor: float, prune_threshold: float,
+                        weights: Tuple[float, float, float] = (0.5, 0.3, 0.2),
+                        archive_k: int = 8,
+                        now: Optional[float] = None) -> Dict[str, object]:
+        """Decay + prune + archive for ALL tenants in ONE donated dispatch
+        + ONE packed readback (ISSUE 19).
+
+        ``passes`` maps tenant name → owed decay passes (0/missing =
+        skip); the steady-state tick passes 1 per tenant and stays
+        bit-identical to the classic per-tenant loop, while catch-up
+        ticks replay the closed form. Returns::
+
+            {"verdicts": {tenant: [(node_id, importance, row), ...]},
+             "removed_edges": [(qsrc, qtgt), ...],
+             "decayed_rows": n, "decayed_edges": n, "pruned_edges": n,
+             "prune_total": n, "prune_overflow": 0/1, "dispatches": 1}
+
+        Verdicts are each tenant's bottom-``archive_k`` live non-super
+        rows by importance — the archive-means-demote feed for the
+        TierPump queue. Removed edges are already reclaimed from the host
+        mirror (O(pruned))."""
+        swept = {t: int(p) for t, p in passes.items()
+                 if int(p) > 0 and t in self._tenants}
+        if not swept:
+            return {"verdicts": {}, "removed_edges": [], "decayed_rows": 0,
+                    "decayed_edges": 0, "pruned_edges": 0, "prune_total": 0,
+                    "prune_overflow": 0, "dispatches": 0}
+        now_rel = (now if now is not None else time.time()) - self.epoch
+        # dense per-tenant-id owed-pass table, pow2-bucketed like pad_rows
+        n_tids = max(self._tenants.values()) + 1
+        tc = max(8, next_pow2(n_tids))
+        passes_arr = np.zeros((tc,), np.int32)
+        v_list = sorted(self._tenants[t] for t in swept)
+        for t, p in swept.items():
+            passes_arr[self._tenants[t]] = p
+        v_tids = S.pad_rows(np.asarray(v_list, np.int32), -1)
+        k_bucket = min(self.state.capacity,
+                       max(8, next_pow2(max(1, archive_k))))
+        prune_cap = self._prune_cap()
+        dev_args = (jnp.asarray(passes_arr), jnp.asarray(v_tids),
+                    jnp.float32(rate), jnp.float32(salience_floor),
+                    jnp.float32(prune_threshold), jnp.float32(now_rel),
+                    jnp.float32(weights[0]), jnp.float32(weights[1]),
+                    jnp.float32(weights[2]))
+        # admission: the planner prices the sweep's [Tv, rows] verdict
+        # transient before the dispatch commits to it (lifecycle kind)
+        if self.planner is not None and self.planner.active:
+            self.planner.check_feasible(
+                self._lifecycle_geometry(len(v_tids), k_bucket),
+                chunkable=False)
+        self._maybe_record_lifecycle_hbm(dev_args, prune_cap, k_bucket,
+                                         len(v_tids))
+        payload = self._apply_lifecycle(
+            *dev_args, prune_cap=prune_cap, archive_k=k_bucket)
+        host = np.asarray(payload)             # the ONE packed readback
+        tv, off = len(v_tids), len(v_tids) * k_bucket
+        v_imps = host[:off].reshape(tv, k_bucket)
+        v_rows = host[off:2 * off].view(np.int32).reshape(tv, k_bucket)
+        pruned_slots = host[2 * off:2 * off + prune_cap].view(np.int32)
+        tail = host[2 * off + prune_cap:].view(np.int32)
+        removed = self._reclaim_pruned_slots(pruned_slots)
+        by_tid = {tid: name for name, tid in self._tenants.items()}
+        verdicts: Dict[str, List[Tuple[str, float, int]]] = {}
+        for vi, tid in enumerate(v_list):
+            out = []
+            for imp, r in zip(v_imps[vi], v_rows[vi]):
+                if not np.isfinite(imp):
+                    continue
+                node_id = self.row_to_id.get(int(r))
+                if node_id is not None:
+                    out.append((node_id, float(imp), int(r)))
+            verdicts[by_tid[tid]] = out[:archive_k]
+        self.telemetry.bump("lifecycle.decayed_rows", int(tail[0]))
+        self.telemetry.bump("lifecycle.decayed_edges", int(tail[1]))
+        self.telemetry.bump("lifecycle.pruned_edges", int(tail[2]))
+        if tail[4]:
+            self.telemetry.bump("lifecycle.prune_overflow")
+        return {"verdicts": verdicts, "removed_edges": removed,
+                "decayed_rows": int(tail[0]), "decayed_edges": int(tail[1]),
+                "pruned_edges": int(tail[2]), "prune_total": int(tail[3]),
+                "prune_overflow": int(tail[4]), "dispatches": 1}
 
     def link_candidates_multi(self, new_ids: Sequence[str], tenant: str,
                               k: int = 3, shard_modes: Sequence[int] = (1, 0)
@@ -3838,26 +4136,23 @@ class MemoryIndex:
                 jnp.asarray(padded), jnp.float32(reinforce), jnp.float32(now))
 
     def prune_edges(self, tenant: str, threshold: float) -> List[Tuple[str, str]]:
+        """Drop the tenant's weak edges; host cleanup is O(pruned) via the
+        kernel's compacted pruned-slot list (ISSUE 19 satellite — this
+        used to re-scan the whole ``edge_slots`` map per prune)."""
         tid = self._tenants.get(tenant)
         if tid is None:
             return []
+        prune_cap = self._prune_cap()
         with self._state_lock:
             cur = self._edge_state
             sole = sys.getrefcount(cur) <= self._SOLE_REFS
-            new_state, pruned = self._guarded(
-                lambda fn: fn(cur, jnp.int32(tid), jnp.float32(threshold)),
+            new_state, slots = self._guarded(
+                lambda fn: fn(cur, jnp.int32(tid), jnp.float32(threshold),
+                              prune_cap=prune_cap),
                 S.edges_prune, S.edges_prune_copy, sole, (cur,), "edges")
             del cur
             self.edge_state = new_state
-        pruned = np.asarray(pruned)
-        removed = []
-        for key, slot in list(self.edge_slots.items()):
-            if pruned[slot]:
-                removed.append(key)
-                self._free_edge_slots.append(self.edge_slots.pop(key))
-        if removed:
-            self._csr_dirty = True
-        return removed
+        return self._reclaim_pruned_slots(np.asarray(slots))
 
     def edge_weights(self) -> Dict[Tuple[str, str], Tuple[float, int]]:
         """Bulk pull of (weight, co_occurrence) for host Edge sync."""

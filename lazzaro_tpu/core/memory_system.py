@@ -63,6 +63,36 @@ def _ensure_log_handler() -> None:
         pkg.setLevel(logging.INFO)
 
 
+class _LifecyclePump:
+    """Background maintenance thread (ISSUE 19): calls
+    ``system.lifecycle_tick()`` every ``interval_s``. The tick itself is
+    scheduler-aware (it defers while serving load is queued), so the pump
+    stays a dumb metronome — mirror of ``tier.TierPump``."""
+
+    def __init__(self, system: "MemorySystem", interval_s: float):
+        self._system = system
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="lifecycle-pump", daemon=True)
+
+    def start(self) -> "_LifecyclePump":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._system.lifecycle_tick()
+            except Exception:                            # pragma: no cover
+                logging.getLogger("lazzaro_tpu").exception(
+                    "lifecycle tick failed")
+
+
 class MemorySystem:
     # Above this many arena rows, per-conversation host syncs become
     # selective (dirty rows only) and the full sweep is reserved for
@@ -202,6 +232,14 @@ class MemorySystem:
                     tmgr, cfg.tier_pump_interval_s).start()
 
         self.query_cache = QueryCache(cfg.cache_size) if self.enable_caching else None
+
+        # Device-side lifecycle (ISSUE 19): periodic all-tenant maintenance
+        # tick (decay + prune + archive verdicts in ONE fused dispatch).
+        # 0 interval = manual ticks only (tests/bench call lifecycle_tick).
+        self.lifecycle_pump = None
+        if cfg.lifecycle_interval_s > 0 and self.enable_async:
+            self.lifecycle_pump = _LifecyclePump(
+                self, cfg.lifecycle_interval_s).start()
 
         self.short_term_memory: List[Dict] = []
         self.conversation_history: List[Dict] = []
@@ -687,7 +725,116 @@ class MemorySystem:
                 del self.shards[self._edge_shard.pop(key)].edges[key]
                 count += 1
         if self.query_cache:
-            self.query_cache.invalidate_results()
+            # scoped flush: only this tenant's graph changed (ISSUE 19
+            # satellite — the old all-tenant flush threw away every other
+            # tenant's warm results on each prune)
+            self.query_cache.invalidate_results(self.user_id)
+        return count
+
+    # ---------------------------------------------------- lifecycle (ISSUE 19)
+    def lifecycle_tick(self, now: Optional[float] = None,
+                       force: bool = False) -> Dict[str, object]:
+        """ONE all-tenant maintenance sweep: salience decay, edge decay +
+        weak-edge prune, and importance-ranked archive verdicts (bottom-k
+        per tenant, fed to the TierPump demote queue — "archived" means
+        demoted-to-cold, never deleted), all in one donated dispatch + one
+        packed readback (``MemoryIndex.lifecycle_sweep``).
+
+        Scheduler-aware: while the serving scheduler reports queued work
+        the tick defers (``lifecycle.deferred_busy``) instead of queueing
+        maintenance behind live traffic — correctness never depends on
+        this (the donation gate already serializes state handoff), only
+        tail latency does. ``config.lifecycle_fused = False`` runs the
+        classic host loop instead — the A/B + bit-parity oracle."""
+        sched = self.query_scheduler
+        if (not force and sched is not None and not sched.closed
+                and sched.load() > self.config.lifecycle_busy_load):
+            self.telemetry.bump("lifecycle.deferred_busy")
+            return {"deferred": True}
+        cfg = self.config
+        t0 = time.perf_counter()
+        with self._mutex:
+            passes = {t: 1 for t in self.index._tenants}
+            if cfg.lifecycle_fused:
+                out = self.index.lifecycle_sweep(
+                    passes, rate=cfg.decay_rate,
+                    salience_floor=cfg.salience_floor,
+                    prune_threshold=cfg.prune_threshold,
+                    weights=(cfg.importance_w_salience,
+                             cfg.importance_w_access,
+                             cfg.importance_w_recency),
+                    archive_k=cfg.lifecycle_archive_k, now=now)
+            else:
+                out = self._lifecycle_classic(passes, now=now)
+            self._decay_pass += 1
+            out["pruned_hosts"] = self._lifecycle_cleanup(out)
+            if len(self.index) <= self._SYNC_FULL_MAX:
+                self._sync_from_arena()
+        tiering = self.index.tiering
+        out["archived"] = 0
+        if tiering is not None and cfg.lifecycle_archive_k:
+            rows = [row for pairs in out["verdicts"].values()
+                    for (_nid, _imp, row) in pairs]
+            out["archived"] = tiering.queue_demotions(rows)
+        out["deferred"] = False
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        self.telemetry.record("lifecycle.sweep_ms", wall_ms)
+        self.telemetry.bump("lifecycle.ticks")
+        self.telemetry.bump(
+            "lifecycle.archive_verdicts",
+            sum(len(v) for v in out["verdicts"].values()))
+        return out
+
+    def _lifecycle_classic(self, passes: Dict[str, int],
+                           now: Optional[float] = None) -> Dict[str, object]:
+        """The host-driven per-tenant loop the fused sweep replaces — kept
+        as the A/B + bit-parity oracle: same decay/prune/verdict
+        arithmetic, but 3 device round trips per tenant per pass and a
+        host stall between each."""
+        cfg = self.config
+        removed: List[Tuple[str, str]] = []
+        verdicts: Dict[str, List[Tuple[str, float, int]]] = {}
+        dispatches = 0
+        for tenant, owed in passes.items():
+            for _ in range(max(0, int(owed))):
+                self.index.decay(tenant, cfg.decay_rate, cfg.salience_floor)
+                removed.extend(self.index.prune_edges(tenant,
+                                                      cfg.prune_threshold))
+                dispatches += 2
+            if cfg.lifecycle_archive_k:
+                cand = self.index.evict_candidates(
+                    tenant, cfg.lifecycle_archive_k, now=now,
+                    weights=(cfg.importance_w_salience,
+                             cfg.importance_w_access,
+                             cfg.importance_w_recency))
+                verdicts[tenant] = [
+                    (nid, imp, self.index.id_to_row.get(nid, -1))
+                    for nid, imp in cand]
+                dispatches += 1
+        return {"verdicts": verdicts, "removed_edges": removed,
+                "pruned_edges": len(removed), "dispatches": dispatches}
+
+    def _lifecycle_cleanup(self, out: Dict[str, object]) -> int:
+        """Host structural cleanup after a sweep: mirror deletion for the
+        CURRENT user's pruned edges (foreign tenants have no host mirror
+        loaded — their device/edge-slot state is already consistent) and
+        per-tenant query-cache flushes scoped to whoever actually pruned."""
+        touched: Set[str] = set()
+        count = 0
+        for qsrc, qtgt in out.get("removed_edges", ()):
+            tenant = qsrc.partition(":")[0]
+            touched.add(tenant)
+            key = (qsrc.partition(":")[2], qtgt.partition(":")[2])
+            if key not in self._edge_shard:
+                continue
+            edge = self._find_edge(key)
+            if edge is not None:
+                self._mark_edge_deleted(edge)
+                del self.shards[self._edge_shard.pop(key)].edges[key]
+                count += 1
+        if self.query_cache:
+            for tenant in touched:
+                self.query_cache.invalidate_results(tenant)
         return count
 
     # ------------------------------------------------------------------ chat
@@ -837,7 +984,8 @@ class MemorySystem:
                     if len(retrieved) >= self.config.retrieval_cap:
                         result = retrieved[:self.config.retrieval_cap]
                         if self.query_cache:
-                            self.query_cache.set_results(query_text, result)
+                            self.query_cache.set_results(
+                                query_text, result, tenant=self.user_id)
                         return result
 
         # 2. Arena ANN (replaces LanceDB search_nodes)
@@ -864,7 +1012,8 @@ class MemorySystem:
 
         final = final[:self.config.retrieval_cap]
         if self.query_cache:
-            self.query_cache.set_results(query_text, final)
+            self.query_cache.set_results(query_text, final,
+                                         tenant=self.user_id)
         return final
 
     def _boost_neighbors(self, retrieved_ids: List[str],
@@ -1036,7 +1185,8 @@ class MemorySystem:
                 if len(retrieved) >= self.config.retrieval_cap:
                     result = retrieved[:self.config.retrieval_cap]
                     if self.query_cache:
-                        self.query_cache.set_results(query_text, result)
+                        self.query_cache.set_results(
+                            query_text, result, tenant=self.user_id)
                     return result
         vector_ids = [v.partition(":")[2] for v in res.ids]
         seen_ids: Set[str] = set(retrieved)
@@ -1057,7 +1207,8 @@ class MemorySystem:
                 seen_ids.add(rid)
         final = final[:self.config.retrieval_cap]
         if self.query_cache:
-            self.query_cache.set_results(query_text, final)
+            self.query_cache.set_results(query_text, final,
+                                         tenant=self.user_id)
         return final
 
     def _queue_boost(self, node_id: str, acc: int = 0, nbr: int = 0,
@@ -1629,7 +1780,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                         self._create_super_nodes_for_shard(shard_key)
 
         if self.query_cache:
-            self.query_cache.invalidate_results()
+            self.query_cache.invalidate_results(self.user_id)
 
         # IVF coarse-index upkeep belongs to background maintenance (this
         # runs on the single consolidation worker), never a serving query —
@@ -1837,7 +1988,7 @@ Return JSON: {"memories": [{"content": "...", "type": "semantic|episodic|procedu
                 self.index.delete([self._q(n) for n in removed_ids])
                 self.store.delete_nodes(removed_ids, user_id=self.user_id)
                 if self.query_cache:
-                    self.query_cache.invalidate_results()
+                    self.query_cache.invalidate_results(self.user_id)
                 self._log(f"⚠ Buffer limit reached! Archived {len(removed_ids)} old nodes "
                           f"(limit: {self.max_buffer_size})")
 
@@ -2014,7 +2165,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             if absorbed:
                 self.store.delete_nodes(sorted(absorbed), user_id=self.user_id)
             if merged_count and self.query_cache:
-                self.query_cache.invalidate_results()
+                self.query_cache.invalidate_results(self.user_id)
             return merged_count
 
     # ------------------------------------------------------------ multi-tenant
@@ -2319,6 +2470,45 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
             except ValueError:
                 pass
 
+    @staticmethod
+    def _replay_node_decay(stored: np.ndarray, missed: np.ndarray,
+                           rate: float, floor: float) -> np.ndarray:
+        """Replay the decay sweeps a stored row missed since its stamp,
+        bit-for-bit against the arena kernel: each pass is the f32 sub the
+        device does, then the multiply-add in f64 — exact, so the single
+        rounding back to f32 reproduces the kernel's fused multiply-add.
+        A closed-form ``(1-rate)**missed`` in f64 lands within an ulp but
+        NOT on the same bits, and restart parity is a CI gate."""
+        sal = np.asarray(stored, np.float32).copy()
+        left = np.asarray(missed, np.int64).copy()
+        fl32 = np.float32(floor)
+        fl64, dec64 = np.float64(fl32), np.float64(np.float32(1.0)
+                                                   - np.float32(rate))
+        while True:
+            m = left > 0
+            if not m.any():
+                break
+            base = (sal[m] - fl32).astype(np.float64)
+            sal[m] = (fl64 + base * dec64).astype(np.float32)
+            left[m] -= 1
+        return sal
+
+    @staticmethod
+    def _replay_edge_decay(stored: np.ndarray, missed: np.ndarray,
+                           rate: float) -> np.ndarray:
+        """Edge-weight twin of :meth:`_replay_node_decay`: ``w *= (1-rate)``
+        per missed pass, one f32 rounding per step like the kernel."""
+        w = np.asarray(stored, np.float32).copy()
+        left = np.asarray(missed, np.int64).copy()
+        dec32 = np.float32(1.0) - np.float32(rate)
+        while True:
+            m = left > 0
+            if not m.any():
+                break
+            w[m] = w[m] * dec32
+            left[m] -= 1
+        return w
+
     def _load_columnar(self) -> None:
         """Bulk columnar restore: embeddings go host→arena as ONE matrix,
         host nodes materialize WITHOUT per-node vectors, and clean rows'
@@ -2331,7 +2521,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         rate = self.config.decay_rate
         floor = self.config.salience_floor
         missed = np.maximum(self._decay_pass - cols["decay_pass"], 0)
-        sal = floor + (cols["salience"] - floor) * (1.0 - rate) ** missed
+        sal = self._replay_node_decay(cols["salience"], missed, rate, floor)
         ids = cols["id"]
         contents = cols["content"]
         types = cols["type"]
@@ -2398,7 +2588,7 @@ Example: {"preferences": "User prefers Python for data science.", "knowledge_dom
         if ecols is None:
             return
         missed_e = np.maximum(self._decay_pass - ecols["decay_pass"], 0)
-        weights = ecols["weight"] * (1.0 - rate) ** missed_e
+        weights = self._replay_edge_decay(ecols["weight"], missed_e, rate)
         node_shard = {}
         for i in range(len(ids)):
             if not is_super[i]:
@@ -2997,6 +3187,9 @@ STORAGE:
         pump = getattr(self, "tier_pump", None)
         if pump is not None:
             pump.stop()
+        lpump = getattr(self, "lifecycle_pump", None)
+        if lpump is not None:
+            lpump.stop()
         sched = getattr(self, "query_scheduler", None)
         if sched is not None:
             sched.close()

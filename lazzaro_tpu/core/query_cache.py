@@ -23,6 +23,9 @@ class QueryCache:
         self.max_size = max_size
         self._embeddings: OrderedDict[str, List[float]] = OrderedDict()
         self._results: OrderedDict[str, List[str]] = OrderedDict()
+        # result key → owning tenant, so mutations in one tenant's graph
+        # (prune, eviction) don't flush every other tenant's entries
+        self._result_tenant: dict = {}
         self.hits = 0
         self.misses = 0
         self._lock = threading.Lock()
@@ -57,19 +60,35 @@ class QueryCache:
             self.misses += 1
             return None
 
-    def set_results(self, query: str, results: List[str]) -> None:
+    def set_results(self, query: str, results: List[str],
+                    tenant: Optional[str] = None) -> None:
         k = _key(query)
         with self._lock:
             self._results[k] = results
             self._results.move_to_end(k)
+            if tenant is not None:
+                self._result_tenant[k] = tenant
+            else:
+                self._result_tenant.pop(k, None)
             while len(self._results) > self.max_size:
-                self._results.popitem(last=False)
+                old, _ = self._results.popitem(last=False)
+                self._result_tenant.pop(old, None)
 
-    def invalidate_results(self) -> None:
+    def invalidate_results(self, tenant: Optional[str] = None) -> None:
         """Drop cached retrievals (called after graph mutations so stale id
-        lists don't outlive the nodes they point to)."""
+        lists don't outlive the nodes they point to). With ``tenant`` the
+        flush is scoped to that tenant's entries (ISSUE 19 satellite) —
+        untagged entries are dropped either way, since their owner is
+        unknown."""
         with self._lock:
-            self._results.clear()
+            if tenant is None:
+                self._results.clear()
+                self._result_tenant.clear()
+                return
+            for k in list(self._results):
+                if self._result_tenant.get(k, tenant) == tenant:
+                    del self._results[k]
+                    self._result_tenant.pop(k, None)
 
     def get_hit_rate(self) -> float:
         total = self.hits + self.misses

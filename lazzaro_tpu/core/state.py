@@ -859,14 +859,52 @@ def _edges_decay(state: EdgeState, tenant: jax.Array, rate: jax.Array) -> EdgeSt
 edges_decay, edges_decay_copy = _donated_pair(_edges_decay)
 
 
-def _edges_prune(state: EdgeState, tenant: jax.Array,
-                 threshold: jax.Array) -> Tuple[EdgeState, jax.Array]:
-    """Kill the tenant's edges with weight < threshold; returns (state, pruned_mask)."""
-    pruned = state.alive & (state.tenant_id == tenant) & (state.weight < threshold)
-    return state.replace(alive=state.alive & ~pruned), pruned
+def _prune_compact(weak: jax.Array, prune_cap: int) -> Tuple[jax.Array, jax.Array]:
+    """Prefix-sum compaction of a weak-edge mask into a dense [prune_cap]
+    vector of slot indices (-1 padded, ascending slot order) — the PR 3
+    pool-compactor idiom pointed at prune victims, so host cleanup walks
+    O(pruned) slots instead of re-scanning every live edge. Returns
+    ``(ok, slots)`` where ``ok`` is the mask of edges actually compacted
+    (== ``weak`` whenever ``prune_cap`` covers the weak count; the host
+    sizes it off the live-edge count so the cap can never bind — edges
+    past it stay alive and are caught by the overflow counter rather
+    than silently leaking from the host mirror)."""
+    weak = jax.lax.optimization_barrier(weak)
+    pos = jnp.cumsum(weak.astype(jnp.int32)) - 1
+    ok = weak & (pos < prune_cap)
+    slot_ids = jnp.arange(weak.shape[0], dtype=jnp.int32)
+    buf = jnp.full((prune_cap + 1,), -1, jnp.int32)
+    buf = buf.at[jnp.where(ok, jnp.minimum(pos, prune_cap - 1),
+                           prune_cap)].set(slot_ids)
+    return ok, buf[:prune_cap]
 
 
-edges_prune, edges_prune_copy = _donated_pair(_edges_prune)
+def _edges_prune(state: EdgeState, tenant: jax.Array, threshold: jax.Array,
+                 prune_cap: int) -> Tuple[EdgeState, jax.Array]:
+    """Kill the tenant's edges with weight < threshold; returns
+    ``(state, pruned_slots)`` where ``pruned_slots`` is the compacted
+    [prune_cap] slot-index vector (-1 padded) from :func:`_prune_compact`."""
+    weak = state.alive & (state.tenant_id == tenant) & (state.weight < threshold)
+    ok, slots = _prune_compact(weak, prune_cap)
+    return state.replace(alive=state.alive & ~ok), slots
+
+
+edges_prune, edges_prune_copy = _donated_pair(
+    _edges_prune, static_argnames=("prune_cap",))
+
+
+def _decay_fused(arena: ArenaState, edges: EdgeState, tenant: jax.Array,
+                 rate: jax.Array, floor: jax.Array
+                 ) -> Tuple[ArenaState, EdgeState]:
+    """Classic per-tenant decay, arena + edges folded into ONE dispatch
+    (ISSUE 19 satellite): the old ``MemoryIndex.decay`` paid two device
+    round trips per tenant per tick — same arithmetic, half the dispatches.
+    Bitwise identical to ``_arena_decay`` ∘ ``_edges_decay``."""
+    return (_arena_decay(arena, tenant, rate, floor),
+            _edges_decay(edges, tenant, rate))
+
+
+decay_fused, decay_fused_copy = _donated_pair(_decay_fused, donate=(0, 1))
 
 
 def _edges_delete_for_nodes(state: EdgeState, node_rows: jax.Array) -> EdgeState:
@@ -880,6 +918,147 @@ def _edges_delete_for_nodes(state: EdgeState, node_rows: jax.Array) -> EdgeState
 
 edges_delete_for_nodes, edges_delete_for_nodes_copy = _donated_pair(
     _edges_delete_for_nodes)
+
+
+# ---------------------------------------------------------------------------
+# Device-side lifecycle: decay + prune + archive as ONE all-tenant sweep
+# ---------------------------------------------------------------------------
+
+# Counter leaves riding the packed-payload tail (ISSUE 19): decayed arena
+# rows, decayed edges, pruned edges, weak-edge total, prune overflow flag.
+LIFECYCLE_TAIL = 5
+
+
+def _bitcast_f32(x: jax.Array) -> jax.Array:
+    """int32 → f32 bit-pattern view so int sections can ride the single
+    flat f32 payload; the host views them back with ``.view(np.int32)``."""
+    return jax.lax.bitcast_convert_type(x.astype(jnp.int32), jnp.float32)
+
+
+def _lifecycle_core(arena: ArenaState, edges: EdgeState, passes: jax.Array,
+                    verdict_tids: jax.Array, rate: jax.Array,
+                    floor: jax.Array, threshold: jax.Array, now: jax.Array,
+                    w_sal: jax.Array, w_acc: jax.Array, w_rec: jax.Array,
+                    prune_cap: int, archive_k: int):
+    """Shard-local body of the all-tenant maintenance sweep. Both the
+    single-chip jit and the ``make_lifecycle_sharded`` shard_map trace this
+    one function, so single-chip/mesh parity is structural.
+
+    ``passes`` is a dense [Tc] per-tenant-id owed-decay-pass table (0 =
+    tenant not swept this tick) — the per-row pass count is one gather by
+    ``tenant_id``, honoring the ``decay_pass`` stamping discipline from
+    ``MemorySystem`` without an O(cap × tenants) mask product.
+
+    Bit-parity with the classic host loop: the steady-state single owed
+    pass multiplies by ``(1 - rate)`` ONCE — the exact expression
+    ``_arena_decay`` / ``_edges_decay`` evaluate — and only catch-up ticks
+    (p > 1, e.g. after a deferred sweep) take the closed form
+    ``(1 - rate) ** p`` that the checkpoint-load replay already uses.
+    Per-tenant stages are disjoint by tenant mask, so fusing all tenants
+    into one scatter is order-equivalent to the classic per-tenant loop."""
+    tc = passes.shape[0]
+
+    def owed(tid):
+        inb = (tid >= 0) & (tid < tc)
+        return jnp.where(inb, passes[jnp.clip(tid, 0, tc - 1)], 0)
+
+    # (a) closed-form salience decay over every swept tenant's live rows
+    p = owed(arena.tenant_id)
+    d_mask = arena.alive & (p > 0)
+    base = arena.salience - floor
+    stepped = floor + base * (1.0 - rate)
+    closed = floor + base * jnp.power(1.0 - rate, p.astype(jnp.float32))
+    arena = arena.replace(salience=jnp.where(
+        d_mask, jnp.where(p == 1, stepped, closed), arena.salience))
+
+    # (b) edge-weight decay, then weak-edge prune on the DECAYED weights
+    # (classic order: decay tick precedes the prune pass)
+    ep = owed(edges.tenant_id)
+    e_mask = edges.alive & (ep > 0)
+    w = edges.weight
+    w_new = jnp.where(
+        e_mask,
+        jnp.where(ep == 1, w * (1.0 - rate),
+                  w * jnp.power(1.0 - rate, ep.astype(jnp.float32))),
+        w)
+    weak = e_mask & (w_new < threshold)
+    ok, pruned_slots = _prune_compact(weak, prune_cap)
+    edges = edges.replace(weight=w_new, alive=edges.alive & ~ok)
+
+    # (c) importance verdicts on the decayed salience (classic order:
+    # ``evict_candidates`` after the decay tick) — bottom-k per verdict
+    # tenant, the archive-means-demote feed for the TierPump
+    imp = jax.lax.optimization_barrier(
+        arena_importance(arena, now, w_sal, w_acc, w_rec))
+
+    def bottom_k(t):
+        mask = (arena.alive & (arena.tenant_id == t) & ~arena.is_super
+                & (t >= 0))
+        neg_scores, rows = jax.lax.top_k(
+            -jnp.where(mask, imp, jnp.inf), archive_k)
+        return -neg_scores, rows
+
+    v_imps, v_rows = jax.vmap(bottom_k)(verdict_tids)
+    counters = jnp.stack([
+        d_mask.sum().astype(jnp.int32),
+        e_mask.sum().astype(jnp.int32),
+        ok.sum().astype(jnp.int32),
+        weak.sum().astype(jnp.int32),
+        (weak & ~ok).any().astype(jnp.int32),
+    ])
+    return arena, edges, v_imps, v_rows, pruned_slots, counters
+
+
+def _lifecycle_payload(v_imps, v_rows, pruned_slots, counters) -> jax.Array:
+    """ONE flat f32 payload so the whole sweep comes home in ONE transfer:
+    [Tv·k] verdict importances | [Tv·k] verdict rows (bitcast) |
+    [prune_cap] pruned slots (bitcast) | [LIFECYCLE_TAIL] counters
+    (bitcast). Static offsets — the host slices by shape, no header."""
+    return jnp.concatenate([
+        v_imps.astype(jnp.float32).reshape(-1),
+        _bitcast_f32(v_rows).reshape(-1),
+        _bitcast_f32(pruned_slots),
+        _bitcast_f32(counters),
+    ])
+
+
+def _lifecycle_sweep(arena: ArenaState, edges: EdgeState, passes: jax.Array,
+                     verdict_tids: jax.Array, rate: jax.Array,
+                     floor: jax.Array, threshold: jax.Array, now: jax.Array,
+                     w_sal: jax.Array, w_acc: jax.Array, w_rec: jax.Array,
+                     prune_cap: int, archive_k: int
+                     ) -> Tuple[ArenaState, EdgeState, jax.Array]:
+    """ONE donated dispatch + ONE packed readback: salience decay, edge
+    decay + weak-edge prune (compacted victim slots ride the readback like
+    the paged free-list leaves), and per-tenant bottom-k archive verdicts
+    — over the live arena and edge pool for ALL tenants at once."""
+    arena, edges, v_imps, v_rows, pruned_slots, counters = _lifecycle_core(
+        arena, edges, passes, verdict_tids, rate, floor, threshold, now,
+        w_sal, w_acc, w_rec, prune_cap, archive_k)
+    return arena, edges, _lifecycle_payload(v_imps, v_rows, pruned_slots,
+                                            counters)
+
+
+lifecycle_sweep, lifecycle_sweep_copy = _donated_pair(
+    _lifecycle_sweep, donate=(0, 1),
+    static_argnames=("prune_cap", "archive_k"))
+
+
+def _lifecycle_sweep_read(arena: ArenaState, edges: EdgeState,
+                          passes: jax.Array, verdict_tids: jax.Array,
+                          rate: jax.Array, floor: jax.Array,
+                          threshold: jax.Array, now: jax.Array,
+                          w_sal: jax.Array, w_acc: jax.Array,
+                          w_rec: jax.Array, prune_cap: int, archive_k: int
+                          ) -> jax.Array:
+    """Read-only twin: payload only, states untouched (dry-run / gauges)."""
+    return _lifecycle_sweep(arena, edges, passes, verdict_tids, rate, floor,
+                            threshold, now, w_sal, w_acc, w_rec,
+                            prune_cap, archive_k)[2]
+
+
+lifecycle_sweep_read = jax.jit(_lifecycle_sweep_read,
+                               static_argnames=("prune_cap", "archive_k"))
 
 
 # ---------------------------------------------------------------------------
@@ -4374,6 +4553,101 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     return FusedShardedKernels(
         serve=jax.jit(mapped_serve, donate_argnums=(0,)),
         serve_copy=jax.jit(mapped_serve),
+        read=jax.jit(mapped_read))
+
+
+class LifecycleShardedKernels(NamedTuple):
+    """The jit entry points one ``make_lifecycle_sharded`` call builds:
+    the donated all-tenant sweep, its copy-on-write twin, and the
+    read-only payload twin. Each call is exactly ONE distributed
+    dispatch — the jit-counter tests wrap the factory to pin that."""
+
+    sweep: Callable
+    sweep_copy: Callable
+    read: Callable
+
+
+def make_lifecycle_sharded(mesh, axis: str, *, prune_cap: int,
+                           archive_k: int) -> LifecycleShardedKernels:
+    """Distributed twin of ``lifecycle_sweep``: the decay scatters and the
+    importance arithmetic are element-wise over the row-sharded columns
+    (shard-local, zero traffic), weak-edge compaction runs shard-local
+    with victim slots globalized before ONE all_gather re-compaction, and
+    the per-tenant bottom-k verdicts merge through ``sharded_topk_merge``
+    (replicated verdict arithmetic — every chip holds the identical
+    payload, so the host reads ONE replicated buffer).
+
+    Call signature mirrors the single-chip jit: ``sweep(arena, edges,
+    passes [Tc], verdict_tids [Tv], rate, floor, threshold, now, w_sal,
+    w_acc, w_rec) -> (arena, edges, payload)`` with ``prune_cap`` /
+    ``archive_k`` baked in at build time (the host caches one program per
+    (prune_cap, archive_k) bucket, same discipline as the ingest
+    factory). The payload's pruned-slot and verdict-row sections carry
+    GLOBAL ids, so the host decode is identical to single-chip."""
+    from jax.sharding import PartitionSpec as P
+
+    from lazzaro_tpu.ops.topk import sharded_topk_merge
+    from lazzaro_tpu.utils.compat import shard_map
+
+    n_shards = mesh.shape[axis]
+
+    def _local(arena, edges, passes, verdict_tids, rate, floor, threshold,
+               now, w_sal, w_acc, w_rec):
+        shard = jax.lax.axis_index(axis)
+        local_n = arena.salience.shape[0]
+        local_e = edges.src.shape[0]
+        # full prune_cap per shard: skew-proof (one shard may hold every
+        # weak edge) and still tiny — [prune_cap] i32 per chip
+        arena, edges, v_imps_l, v_rows_l, slots_l, counters = \
+            _lifecycle_core(arena, edges, passes, verdict_tids, rate,
+                            floor, threshold, now, w_sal, w_acc, w_rec,
+                            prune_cap, archive_k)
+        # pruned slots: local → global ids, ONE all_gather, re-compact.
+        # Shard-major flatten of ascending local slots IS globally
+        # ascending, so the merged list keeps single-chip slot order.
+        g_slots = jnp.where(slots_l >= 0, slots_l + shard * local_e, -1)
+        flat = jax.lax.all_gather(g_slots, axis).reshape(-1)
+        okg = flat >= 0
+        posg = jnp.cumsum(okg.astype(jnp.int32)) - 1
+        buf = jnp.full((prune_cap + 1,), -1, jnp.int32)
+        buf = buf.at[jnp.where(okg & (posg < prune_cap),
+                               jnp.minimum(posg, prune_cap - 1),
+                               prune_cap)].set(flat)
+        over_g = (okg & (posg >= prune_cap)).any().astype(jnp.int32)
+        # verdicts: local bottom-k per tenant → globalize → merged bottom-k
+        # (merge runs on negated importances so descending == bottom)
+        neg_l = -v_imps_l
+        g_rows = _globalize_rows(v_rows_l, neg_l, shard, local_n, n_shards)
+        neg_m, rows_m = sharded_topk_merge(
+            axis, neg_l, g_rows, archive_k,
+            sentinel=n_shards * local_n - 1)
+        cg = jax.lax.psum(counters, axis)
+        cg = jnp.concatenate([
+            cg[:4], jnp.maximum(jnp.minimum(cg[4:5], 1), over_g[None])])
+        payload = _lifecycle_payload(-neg_m, rows_m, buf[:prune_cap], cg)
+        return arena, edges, payload
+
+    def _read_local(*args):
+        return _local(*args)[2]
+
+    state_specs = ArenaState(
+        emb=P(axis, None), salience=P(axis), timestamp=P(axis),
+        last_accessed=P(axis), access_count=P(axis), type_id=P(axis),
+        shard_id=P(axis), tenant_id=P(axis), alive=P(axis),
+        is_super=P(axis))
+    edge_specs = EdgeState(
+        src=P(axis), tgt=P(axis), weight=P(axis), co=P(axis),
+        last_updated=P(axis), alive=P(axis), tenant_id=P(axis))
+    in_specs = (state_specs, edge_specs, P(None), P(None),
+                P(), P(), P(), P(), P(), P(), P())
+    mapped = shard_map(_local, mesh=mesh, in_specs=in_specs,
+                       out_specs=(state_specs, edge_specs, P(None)),
+                       check_vma=False)
+    mapped_read = shard_map(_read_local, mesh=mesh, in_specs=in_specs,
+                            out_specs=P(None), check_vma=False)
+    return LifecycleShardedKernels(
+        sweep=jax.jit(mapped, donate_argnums=(0, 1)),
+        sweep_copy=jax.jit(mapped),
         read=jax.jit(mapped_read))
 
 

@@ -47,8 +47,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from lazzaro_tpu.core import state as S
-from lazzaro_tpu.core.index import (build_host_csr, link_pool_dev,
-                                    link_pool_size, split_csr)
+from lazzaro_tpu.core.index import (_EdgeSlotMap, build_host_csr,
+                                    link_pool_dev, link_pool_size,
+                                    split_csr)
 from lazzaro_tpu.ops.topk import make_sharded_topk
 from lazzaro_tpu.parallel.mesh import shard_stacked
 from lazzaro_tpu.plan import Geometry, HbmPlanner
@@ -226,7 +227,7 @@ class ShardedMemoryIndex:
         self._edge_state = self._reshard(S.init_edges(self.edge_capacity))
         self._free_edge_slots: List[int] = list(
             range(self.edge_capacity - 1, -1, -1))
-        self.edge_slots: Dict[Tuple[str, str], int] = {}
+        self.edge_slots: _EdgeSlotMap = _EdgeSlotMap()
         self._ingest_cache = LRUKernelCache(serve_kernel_cache_max)
         self._ingest_classic_cache = LRUKernelCache(serve_kernel_cache_max)
         self.link_pool_overflows = 0

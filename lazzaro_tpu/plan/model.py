@@ -79,7 +79,7 @@ class Geometry:
     and the scan tiles that stream it scale with the pool; every other
     column stays logical-length."""
 
-    kind: str = "serve"          # "serve" | "ingest"
+    kind: str = "serve"          # "serve" | "ingest" | "lifecycle"
     mode: str = "exact"          # exact | quant | ivf | pq | tiered
     batch: int = 8
     rows: int = 1024
@@ -133,7 +133,8 @@ def _mode_family(mode: str) -> str:
         return "pq"
     if m.startswith("ivf"):
         return "ivf"
-    return m if m in ("exact", "quant", "tiered", "ingest") else "exact"
+    return (m if m in ("exact", "quant", "tiered", "ingest", "lifecycle")
+            else "exact")
 
 
 class CostModel:
@@ -221,6 +222,19 @@ class CostModel:
         scan_rows_pc = (-(-g.pool_rows // max(1, g.mesh_parts))
                         if g.pool_rows else rows_pc)
         fam = _mode_family(g.mode)
+        if g.kind == "lifecycle":
+            # The all-tenant maintenance sweep (ISSUE 19) never streams
+            # the embedding slab — its high-water mark is the [tenants,
+            # rows] masked-importance tile behind the per-tenant bottom-k
+            # (``batch`` carries the verdict-tenant count, ``k`` the
+            # archive depth), the edge decay/prune working set (decayed
+            # weight copy + cumsum positions + victim buffer), and the
+            # packed payload readback.
+            tv = max(1, g.batch)
+            tile = tv * (rows_pc + 1) * 4 * 2
+            tile += 3 * g.edge_cap * 4
+            tile += (2 * tv * g.k + g.edge_cap + 8) * 4
+            return int(tile + DISPATCH_WORKSPACE_BYTES)
         default_chunk = (IVF_SERVE_CHUNK if fam in ("ivf", "pq")
                          else QUERY_CHUNK)
         chunk = min(g.batch, g.scan_chunk or default_chunk)

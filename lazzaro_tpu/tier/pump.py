@@ -94,6 +94,11 @@ class TierManager:
         self._mask_lock = threading.Lock()
         self._hits: Dict[int, int] = {}
         self._promote_queue: set = set()
+        # Archive verdicts from the device-side lifecycle sweep (ISSUE 19):
+        # rows the importance scoring picked as each tenant's coldest —
+        # preferred demotion candidates, consumed before the pump falls
+        # back to its own host-side bulk-readback scoring.
+        self._demote_queue: set = set()
         self._no_demote_until: Dict[int, float] = {}
         # serving counters (tier.cold_hit_rate)
         self.turns = 0
@@ -462,6 +467,21 @@ class TierManager:
         cand = np.argpartition(score, n - 1)[:n]
         return [int(r) for r in cand if np.isfinite(score[r])]
 
+    def queue_demotions(self, rows) -> int:
+        """Feed lifecycle archive verdicts into the demote queue (ISSUE
+        19). Rows wait here until the watermark policy actually needs
+        evictions — "archived" is a standing nomination, the demotion
+        itself still happens on the pump (demote-to-cold, never delete).
+        Already-cold and out-of-range rows are dropped; returns queued."""
+        n = 0
+        with self._lock:
+            for r in rows:
+                r = int(r)
+                if 0 <= r < len(self.cold_np) and not self.cold_np[r]:
+                    self._demote_queue.add(r)
+                    n += 1
+        return n
+
     def run_once(self, now: Optional[float] = None) -> Dict[str, int]:
         """One pump pass: apply queued promotions, then watermark-driven
         demotion. Returns {"promoted": n, "demoted": n}."""
@@ -476,9 +496,21 @@ class TierManager:
             need = hot - target
             if self.max_demote_per_pass:
                 need = min(need, self.max_demote_per_pass)
-            cand = self.select_demotion_candidates(need, now=now)
+            # lifecycle verdicts first (already importance-ranked on
+            # device, zero extra readback), host scoring for the rest
+            with self._lock:
+                queued = [r for r in sorted(self._demote_queue)
+                          if not self.cold_np[r]
+                          and self._no_demote_until.get(r, 0.0) <= now]
+            cand = queued[:need]
+            if len(cand) < need:
+                have = set(cand)
+                cand += [r for r in self.select_demotion_candidates(
+                             need - len(cand), now=now) if r not in have]
             if cand:
                 demoted = self.demote_rows(cand, now=now)
+            with self._lock:
+                self._demote_queue.difference_update(cand)
         self.update_gauges()
         return {"promoted": promoted, "demoted": demoted}
 

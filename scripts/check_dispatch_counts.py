@@ -100,6 +100,22 @@ the paths passed as arguments) and exits nonzero if:
     reason to exist — must stay measured, and below the int8 shadow's
     when both are present as ``bytes_per_row``/``int8_bytes_per_row``),
 
+  - (ISSUE 19) a LIFECYCLE artifact (any dict with ``"lifecycle": true``)
+    does not record a measured ``dispatches_per_sweep`` (gated == 1 by
+    the generic rule — decay + weak-edge prune + archive verdicts for
+    ALL tenants must stay ONE donated all-tenant dispatch, never the
+    classic 3-dispatches-per-tenant host loop), does not record
+    ``"bit_parity": true`` (the fused sweep must stay bit-identical to
+    the classic decay/prune/evict host loop on the churn fixture —
+    approximate maintenance silently corrupts every downstream recall
+    number), lacks a ``serve_p99_ratio``/``serve_p99_bound`` pair or
+    records the ratio above its bound (lifecycle ticks run UNDER live
+    serving — blowing the serving tail is exactly the host-stall
+    failure mode this sweep exists to kill), or lacks a
+    ``host_stall_speedup``/``host_stall_floor`` pair or records the
+    speedup below its floor (the one-dispatch sweep quietly lost its
+    wall-clock edge over the per-tenant loop),
+
   - (ISSUE 18) a REPLICA artifact (any dict with ``"replica": true``)
     does not record a measured ``dispatches_per_turn`` (gated == 1 by
     the generic rule — a routed turn must cost ONE group-local dispatch
@@ -145,11 +161,13 @@ _TELEMETRY_KEYS = ("pad_waste_fraction", "queue_wait_ms_p50",
                    "queue_wait_ms_p95", "peak_hbm_bytes")
 
 
-_DISPATCH_KEYS = ("dispatches_per_turn", "dispatches_per_conversation")
+_DISPATCH_KEYS = ("dispatches_per_turn", "dispatches_per_conversation",
+                  "dispatches_per_sweep")
 
 
 def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
-          tiereds, ingests, online_ivfs, pq_fuseds, pageds, replicas):
+          tiereds, ingests, online_ivfs, pq_fuseds, pageds, replicas,
+          lifecycles):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -176,6 +194,8 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             pageds.append((path, obj))
         if obj.get("replica") is True:
             replicas.append((path, obj))
+        if obj.get("lifecycle") is True:
+            lifecycles.append((path, obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k in _DISPATCH_KEYS:
@@ -185,12 +205,12 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             else:
                 _walk(v, here, hits, recalls, speedups, meshes, tel_blocks,
                       raggeds, tiereds, ingests, online_ivfs, pq_fuseds,
-                      pageds, replicas)
+                      pageds, replicas, lifecycles)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
             _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
                   tel_blocks, raggeds, tiereds, ingests, online_ivfs,
-                  pq_fuseds, pageds, replicas)
+                  pq_fuseds, pageds, replicas, lifecycles)
 
 
 def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
@@ -392,6 +412,50 @@ def _check_replica(loc, obj, bad):
                                  f"recovery diverged)"))
 
 
+def _check_lifecycle(loc, obj, bad):
+    """The ISSUE 19 lifecycle-sweep gate on one ``"lifecycle": true``
+    dict."""
+    if "dispatches_per_sweep" not in obj:
+        bad.append((loc, "lifecycle artifact must record a measured "
+                         "'dispatches_per_sweep' (decay + prune + archive "
+                         "verdicts for ALL tenants in ONE dispatch)"))
+    if obj.get("bit_parity") is not True:
+        bad.append((loc, f"bit_parity == {obj.get('bit_parity')!r} (the "
+                         f"fused sweep must record a measured true — "
+                         f"bit-identical to the classic decay/prune/evict "
+                         f"host loop)"))
+    ratio = obj.get("serve_p99_ratio")
+    bound = obj.get("serve_p99_bound")
+    if ratio is None or bound is None:
+        bad.append((loc, "lifecycle artifact must record both "
+                         "'serve_p99_ratio' and 'serve_p99_bound' "
+                         "(serving tail under concurrent maintenance)"))
+    else:
+        try:
+            ok = float(ratio) <= float(bound)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            bad.append((loc, f"serve_p99_ratio == {ratio!r} > "
+                             f"serve_p99_bound {bound!r} (maintenance "
+                             f"sweeps are blowing the live serving tail)"))
+    speedup = obj.get("host_stall_speedup")
+    floor = obj.get("host_stall_floor")
+    if speedup is None or floor is None:
+        bad.append((loc, "lifecycle artifact must record both "
+                         "'host_stall_speedup' and 'host_stall_floor'"))
+    else:
+        try:
+            ok = float(speedup) >= float(floor)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            bad.append((loc, f"host_stall_speedup == {speedup!r} < "
+                             f"host_stall_floor {floor!r} (the one-"
+                             f"dispatch sweep lost its edge over the "
+                             f"per-tenant host loop)"))
+
+
 def _check_ingest(loc, obj, bad):
     """The ISSUE 9 sharded-ingest gate on one ``"ingest_sharded": true``
     dict."""
@@ -456,6 +520,7 @@ def main(argv):
     checked_pq = 0
     checked_paged = 0
     checked_replica = 0
+    checked_lifecycle = 0
     bad = []
     for p in paths:
         try:
@@ -465,11 +530,11 @@ def main(argv):
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
         (hits, recalls, speedups, meshes, tel_blocks, raggeds, tiereds,
-         ingests, online_ivfs, pq_fuseds, pageds, replicas) = (
-            [], [], [], [], [], [], [], [], [], [], [], [])
+         ingests, online_ivfs, pq_fuseds, pageds, replicas, lifecycles) = (
+            [], [], [], [], [], [], [], [], [], [], [], [], [])
         _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
               tel_blocks, raggeds, tiereds, ingests, online_ivfs,
-              pq_fuseds, pageds, replicas)
+              pq_fuseds, pageds, replicas, lifecycles)
         grandfathered = os.path.basename(p).startswith(
             _PRE_TELEMETRY_PREFIXES)
         for loc, measured_fused, block in tel_blocks:
@@ -497,6 +562,9 @@ def main(argv):
         for loc, obj in replicas:
             checked_replica += 1
             _check_replica(loc, obj, bad)
+        for loc, obj in lifecycles:
+            checked_lifecycle += 1
+            _check_lifecycle(loc, obj, bad)
         for loc, v, planned in hits:
             checked += 1
             if v == 1:
@@ -548,8 +616,9 @@ def main(argv):
           f"{checked_ingest} sharded-ingest gate(s), "
           f"{checked_online_ivf} online-ivf gate(s), "
           f"{checked_pq} fused-pq gate(s), "
-          f"{checked_paged} paged-arena gate(s), and "
-          f"{checked_replica} replica gate(s) across "
+          f"{checked_paged} paged-arena gate(s), "
+          f"{checked_replica} replica gate(s), and "
+          f"{checked_lifecycle} lifecycle gate(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 

@@ -131,6 +131,17 @@ def _geometry_from_gauge(plan_mod, key: str, artifact: dict):
     # ISSUE 18: replica-group placements label their gauges with the
     # fleet-wide replication factor; mesh_parts is already per-GROUP.
     groups = int(lab.get("groups") or 1)
+    # ISSUE 19: path="lifecycle" labels (the fused all-tenant maintenance
+    # sweep) carry the verdict-tenant count, archive depth, and edge-pool
+    # capacity — the [tenants, rows] importance tile + edge working set
+    # the cost model's lifecycle branch bounds.
+    if lab.get("path") == "lifecycle":
+        return plan_mod.Geometry(
+            kind="lifecycle", mode="lifecycle",
+            batch=int(lab.get("tenants") or 1), rows=rows, dim=int(dim),
+            k=int(lab.get("k") or 8), dtype_bytes=dtype_bytes,
+            mesh_parts=_mesh_parts(lab.get("mesh", "1")),
+            edge_cap=int(lab.get("edge_cap") or 0))
     if lab.get("path") == "ingest":
         return plan_mod.Geometry(
             kind="ingest", mode="ingest",
