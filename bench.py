@@ -2935,6 +2935,225 @@ def bench_lifecycle(on_tpu: bool, rows: int = 8_192, tenants: int = 16,
     return out
 
 
+def bench_semantic_cache(on_tpu: bool, rows: int = 65_536, tenants: int = 4,
+                         turns: int = 16, batch: int = 32,
+                         zipf_s: float = 1.1, pool: int = 16,
+                         speedup_floor: float = 1.5,
+                         hit_rate_floor: float = 0.5,
+                         recall_floor: float = 0.999):
+    """Semantic query cache acceptance bench (ISSUE 20): a Zipf-shaped
+    multi-tenant chat workload (repeated intent plus near-dup paraphrase
+    mass) served through the fused path with the device-resident similarity
+    ring ON vs OFF. The artifact pins the five claims:
+
+      - one dispatch: hits ride the SAME fused dispatch — the counted jit
+        entries per served turn stay exactly 1.0 with the cache on,
+      - throughput: QPS over the Zipf workload ≥ ``speedup_floor``× the
+        cache-off twin (hit queries early-out their scan blocks, so the
+        win scales with hit rate × scan fraction),
+      - hit rate: measured semantic hit rate over the steady-state phase
+        ≥ ``hit_rate_floor`` (Zipf s≈1.1 over ``pool`` intents/tenant),
+      - no stale hits: under ingest/delete churn the cache-on results
+        stay identical to a churned cache-off twin — ``stale_hits == 0``,
+      - miss parity: a never-seen query population returns bit-identical
+        ids AND scores on both twins (a cold probe is a pure pass-through).
+    """
+    from lazzaro_tpu.core import state as S_mod
+    from lazzaro_tpu.core.index import MemoryIndex
+    from lazzaro_tpu.serve import RetrievalRequest
+    from lazzaro_tpu.utils.telemetry import Telemetry
+
+    dim = min(DIM, 128)
+    per = rows // tenants
+    slots = max(128, 2 * tenants * pool)
+    rng = np.random.default_rng(20)
+    emb = rng.standard_normal((rows, dim)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    # intent pool: per tenant, ``pool`` base query vectors; every served
+    # query is a paraphrase (tiny jitter, cosine >> threshold) of one,
+    # drawn Zipf(s) — the repeated-intent mass real agent traffic shows
+    intents = rng.standard_normal((tenants, pool, dim)).astype(np.float32)
+    intents /= np.linalg.norm(intents, axis=2, keepdims=True)
+    zp = (1.0 / np.arange(1, pool + 1) ** zipf_s)
+    zp /= zp.sum()
+    kw = dict(cap_take=5, max_nbr=16, super_gate=0.4,
+              acc_boost=0.05, nbr_boost=0.02, now=500.0)
+
+    def build(sem: bool):
+        tel = Telemetry()
+        idx = MemoryIndex(dim=dim, capacity=rows + 255, telemetry=tel,
+                          epoch=0.0, semantic_cache=sem,
+                          semantic_cache_slots=slots)
+        for t in range(tenants):
+            lo = t * per
+            idx.add([f"t{t}:n{i}" for i in range(per)], emb[lo:lo + per],
+                    [0.5] * per, [100.0] * per, ["semantic"] * per,
+                    ["default"] * per, f"t{t}")
+        return idx, tel
+
+    def turn_reqs(seed):
+        r = np.random.default_rng(seed)
+        out = []
+        for j in range(batch):
+            t = int(r.integers(tenants))
+            i = int(r.choice(pool, p=zp))
+            q = intents[t, i] + 0.003 * r.standard_normal(dim).astype(
+                np.float32)
+            out.append(RetrievalRequest(query=q, tenant=f"t{t}", k=10,
+                                        gate_enabled=True))
+        return out
+
+    t0 = time.perf_counter()
+    idx_on, tel_on = build(True)
+    idx_off, tel_off = build(False)
+    fill_s = time.perf_counter() - t0
+
+    # measured dispatch counter over the exact-family jit entries (static
+    # + ragged + twins) — the fused-serving invariant, cache ON
+    calls = {"n": 0}
+    wrapped = {}
+    for name in ("search_fused", "search_fused_copy", "search_fused_read",
+                 "search_fused_ragged", "search_fused_ragged_copy",
+                 "search_fused_ragged_read"):
+        orig = getattr(S_mod, name)
+        wrapped[name] = orig
+
+        def counting(*a, __orig=orig, **k2):
+            calls["n"] += 1
+            return __orig(*a, **k2)
+
+        setattr(S_mod, name, counting)
+
+    # warm/compile both twins AND pre-seat the steady-state working set
+    t0 = time.perf_counter()
+    for s in (0, 1):
+        idx_on.search_fused_requests(turn_reqs(s), **kw)
+        idx_off.search_fused_requests(turn_reqs(s), **kw)
+    warm_s = time.perf_counter() - t0
+
+    h0 = tel_on.counter_total("serve.semantic_hits")
+    m0 = tel_on.counter_total("serve.semantic_misses")
+    calls["n"] = 0
+    t0 = time.perf_counter()
+    for s in range(turns):
+        idx_on.search_fused_requests(turn_reqs(s), **kw)
+    on_s = time.perf_counter() - t0
+    dispatches_per_turn = calls["n"] / turns
+    for name, orig in wrapped.items():
+        setattr(S_mod, name, orig)
+    hits = tel_on.counter_total("serve.semantic_hits") - h0
+    misses = tel_on.counter_total("serve.semantic_misses") - m0
+    hit_rate = hits / max(1, hits + misses)
+
+    t0 = time.perf_counter()
+    for s in range(turns):
+        idx_off.search_fused_requests(turn_reqs(s), **kw)
+    off_s = time.perf_counter() - t0
+    qps_on = turns * batch / on_s
+    qps_off = turns * batch / off_s
+
+    # miss parity: a NEVER-seen population (novel random directions, far
+    # below threshold of anything cached) must be bit-identical on both
+    fr = np.random.default_rng(777)
+    fq = fr.standard_normal((batch, dim)).astype(np.float32)
+    fq /= np.linalg.norm(fq, axis=1, keepdims=True)
+    fresh = [RetrievalRequest(query=fq[j],
+                              tenant=f"t{int(fr.integers(tenants))}",
+                              k=10, gate_enabled=True)
+             for j in range(batch)]
+    ra = idx_on.search_fused_requests(list(fresh), **kw)
+    rb = idx_off.search_fused_requests(list(fresh), **kw)
+    miss_parity = all(a.ids == b.ids and a.scores == b.scores
+                      for a, b in zip(ra, rb))
+
+    # recall@10 of the warm (hit-serving) turn vs exact brute force over
+    # the master matrix — a cached window must BE the exact answer
+    probe = turn_reqs(0)
+    res = idx_on.search_fused_requests(list(probe), **kw)
+    got, want = 0, 0
+    for r_i, rq in zip(res, probe):
+        t = int(rq.tenant[1:])
+        qn = rq.query / np.linalg.norm(rq.query)
+        sims = emb[t * per:(t + 1) * per] @ qn
+        top = {f"t{t}:n{i}" for i in np.argsort(-sims)[:10]}
+        got += len(top & set(r_i.ids))
+        want += len(top)
+    recall = got / max(1, want)
+
+    # churn: fresh ingest + a delete per round, then the SAME popular
+    # queries on both twins. Staleness is content-level: a served window
+    # containing a DELETED row, or a churned tenant's queries diverging
+    # from the cache-off twin (its entries were invalidated, so those
+    # MUST be fresh scans). Unchurned tenants may legitimately serve the
+    # cached intent's ranking for a near-dup paraphrase — that is the
+    # cache's contracted approximation, not staleness.
+    stale_hits = 0
+    churn_rounds = 4
+    dead: set = set()
+    for c in range(churn_rounds):
+        t = c % tenants
+        nv = intents[t, 0] + 0.01 * rng.standard_normal(dim).astype(
+            np.float32)
+        nv /= np.linalg.norm(nv)
+        for ix in (idx_on, idx_off):
+            ix.add([f"t{t}:new{c}"], nv.reshape(1, -1), [0.9], [200.0],
+                   ["semantic"], ["default"], f"t{t}")
+        victim = f"t{t}:n{c}"
+        dead.add(victim)
+        idx_on.delete([victim])
+        idx_off.delete([victim])
+        creqs = turn_reqs(c)
+        qa = idx_on.search_fused_requests(list(creqs), **kw)
+        qb = idx_off.search_fused_requests(list(creqs), **kw)
+        for a, b, rq in zip(qa, qb, creqs):
+            if dead & set(a.ids):
+                stale_hits += 1          # deleted row still served
+            elif rq.tenant == f"t{t}" and a.ids != b.ids:
+                stale_hits += 1          # invalidated entry survived
+
+    sem_stats = idx_on.stats().get("semantic_cache") or {}
+    out = {
+        "semantic_cache": True,
+        "arena_rows": rows, "dim": dim, "tenants": tenants,
+        "batch": batch, "turns": turns,
+        "zipf_s": zipf_s, "intent_pool_per_tenant": pool,
+        "ring_slots": slots,
+        "ring_occupied": sem_stats.get("occupied"),
+        "fill_s": round(fill_s, 1), "warm_s": round(warm_s, 1),
+        "dispatches_per_turn": dispatches_per_turn,
+        "semantic_hit_rate": round(hit_rate, 4),
+        "hit_rate_floor": hit_rate_floor,
+        "semantic_qps": round(qps_on, 1),
+        "cache_off_qps": round(qps_off, 1),
+        "semantic_vs_off_speedup": round(qps_on / qps_off, 2),
+        "speedup_floor": speedup_floor,
+        "miss_parity": bool(miss_parity),
+        "stale_hits": int(stale_hits),
+        "churn_rounds": churn_rounds,
+        "recall_at_10": round(recall, 4),
+        "recall_floor": recall_floor,
+        "stale_evictions": tel_on.counter_total(
+            "serve.semantic_stale_evictions"),
+        # ring-geometry sweep for check_hbm_budget.py (ISSUE 20): every
+        # (slots × width) a deployment might configure must either fit
+        # the per-chip budget or have a feasible planned split — swept
+        # through the cost model's sem terms, not just the one geometry
+        # this stage happened to compile
+        "geometries_exercised": [
+            {"kind": "serve", "mode": "exact", "batch": batch,
+             "rows": rows + 256, "dim": dim, "k": 10, "dtype_bytes": 4,
+             "sem_slots": s, "sem_width": w}
+            for s in (64, 256, 1024)
+            for w in (64, 136, 264)],
+        "telemetry": _telemetry_block(tel_on),
+        "baseline_telemetry": _telemetry_block(tel_off),
+        "roofline": _roofline(rows, dim, 2, on_s * 1e3 / turns, batch,
+                              on_tpu),
+    }
+    del idx_on, idx_off
+    return out
+
+
 def bench_reference_default(on_tpu: bool):
     """Reference-DEFAULT configuration, measured (r4 review #4): hierarchy
     ON (super-node creation + the 0.4-gated fast path, ref
@@ -4038,6 +4257,47 @@ def lifecycle_stage_main():
                           if k not in ("telemetry",)}}}))
 
 
+def semantic_cache_stage_main():
+    """Standalone semantic-cache acceptance stage (BENCH_SEMANTIC_CACHE=
+    <rows> or =1 for the default 65536): a Zipf(s≈1.1) multi-tenant
+    repeated-intent workload with near-dup paraphrase mass, served with
+    the similarity ring ON vs OFF — measured dispatches_per_turn (must
+    stay 1.0), semantic hit rate, QPS speedup vs the cache-off twin,
+    stale_hits under ingest/delete churn (must be 0), miss-population
+    bit-parity, and recall@10 of hit-served turns. Writes
+    bench_artifacts/pr20_semantic_cache_<size>_<dev>.json (gated in CI
+    by scripts/check_dispatch_counts.py, swept by check_hbm_budget.py
+    via the ring-geometry HBM model). BENCH_SEMANTIC_TENANTS picks the
+    tenant count (default 4, the ISSUE floor)."""
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    spec = os.environ.get("BENCH_SEMANTIC_CACHE", "1")
+    rows = 65_536 if spec.strip() in ("", "1") else int(spec)
+    tenants = int(os.environ.get("BENCH_SEMANTIC_TENANTS", "4"))
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    dev_tag = "tpu" if on_tpu else "cpu"
+    print(f"[bench] semantic-cache stage at {rows} rows, {tenants} "
+          f"tenants", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    out = bench_semantic_cache(on_tpu, rows, tenants=tenants)
+    out["stage_total_s"] = round(time.perf_counter() - t0, 1)
+    size_tag = "1m" if rows >= 1_000_000 else f"{rows // 1024}k"
+    path = os.path.join(art_dir,
+                        f"pr20_semantic_cache_{size_tag}_{dev_tag}.json")
+    with open(path, "w") as f:
+        json.dump({"metric": "semantic_cache_speedup",
+                   "value": out["semantic_vs_off_speedup"], "unit": "x",
+                   "device": dev_tag, "sizes": {size_tag: out}},
+                  f, indent=1)
+    print(f"[bench] wrote {path}", file=sys.stderr, flush=True)
+    print(json.dumps({"metric": "semantic_cache_speedup",
+                      "sizes": {size_tag: {
+                          k: v for k, v in out.items()
+                          if k not in ("telemetry",
+                                       "baseline_telemetry")}}}))
+
+
 def replica_stage_main():
     """Standalone replica-serving acceptance stage (BENCH_REPLICA=<rows>
     or =1 for the default 512): aggregate routed QPS over 1→2→4 replica
@@ -4802,6 +5062,9 @@ if __name__ == "__main__":
             sys.exit(0)
         if os.environ.get("BENCH_REPLICA"):
             replica_stage_main()
+            sys.exit(0)
+        if os.environ.get("BENCH_SEMANTIC_CACHE"):
+            semantic_cache_stage_main()
             sys.exit(0)
         if os.environ.get("BENCH_LIFECYCLE"):
             lifecycle_stage_main()

@@ -208,6 +208,31 @@ class MemoryConfig:
     # conversation end / save; the flush also triggers early past this
     # many distinct nodes.
     serve_boost_flush_max: int = 4096
+    # Semantic query cache (ISSUE 20): a device-resident ring of recent
+    # query embeddings + their packed top-k results, probed INSIDE every
+    # fused serving kernel — a query whose top-1 cosine against the ring
+    # clears semantic_cache_threshold substitutes the cached result and
+    # early-outs its scan, in the SAME one dispatch + one packed
+    # readback. Misses write themselves back into the ring in-dispatch
+    # (LIFO rotation). Entries are keyed by (tenant, serving-mode,
+    # requested k/nprobe), so a mode flip or geometry change is an
+    # automatic miss; host-side invalidation (ingest, delete, tier
+    # moves, lifecycle) flips validity bits via a row→slot reverse
+    # index, so stale hits never serve. Off by default: exact-text hits
+    # already ride the host QueryCache; this tier catches PARAPHRASED
+    # repeated intent at near-zero device cost.
+    semantic_cache: bool = False
+    # Ring capacity in cached queries (per index; the pod path keeps one
+    # replicated ring). HBM cost ≈ slots · (d·4 + width·8) bytes.
+    semantic_cache_slots: int = 64
+    # Top-1 cosine a probe must clear against a same-(tenant, mode,
+    # geometry) ring entry to substitute its cached result. Near-dup
+    # paraphrases of one intent sit ≥ 0.98 under typical embedders;
+    # raise toward 1.0 to serve only near-verbatim repeats.
+    semantic_cache_threshold: float = 0.985
+    # Static block width of the in-kernel miss scan's early-out loop
+    # (queries per while_loop step; trace-time constant).
+    semantic_cache_block: int = 16
 
     # --- reliability (ISSUE 10) --------------------------------------------
     # Per-dispatch watchdog deadline for the query scheduler: > 0 arms a

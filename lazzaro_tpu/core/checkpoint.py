@@ -350,6 +350,17 @@ def save_index(index: MemoryIndex, ckpt_dir: str,
         arrays.update(index._pager.export_arrays())
         meta["paged"] = {"page_rows": int(index._pager.page_rows),
                          "pool_slots": int(index._pager.pool_slots)}
+    # Semantic query cache (ISSUE 20): the warm ring survives restarts —
+    # the device leaves plus the host mirror's validity/tenant/head ride
+    # the snapshot; the row→slot reverse index rebuilds from the ring's
+    # own candidate rows on load. Same meta idiom as ``tier``/``paged``:
+    # absent in older checkpoints, geometry recorded for the load-time
+    # match (a mismatched ring restores COLD, never wrong).
+    sem = getattr(index, "_sem_host", None)
+    if sem is not None:
+        arrays.update(sem.export_arrays())
+        meta["semantic_cache"] = {"slots": sem.slots, "width": sem.width,
+                                  "threshold": sem.threshold}
     if extra_meta:
         meta.update(extra_meta)
     _write_versioned(ckpt_dir, arrays, meta)
@@ -490,6 +501,12 @@ def load_index(ckpt_dir: str, mesh=None, shard_axis: str = "data",
         budget = int(tier_kw.pop("hot_budget_rows"))
         tmgr = index.enable_tiering(budget, **tier_kw)
         tmgr.import_arrays(data)
+    # Semantic query cache (ISSUE 20): restore the warm ring when the
+    # restored index also enabled the cache AND the saved geometry
+    # matches the configured one; otherwise the fresh empty ring stands
+    # (a cold cache, never a wrong one).
+    if "sem_emb" in data and index._sem_host is not None:
+        index._sem_host.import_arrays(data)
     return index
 
 

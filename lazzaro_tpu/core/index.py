@@ -165,6 +165,209 @@ class _EdgeSlotMap(dict):
         self.by_slot.clear()
 
 
+class SemanticCacheHost:
+    """Host mirror of the device-resident semantic query-cache ring
+    (ISSUE 20), shared by ``MemoryIndex`` and the pod
+    ``ShardedMemoryIndex``.
+
+    The DEVICE side is the ``state.SemanticRing`` the fused serving
+    kernels probe/substitute/write in-dispatch; this mirror owns
+    everything the kernels must NOT pay a readback for:
+
+    - ``valid`` / ``head`` — the slot validity bits and LIFO cursor that
+      ride into every dispatch as data. The kernel's writeback contract
+      is derivable from the packed readback alone (rank j = the j-th
+      miss in batch order, kept = the last R misses, slot =
+      ``(head + rank) % R``, head' = ``(head + n_miss) % R``), so
+      ``note_readback`` replays it exactly — no extra transfer.
+    - the row→slot reverse index — every arena row a cached result
+      references maps to the slots caching it, so ingest dedup-merges,
+      deletes, tier demotions/promotions and lifecycle prunes can flip
+      exactly the stale slots' validity bits (``invalidate_rows``)
+      instead of flushing the ring.
+    - per-slot tenant ids, so ``invalidate_tenant`` scopes a flush the
+      way ``QueryCache.invalidate_results(tenant=...)`` does.
+
+    Invalidation is host-state only: the device ring keeps its (now
+    unreachable) entry until the LIFO rotation overwrites it, because
+    validity is an input column, not device state.
+    """
+
+    def __init__(self, slots: int, dim: int, width: int, threshold: float,
+                 block: int, telemetry=None):
+        self.slots = max(1, int(slots))
+        self.dim = int(dim)
+        self.width = max(1, int(width))
+        self.threshold = float(threshold)
+        self.block = max(1, int(block))
+        self.ring = S.init_semantic_ring(self.slots, self.dim, self.width)
+        self.valid = np.zeros((self.slots,), bool)
+        self.head = 0
+        self.slot_tenant = np.full((self.slots,), -1, np.int32)
+        self.slot_rows: List[set] = [set() for _ in range(self.slots)]
+        self.row_slots: Dict[int, set] = {}
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ dispatch
+    def tuple_for(self, mode: str):
+        """The ``sem`` kernel operand for one dispatch of serving-family
+        ``mode`` — ``(ring, valid, head, threshold, mode_id)`` — or None
+        when the family has no semantic id (entries never cross
+        families, so a mode flip is an automatic miss)."""
+        mid = S.SEM_MODE_IDS.get(mode)
+        if mid is None:
+            return None
+        with self._lock:
+            return (self.ring, jnp.asarray(self.valid),
+                    jnp.int32(self.head), jnp.float32(self.threshold),
+                    jnp.int32(mid))
+
+    def note_readback(self, ring2, sem_col, valid_q, tenants, gate_s,
+                      gate_r, ann_s, ann_r) -> None:
+        """Replay one dispatch's in-kernel writeback onto the mirror.
+        ``sem_col`` is the packed readback's semantic counter (0 = miss,
+        1 + slot on a hit); the written slots and the head advance follow
+        from it and the batch order alone. Miss queries' result rows
+        (live ANN rows + the gate row) feed the row→slot reverse
+        index."""
+        with self._lock:
+            self.ring = ring2
+            miss = np.asarray(valid_q, bool) & (np.asarray(sem_col) == 0)
+            midx = np.nonzero(miss)[0]
+            n_miss = len(midx)
+            R = self.slots
+            for rank, qi in enumerate(midx):
+                if rank < n_miss - R:
+                    continue               # rotated over inside the batch
+                slot = (self.head + rank) % R
+                self._clear_slot(slot)
+                live = ann_s[qi] > S.NEG_INF / 2
+                rows = {int(r) for r in ann_r[qi][live]}
+                if gate_s[qi] > S.NEG_INF / 2:
+                    rows.add(int(gate_r[qi]))
+                self.slot_rows[slot] = rows
+                for r in rows:
+                    self.row_slots.setdefault(r, set()).add(slot)
+                self.slot_tenant[slot] = int(tenants[qi])
+                self.valid[slot] = True
+            self.head = (self.head + n_miss) % R
+            occ = float(self.valid.sum()) / R
+        if self.telemetry is not None:
+            self.telemetry.gauge("serve.semantic_ring_occupancy", occ)
+
+    # -------------------------------------------------------- invalidation
+    def _clear_slot(self, slot: int) -> None:
+        for r in self.slot_rows[slot]:
+            s = self.row_slots.get(r)
+            if s is not None:
+                s.discard(slot)
+                if not s:
+                    del self.row_slots[r]
+        self.slot_rows[slot] = set()
+        self.valid[slot] = False
+        self.slot_tenant[slot] = -1
+
+    def invalidate_rows(self, rows: Iterable[int]) -> int:
+        """Flip validity off for every slot whose cached result touches
+        any of ``rows`` (the mutation hooks' entry point: ingest
+        dedup-merge targets, deleted rows, tier moves, lifecycle
+        prunes). Returns the number of slots evicted."""
+        with self._lock:
+            hit: set = set()
+            for r in rows:
+                hit |= self.row_slots.get(int(r), set())
+            for s in hit:
+                self._clear_slot(s)
+        if hit and self.telemetry is not None:
+            self.telemetry.bump("serve.semantic_stale_evictions", len(hit))
+        return len(hit)
+
+    def invalidate_tenant(self, tid: Optional[int]) -> int:
+        """Flip validity off for one tenant's slots (None = all slots):
+        the semantic twin of ``QueryCache.invalidate_results``, and the
+        new-row ingest hook — a fresh fact can change its tenant's
+        top-k, which no row-level index can see."""
+        with self._lock:
+            if tid is None:
+                hit = [s for s in range(self.slots) if self.valid[s]]
+            else:
+                hit = [s for s in range(self.slots)
+                       if self.valid[s] and self.slot_tenant[s] == tid]
+            for s in hit:
+                self._clear_slot(s)
+        if hit and self.telemetry is not None:
+            self.telemetry.bump("serve.semantic_stale_evictions", len(hit))
+        return len(hit)
+
+    # --------------------------------------------------------- persistence
+    def export_arrays(self) -> Dict[str, np.ndarray]:
+        """Checkpoint payload: the device ring's leaves plus the mirror's
+        validity/tenant columns (the reverse index is derivable — see
+        ``import_arrays``)."""
+        out = {f"sem_{name}": np.asarray(getattr(self.ring, name))
+               for name in ("emb", "tenant", "mode", "stored_k", "nprobe",
+                            "gate_on", "gate_s", "gate_r", "ann_s",
+                            "ann_r")}
+        out["sem_valid"] = self.valid.copy()
+        out["sem_slot_tenant"] = self.slot_tenant.copy()
+        out["sem_head"] = np.asarray([self.head], np.int32)
+        return out
+
+    def import_arrays(self, data) -> bool:
+        """Restore from ``export_arrays``. Geometry must match the
+        configured ring (slots/dim/width) — a mismatch keeps the fresh
+        empty ring (a cold cache, never a wrong one). The row→slot
+        reverse index rebuilds from the ring's own ann/gate rows."""
+        emb = np.asarray(data["sem_emb"])
+        ann_s = np.asarray(data["sem_ann_s"])
+        if (emb.shape != (self.slots + 1, self.dim)
+                or ann_s.shape != (self.slots + 1, self.width)):
+            return False
+        self.ring = S.SemanticRing(
+            emb=jnp.asarray(emb, jnp.float32),
+            tenant=jnp.asarray(np.asarray(data["sem_tenant"], np.int32)),
+            mode=jnp.asarray(np.asarray(data["sem_mode"], np.int32)),
+            stored_k=jnp.asarray(np.asarray(data["sem_stored_k"],
+                                            np.int32)),
+            nprobe=jnp.asarray(np.asarray(data["sem_nprobe"], np.int32)),
+            gate_on=jnp.asarray(np.asarray(data["sem_gate_on"], bool)),
+            gate_s=jnp.asarray(np.asarray(data["sem_gate_s"], np.float32)),
+            gate_r=jnp.asarray(np.asarray(data["sem_gate_r"], np.int32)),
+            ann_s=jnp.asarray(np.asarray(data["sem_ann_s"], np.float32)),
+            ann_r=jnp.asarray(np.asarray(data["sem_ann_r"], np.int32)))
+        self.valid = np.asarray(data["sem_valid"], bool).copy()
+        self.slot_tenant = np.asarray(data["sem_slot_tenant"],
+                                      np.int32).copy()
+        self.head = int(np.asarray(data["sem_head"]).reshape(-1)[0])
+        ann_s_np = np.asarray(data["sem_ann_s"])
+        ann_r_np = np.asarray(data["sem_ann_r"], np.int64)
+        gate_s_np = np.asarray(data["sem_gate_s"])
+        gate_r_np = np.asarray(data["sem_gate_r"], np.int64)
+        self.slot_rows = [set() for _ in range(self.slots)]
+        self.row_slots = {}
+        for s in range(self.slots):
+            if not self.valid[s]:
+                continue
+            rows = {int(r) for r, sc in zip(ann_r_np[s], ann_s_np[s])
+                    if sc > S.NEG_INF / 2}
+            if gate_s_np[s] > S.NEG_INF / 2:
+                rows.add(int(gate_r_np[s]))
+            self.slot_rows[s] = rows
+            for r in rows:
+                self.row_slots.setdefault(r, set()).add(s)
+        return True
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "width": self.width,
+                "threshold": self.threshold,
+                "occupied": int(self.valid.sum()),
+            }
+
+
 class MemoryIndex:
     """Single-chip by default; pass ``mesh`` to row-shard every arena column
     over a mesh axis — the scaling-book recipe: annotate the shardings, let
@@ -194,7 +397,11 @@ class MemoryIndex:
                  hbm_headroom_fraction: float = 0.1,
                  plan_max_splits: int = 16,
                  plan_calibration_path: Optional[str] = None,
-                 planner: Optional[HbmPlanner] = None):
+                 planner: Optional[HbmPlanner] = None,
+                 semantic_cache: bool = False,
+                 semantic_cache_slots: int = 64,
+                 semantic_cache_threshold: float = 0.985,
+                 semantic_cache_block: int = 16):
         self.dim = dim
         self.dtype = dtype
         # Donation-safe recovery (ISSUE 10): a failed donated dispatch
@@ -396,6 +603,18 @@ class MemoryIndex:
         self.serve_ragged = bool(serve_ragged)
         self.serve_k_max = max(1, int(serve_k_max))
         self.serve_pad_granularity = max(1, int(serve_pad_granularity))
+        # Semantic query cache (ISSUE 20): the device ring + host mirror.
+        # Ring width = the widest candidate window any family substitutes
+        # (the ragged k ceiling + the tiered slack), so ONE ring serves
+        # every kernel family; batches whose k-bucket overflows it (non-
+        # ragged k > serve_k_max) just skip the probe for that dispatch.
+        self._sem_host = None
+        if semantic_cache:
+            self._sem_host = SemanticCacheHost(
+                semantic_cache_slots, dim,
+                self.serve_k_max + self.coarse_slack,
+                semantic_cache_threshold, semantic_cache_block,
+                telemetry=self.telemetry)
         # Distinct fused serving-kernel keys this index has dispatched
         # (mode + statics — with ragged on, exactly one per mode); the
         # bench's compile_cache_entries measurement and the
@@ -950,7 +1169,26 @@ class MemoryIndex:
                      else None),
             "paged": (self._page_block() if self._pager is not None
                       else None),
+            "semantic_cache": (self._sem_host.stats()
+                               if self._sem_host is not None else None),
         }
+
+    def semantic_invalidate(self, tenant: Optional[str] = None) -> int:
+        """Evict the semantic query cache's entries for ``tenant`` (None
+        = every tenant): the device-ring twin of
+        ``QueryCache.invalidate_results``. Host-mutation paths that
+        bypass the index's own hooks (external edits, manual repair)
+        should call this; the built-in mutators (``add``, ingest,
+        ``delete``, tier moves) already invalidate exactly. Returns the
+        number of ring slots evicted."""
+        if self._sem_host is None:
+            return 0
+        if tenant is None:
+            return self._sem_host.invalidate_tenant(None)
+        tid = self._tenants.get(tenant)
+        if tid is None:
+            return 0
+        return self._sem_host.invalidate_tenant(tid)
 
     def _page_block(self) -> Dict[str, object]:
         pager = self._pager
@@ -1099,6 +1337,8 @@ class MemoryIndex:
         self._ivf_note_added(rows)
         if self.tiering is not None:       # a re-added cold row is hot again
             self.tiering.on_rows_written(rows)
+        if self._sem_host is not None:     # new facts change tenant top-k
+            self._sem_host.invalidate_tenant(tid)
         return rows
 
     def _note_super(self, rows: Sequence[int], flags: Sequence[bool]) -> None:
@@ -1469,6 +1709,8 @@ class MemoryIndex:
             self.link_pool_overflows += 1
             self.telemetry.bump("ingest.link_pool_overflows")
             self.add_edges(overflowed, tenant, now=now)
+        if self._sem_host is not None:     # new facts change tenant top-k
+            self._sem_host.invalidate_tenant(tid)
         return rows, candidates, created
 
     def _link_pool_size(self, worst: int, hint: float) -> int:
@@ -1825,6 +2067,18 @@ class MemoryIndex:
         self._free_edge_slots.extend(link_pool[consumed:])
         self._free_edge_slots.extend(reclaim)
         self._csr_dirty = True
+        if self._sem_host is not None:
+            # Semantic-cache invalidation off THIS ingest readback
+            # (ISSUE 20): dedup-merge targets mutated in place — flip
+            # exactly the slots caching them via the row→slot reverse
+            # index; any ACCEPTED fact can change its tenant's top-k,
+            # which no row-level index can see, so those flush the
+            # tenant's slots.
+            tgt = pending["target_rows"]
+            self._sem_host.invalidate_rows(
+                int(tgt[i]) for i in range(n) if dup[i])
+            if live_rows:
+                self._sem_host.invalidate_tenant(self._tenants.get(tenant))
         if pending.get("ivf_host") is not None:
             # in-dispatch member appends: routed immediately, spills to
             # the exact-scan extras (ISSUE 12)
@@ -1982,6 +2236,10 @@ class MemoryIndex:
         self._apply_edges(S.edges_delete_for_nodes,
                           S.edges_delete_for_nodes_copy, jnp.asarray(padded))
         self._free_rows.extend(rows)
+        if self._sem_host is not None:
+            # cached results naming a freed row are stale the moment the
+            # slot can be re-used — flip exactly those slots (ISSUE 20)
+            self._sem_host.invalidate_rows(rows)
         if self.tiering is not None:       # freed cold rows leave the store
             self.tiering.on_rows_deleted(rows)
         if self._super_rows:
@@ -2236,6 +2494,10 @@ class MemoryIndex:
         self._ivf_serve_cache = None
         self._ivf_pack = (ivf, ())
         self._publish_online_tables(ivf)
+        if self._sem_host is not None:
+            # a re-seed changes coarse routing for EVERY tenant — cached
+            # ivf/pq windows may no longer match what a fresh scan returns
+            self._sem_host.invalidate_tenant(None)
         if self.pq_serving:
             # (re)train the member codebook on the same build cadence and
             # publish it WITH its complete code slab in ONE pack swap — a
@@ -2617,7 +2879,11 @@ class MemoryIndex:
             mesh_parts=self._n_parts, edge_cap=self.edge_state.capacity,
             nprobe=int(self.ivf_nprobe or 0),
             slack=int(self.coarse_slack),
-            pool_rows=(st.emb.shape[0] if st.row_map is not None else 0))
+            pool_rows=(st.emb.shape[0] if st.row_map is not None else 0),
+            sem_slots=(self._sem_host.slots if self._sem_host is not None
+                       else 0),
+            sem_width=(self._sem_host.width if self._sem_host is not None
+                       else 0))
 
     def search_fused_requests(self, reqs, *, cap_take: int, max_nbr: int,
                               super_gate: float, acc_boost: float,
@@ -2827,6 +3093,18 @@ class MemoryIndex:
             mode = ("sharded_tiered" if tiered
                     else "sharded_quant" if self.int8_serving
                     else "sharded_exact")
+            # Semantic query cache (ISSUE 20): the replicated ring rides
+            # the SAME distributed dispatch (substitution-only — the
+            # shard-local scans still run; the probe/substitute/writeback
+            # are replicated arithmetic after the merge). Entries key on
+            # the FAMILY mode id, so they never cross serving modes.
+            semh = self._sem_host
+            fam = mode[len("sharded_"):]
+            sem_state = None
+            if semh is not None and fam in S.SEM_MODE_IDS:
+                win = k_bucket + (self.coarse_slack if tiered else 0)
+                if win <= semh.width:
+                    sem_state = semh.tuple_for(fam)
             # Fault point "plan.oom" (ISSUE 11): models an HBM allocation
             # failure the admission plan missed — recovery is ONE replan
             # into split sub-dispatches through the copy twins.
@@ -2838,7 +3116,9 @@ class MemoryIndex:
                     boost_on, k_bucket, cap_take, max_nbr, super_gate,
                     acc_boost, nbr_boost, now, ragged=ragged,
                     k_arr=k_arr, cap_arr=cap_arr, tiered=tiered,
-                    force_copy=force_copy)
+                    force_copy=force_copy, sem=sem_state)
+                if sem_state is not None:
+                    sem_ring2, packed = packed
                 host = np.asarray(packed)      # the ONE readback
             tel.record("serve.dispatch_ms",
                        (time.perf_counter() - t0) * 1e3,
@@ -2849,6 +3129,12 @@ class MemoryIndex:
                 del st                     # the finish may donate the state
                 now_rel = ((now if now is not None else time.time())
                            - self.epoch)
+                if sem_state is not None:
+                    k_unpack = (host.shape[1] - 8) // 2
+                    g_s, g_r, a_s, a_r, _, ctr = unpack_retrieval(
+                        host[:nq], k_unpack)
+                    semh.note_readback(sem_ring2, ctr[:, 4], valid[:nq],
+                                       tenants[:nq], g_s, g_r, a_s, a_r)
                 with tel.span("serve.decode_ms"):
                     return tiered_decode_and_finish(
                         self, tm, reqs, results, valid, boost_on, q,
@@ -2865,9 +3151,14 @@ class MemoryIndex:
                                         cap,
                                         lengths=(counters[:, 0] if ragged
                                                  else None))
+            if sem_state is not None:
+                semh.note_readback(sem_ring2, counters[:, 4], valid[:nq],
+                                   tenants[:nq], gate_s, gate_r, ann_s,
+                                   ann_r)
             record_device_counters(
                 tel, counters, fast, gate_on[:nq], valid[:nq],
-                np.asarray([min(int(r.k), cap) for r in reqs]))
+                np.asarray([min(int(r.k), cap) for r in reqs]),
+                sem_active=sem_state is not None)
             return out
         args = (indptr, nbr, jnp.asarray(qp),
                 jnp.asarray(padb(valid)),
@@ -2943,6 +3234,21 @@ class MemoryIndex:
             # chunks the arena stream tighter — smaller [chunk, rows]
             # score tile, SAME single dispatch, bit-identical results.
             statics["scan_chunk"] = int(scan_chunk)
+        # Semantic query cache (ISSUE 20): the ring probe, hit
+        # substitution with per-query scan early-out, and the miss
+        # writeback all ride INSIDE this one dispatch; the hit verdict
+        # comes back in the packed readback's semantic counter. Skipped
+        # when the batch's candidate window outgrows the ring width
+        # (non-ragged k-buckets past serve_k_max).
+        semh = self._sem_host
+        sem_kw = {}
+        if semh is not None and mode in S.SEM_MODE_IDS:
+            win = k_bucket + (statics.get("slack", 0)
+                              if mode in ("tiered", "ivf_tiered",
+                                          "pq_tiered") else 0)
+            if win <= semh.width:
+                statics["sem_block"] = semh.block
+                sem_kw = {"sem": semh.tuple_for(mode)}
         self._note_serve_kernel(mode, statics, ragged)
         # pq_tiered never touches the int8 shadow — the cold coarse scan
         # reads the PQ slab already in pq_tabs; only the residency mask
@@ -2957,6 +3263,11 @@ class MemoryIndex:
         # Fault point "plan.oom" (ISSUE 11): an HBM allocation failure the
         # admission plan missed; the wrapper answers with one replan.
         faults.fire("plan.oom", mode=mode, batch=pad_n)
+        if sem_kw and not boost_on.any():
+            # the read twins take the ring operand as a plain kwarg next
+            # to their statics; the boost branch passes it explicitly
+            # beside its donated state
+            statics = dict(statics, **sem_kw)
         t0 = time.perf_counter()
         with trace_annotation(f"lz.serve.{mode}"):
             if boost_on.any():
@@ -3089,11 +3400,15 @@ class MemoryIndex:
                         else:
                             twins = (S.search_fused, S.search_fused_copy)
                             boost_args = (boost_dev,) + scalars
-                    new_state, packed = self._guarded(
+                    out = self._guarded(
                         lambda fn: fn(cur, *pre, *args, *boost_args,
-                                      **statics),
+                                      **sem_kw, **statics),
                         twins[0], twins[1], sole, (cur,),
                         "serve_" + mode)
+                    if sem_kw:
+                        new_state, sem_ring2, packed = out
+                    else:
+                        new_state, packed = out
                     del cur
                     self.state = new_state
             elif pq_tiered:
@@ -3173,6 +3488,8 @@ class MemoryIndex:
                     packed = S.search_fused_read(st, *args,
                                                  jnp.float32(super_gate),
                                                  **statics)
+            if sem_kw and not boost_on.any():
+                sem_ring2, packed = packed
             host = np.asarray(packed)          # the ONE readback
         tel.record("serve.dispatch_ms", (time.perf_counter() - t0) * 1e3,
                    labels={"mode": mode})
@@ -3191,12 +3508,16 @@ class MemoryIndex:
                     max_nbr=max_nbr, acc_boost=acc_boost,
                     nbr_boost=nbr_boost, now_rel=now_rel, ragged=ragged,
                     cap_arr=(cap_arr if ragged else None), tel=tel)
-            k_unpack = (host.shape[1] - 7) // 2
-            _, _, _, _, fast_np, counters = unpack_retrieval(host[:nq],
-                                                             k_unpack)
+            k_unpack = (host.shape[1] - 8) // 2
+            g_s, g_r, a_s, a_r, fast_np, counters = unpack_retrieval(
+                host[:nq], k_unpack)
+            if sem_kw:
+                semh.note_readback(sem_ring2, counters[:, 4], valid[:nq],
+                                   tenants[:nq], g_s, g_r, a_s, a_r)
             record_device_counters(
                 tel, counters, fast_np, gate_on[:nq], valid[:nq],
-                np.asarray([min(int(r.k), cap) for r in reqs]))
+                np.asarray([min(int(r.k), cap) for r in reqs]),
+                sem_active=bool(sem_kw))
             return out
         with tel.span("serve.decode_ms"):
             gate_s, gate_r, ann_s, ann_r, fast, counters = unpack_retrieval(
@@ -3205,9 +3526,13 @@ class MemoryIndex:
                                     gate_r, ann_s, ann_r, fast, cap,
                                     lengths=(counters[:, 0] if ragged
                                              else None))
+        if sem_kw:
+            semh.note_readback(sem_ring2, counters[:, 4], valid[:nq],
+                               tenants[:nq], gate_s, gate_r, ann_s, ann_r)
         record_device_counters(
             tel, counters, fast, gate_on[:nq], valid[:nq],
-            np.asarray([min(int(r.k), cap) for r in reqs]))
+            np.asarray([min(int(r.k), cap) for r in reqs]),
+            sem_active=bool(sem_kw))
         return out
 
     def _note_serve_kernel(self, mode: str, statics: dict,
@@ -3410,6 +3735,11 @@ class MemoryIndex:
                 # exact-rescore shortlist the cost model must over-bound
                 labels["pq"] = "true"
                 labels["slack"] = str(int(self.coarse_slack))
+            if self._sem_host is not None and "sem_block" in statics:
+                # ring geometry for check_hbm_budget.py's semantic-cache
+                # sweep (ISSUE 20): resident ring + [batch, slots] probe
+                labels["sem_slots"] = str(self._sem_host.slots)
+                labels["sem_width"] = str(self._sem_host.width)
             self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
                                  labels=labels)
             # Calibrate the admission model against the measured truth
@@ -3425,7 +3755,13 @@ class MemoryIndex:
                          edge_cap=self.edge_state.capacity,
                          nprobe=int(statics.get("nprobe") or 0),
                          scan_chunk=int(statics.get("scan_chunk") or 0),
-                         slack=int(self.coarse_slack)),
+                         slack=int(self.coarse_slack),
+                         sem_slots=(self._sem_host.slots
+                                    if self._sem_host is not None
+                                    and "sem_block" in statics else 0),
+                         sem_width=(self._sem_host.width
+                                    if self._sem_host is not None
+                                    and "sem_block" in statics else 0)),
                 peak)
 
     def _demux_fused(self, reqs, results, valid, boost_on, gate_s, gate_r,
@@ -3453,18 +3789,21 @@ class MemoryIndex:
 
     def _fused_sharded_kernels(self, mode: str, k_bucket: int,
                                cap_take: int, max_nbr: int,
-                               ragged: bool = False):
+                               ragged: bool = False, sem: bool = False):
         # Ragged kernels collapse to per-mode keys — k_bucket IS the
         # static ceiling then, identical for every batch — so a mixed-k
         # request stream compiles one distributed program per mode.
         key = ((mode, "ragged", k_bucket, cap_take, max_nbr) if ragged
                else (mode, k_bucket, cap_take, max_nbr))
+        if sem:
+            key = key + ("sem",)
         kern = self._fused_sharded_cache.get(key)
         if kern is None:
             kern = S.make_fused_sharded(
                 self.mesh, self.shard_axis, k=k_bucket,
                 cap_take=min(cap_take, k_bucket), max_nbr=max_nbr,
-                mode=mode, slack=self.coarse_slack, ragged=ragged)
+                mode=mode, slack=self.coarse_slack, ragged=ragged,
+                sem=sem)
             self._fused_sharded_cache.put(key, kern)
             self.telemetry.gauge("kernel.cache_entries",
                                  len(self._fused_sharded_cache),
@@ -3476,7 +3815,7 @@ class MemoryIndex:
                                 cap_take, max_nbr, super_gate, acc_boost,
                                 nbr_boost, now, ragged=False, k_arr=None,
                                 cap_arr=None, tiered=False,
-                                force_copy=False):
+                                force_copy=False, sem=None):
         """The pod serving dispatch (ISSUE 5): the full chat-turn program
         as ONE distributed shard_map dispatch against the row-sharded
         arena. Exact by default; with ``int8_serving`` the shard-local
@@ -3500,7 +3839,9 @@ class MemoryIndex:
             return self._int8_shadow_for(st_) if use_quant else ()
 
         kern = self._fused_sharded_kernels(mode, k_bucket, cap_take,
-                                           max_nbr, ragged=ragged)
+                                           max_nbr, ragged=ragged,
+                                           sem=sem is not None)
+        sem_tail = () if sem is None else (sem,)
         sargs = (indptr, nbr, jnp.asarray(qp), jnp.asarray(padb(valid)),
                  jnp.asarray(padb(tenants, -1, np.int32)),
                  jnp.asarray(padb(gate_on)))
@@ -3522,7 +3863,7 @@ class MemoryIndex:
                 try:
                     tables = _tables(st)
                     peak = peak_bytes(kern.read.lower(
-                        st, tables, *sargs, *read_extra
+                        st, tables, *sargs, *read_extra, *sem_tail
                     ).compile().memory_analysis())
                 except Exception:   # noqa: BLE001 — never fail the serve
                     peak = None
@@ -3555,19 +3896,24 @@ class MemoryIndex:
                 boost_extra = ((jnp.asarray(padb(boost_on)), k_dev,
                                 capq_dev, npq_dev) if ragged
                                else (jnp.asarray(padb(boost_on)),))
-                new_state, packed = self._guarded(
+                out = self._guarded(
                     lambda fn: fn(cur, tables, *sargs, *boost_extra,
                                   jnp.float32(now_rel),
                                   jnp.float32(super_gate),
                                   jnp.float32(acc_boost),
-                                  jnp.float32(nbr_boost)),
+                                  jnp.float32(nbr_boost), *sem_tail),
                     kern.serve, kern.serve_copy, sole, (cur,),
                     "serve_sharded")
+                if sem is not None:
+                    new_state, ring2, packed = out
+                else:
+                    new_state, packed = out
                 del cur
                 self.state = new_state
-            return packed
+            return (ring2, packed) if sem is not None else packed
         tables = _tables(st)
-        return kern.read(st, tables, *sargs, *read_extra)
+        out = kern.read(st, tables, *sargs, *read_extra, *sem_tail)
+        return out
 
     def apply_boosts(self, entries: Dict[str, Tuple[int, int, float]],
                      acc_boost: float, nbr_boost: float) -> None:

@@ -210,7 +210,14 @@ class MemorySystem:
                                  plan_calibration_path=(
                                      cfg.plan_calibration_path),
                                  paged=cfg.paged_arena,
-                                 page_rows=cfg.arena_page_rows)
+                                 page_rows=cfg.arena_page_rows,
+                                 semantic_cache=cfg.semantic_cache,
+                                 semantic_cache_slots=(
+                                     cfg.semantic_cache_slots),
+                                 semantic_cache_threshold=(
+                                     cfg.semantic_cache_threshold),
+                                 semantic_cache_block=(
+                                     cfg.semantic_cache_block))
 
         # Tiered memory (ISSUE 8): a hot-row budget attaches the residency
         # manager and (with async on) the background demotion/promotion
@@ -960,7 +967,10 @@ class MemorySystem:
     # ------------------------------------------------------------- retrieval
     def _optimized_retrieval(self, query_emb: List[float], query_text: str) -> List[str]:
         if self.query_cache:
-            cached = self.query_cache.get_results(query_text)
+            # keyed by (tenant, text): two tenants asking the same
+            # question must never see each other's node ids
+            cached = self.query_cache.get_results(query_text,
+                                                  tenant=self.user_id)
             if cached:
                 return cached
 
@@ -1155,7 +1165,8 @@ class MemorySystem:
         - otherwise → the classic multi-dispatch ``_optimized_retrieval``.
         """
         if self.query_cache:
-            cached = self.query_cache.get_results(query_text)
+            cached = self.query_cache.get_results(query_text,
+                                                  tenant=self.user_id)
             if cached:
                 return cached, "deferred"
         if not self._use_fused_serving():
@@ -3015,6 +3026,15 @@ Be clinical yet insightful. Do not include conversational filler."""
         p95_retrieval = float(np.percentile(rt, 95)) if rt else 0
         avg_consolidation = float(np.mean(ct)) / 1e3 if ct else 0
         cache_hit_rate = self.query_cache.get_hit_rate() if self.query_cache else 0.0
+        sem_rate = self._semantic_hit_rate()
+        # ISSUE 20 satellite: both cache tiers land in the Telemetry
+        # registry, labeled, so the dashboard's /metrics and
+        # metrics_summary() read the same numbers this block formats
+        self.telemetry.gauge("serve.cache_hit_rate", cache_hit_rate,
+                             labels={"tier": "exact"})
+        if sem_rate is not None:
+            self.telemetry.gauge("serve.cache_hit_rate", sem_rate,
+                                 labels={"tier": "semantic"})
         return {
             "buffer_nodes": nodes,
             "buffer_edges": edges,
@@ -3031,6 +3051,9 @@ Be clinical yet insightful. Do not include conversational filler."""
                 "p95_retrieval_ms": f"{p95_retrieval:.1f}",
                 "avg_consolidation_s": f"{avg_consolidation:.2f}",
                 "cache_hit_rate": f"{cache_hit_rate:.1%}",
+                "semantic_cache_hit_rate": (f"{sem_rate:.1%}"
+                                            if sem_rate is not None
+                                            else None),
                 "llm_calls": self.metrics["llm_calls"],
                 "embedding_calls": self.metrics["embedding_calls"],
             },
@@ -3046,6 +3069,16 @@ Be clinical yet insightful. Do not include conversational filler."""
                                     if hasattr(self.embedder, "health") else None),
             },
         }
+
+    def _semantic_hit_rate(self) -> Optional[float]:
+        """Semantic-cache hit rate over every dispatch that carried the
+        ring (None while the cache is off or untouched)."""
+        tel = self.telemetry
+        hits = tel.counter_total("serve.semantic_hits")
+        misses = tel.counter_total("serve.semantic_misses")
+        if hits + misses == 0:
+            return None
+        return hits / (hits + misses)
 
     def metrics_summary(self) -> Dict:
         """One JSON-able observability surface (ISSUE 6): the Telemetry
@@ -3083,6 +3116,16 @@ Be clinical yet insightful. Do not include conversational filler."""
                                   else None),
             "serve_dispatches": tel.counter_total("serve.dispatches"),
             "ingest_dispatches": tel.counter_total("ingest.dispatches"),
+            # ISSUE 20: both cache tiers' headline hit rates — "exact"
+            # is the text-keyed QueryCache, "semantic" the device ring
+            # (None until a ring dispatch ran)
+            "cache_hit_rate": {
+                "exact": (self.query_cache.get_hit_rate()
+                          if self.query_cache else 0.0),
+                "semantic": self._semantic_hit_rate(),
+            },
+            "semantic_stale_evictions": tel.counter_total(
+                "serve.semantic_stale_evictions"),
             # ISSUE 16 satellite: rows the non-fused write surface spilled
             # into the exact-scan extras (pod add()) — the residual write
             # path's burden on the coarse structure, as a headline number.

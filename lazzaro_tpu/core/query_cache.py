@@ -18,6 +18,14 @@ def _key(text: str) -> str:
     return hashlib.blake2b(text.encode("utf-8"), digest_size=16).hexdigest()
 
 
+def _result_key(query: str, tenant: Optional[str]) -> str:
+    """Result keys fold the tenant in (NUL never appears in tenant ids,
+    so the pair can't collide with a crafted query): two tenants asking
+    the SAME question must never see each other's node ids. Embedding
+    keys stay text-only — an embedding is tenant-free."""
+    return _key(query if tenant is None else f"{tenant}\x00{query}")
+
+
 class QueryCache:
     def __init__(self, max_size: int = 1000):
         self.max_size = max_size
@@ -50,8 +58,9 @@ class QueryCache:
                 self._embeddings.popitem(last=False)
 
     # -- retrieval results --------------------------------------------------
-    def get_results(self, query: str) -> Optional[List[str]]:
-        k = _key(query)
+    def get_results(self, query: str,
+                    tenant: Optional[str] = None) -> Optional[List[str]]:
+        k = _result_key(query, tenant)
         with self._lock:
             if k in self._results:
                 self._results.move_to_end(k)
@@ -62,7 +71,7 @@ class QueryCache:
 
     def set_results(self, query: str, results: List[str],
                     tenant: Optional[str] = None) -> None:
-        k = _key(query)
+        k = _result_key(query, tenant)
         with self._lock:
             self._results[k] = results
             self._results.move_to_end(k)

@@ -148,6 +148,76 @@ TOPK_BLOCK = 4096
 PALLAS_TOPK_MIN_ROWS = 262_144
 
 
+@struct.dataclass
+class SemanticRing:
+    """Device-resident semantic query cache (ISSUE 20): a small ring of
+    recent query embeddings + their packed top-k serving results, probed
+    as an extra candidate group inside every fused serving kernel. Row
+    ``R`` (the last) is a scratch sentinel — ring writes that must be
+    dropped scatter there, the probe never reads it (same trick as the
+    arena's sentinel row).
+
+    Validity and the rotation head are HOST-owned and ride each dispatch
+    as sidecar inputs: invalidation (a lifecycle/dedup write touching a
+    cached entry's rows, or a tenant-scoped flush) is a host bitmask
+    flip, never a device dispatch. An entry is usable for a query only
+    when tenant / gate flag / serving mode match, ``stored_k`` covers
+    the query's k, the nprobe matches (IVF/PQ), and the stored
+    embedding's cosine clears the threshold."""
+
+    emb: jax.Array       # [R+1, d] f32 normalized query embeddings
+    tenant: jax.Array    # [R+1] i32 owning tenant
+    gate_on: jax.Array   # [R+1] bool gate flag the entry was served under
+    mode: jax.Array      # [R+1] i32 serving-mode id (SEM_MODE_IDS)
+    stored_k: jax.Array  # [R+1] i32 result depth the entry can serve
+    nprobe: jax.Array    # [R+1] i32 probe width (0 for dense modes)
+    gate_s: jax.Array    # [R+1] f32 cached gate score
+    gate_r: jax.Array    # [R+1] i32 cached gate row
+    ann_s: jax.Array     # [R+1, K] f32 cached top-k scores (desc, NEG_INF pad)
+    ann_r: jax.Array     # [R+1, K] i32 cached top-k rows (sentinel pad)
+
+    @property
+    def slots(self) -> int:
+        return self.tenant.shape[0] - 1
+
+    @property
+    def width(self) -> int:
+        return self.ann_s.shape[1]
+
+
+# Serving-mode ids for the ring's mode column: a cached entry only serves
+# queries dispatched through the SAME kernel family (scores are not
+# comparable across coarse stages, and the tiered window width differs).
+SEM_MODE_IDS = {
+    "exact": 0, "quant": 1, "ivf": 2, "ivf_quant": 3, "pq": 4,
+    "tiered": 5, "ivf_tiered": 6, "pq_tiered": 7,
+}
+
+
+def init_semantic_ring(slots: int, dim: int, width: int,
+                       row_sentinel: int = 0) -> SemanticRing:
+    """Fresh (all-invalid, from the host's view) ring. ``width`` must
+    cover the widest candidate window any serving kernel packs (k, or
+    k+slack for the tiered families); ``row_sentinel`` pre-fills the row
+    columns with the arena sentinel so a never-written slot can't alias
+    row 0 even if misused."""
+    if slots < 1:
+        raise ValueError("semantic ring needs at least one slot")
+    n = slots + 1
+    return SemanticRing(
+        emb=jnp.zeros((n, dim), jnp.float32),
+        tenant=jnp.full((n,), -1, jnp.int32),
+        gate_on=jnp.zeros((n,), bool),
+        mode=jnp.full((n,), -1, jnp.int32),
+        stored_k=jnp.zeros((n,), jnp.int32),
+        nprobe=jnp.zeros((n,), jnp.int32),
+        gate_s=jnp.full((n,), NEG_INF, jnp.float32),
+        gate_r=jnp.full((n,), row_sentinel, jnp.int32),
+        ann_s=jnp.full((n, width), NEG_INF, jnp.float32),
+        ann_r=jnp.full((n, width), row_sentinel, jnp.int32),
+    )
+
+
 def init_arena(capacity: int, dim: int, dtype=jnp.float32) -> ArenaState:
     n = capacity + 1
     return ArenaState(
@@ -2174,12 +2244,225 @@ def _exact_two_tier(state: ArenaState, q_c: jax.Array, tenant_c: jax.Array,
     return jax.lax.optimization_barrier((gate_s, gate_r, ann_s, ann_r))
 
 
+# ---------------------------------------------------------------------------
+# Semantic query cache (ISSUE 20): a SemanticRing probe riding INSIDE every
+# fused serving kernel. The per-dispatch flow, all in the one program:
+#
+#   probe     — top-1 cosine of each (normalized) query against the ring,
+#               masked by tenant / gate flag / mode / stored_k / nprobe and
+#               the HOST-owned valid bits; >= threshold is a hit.
+#   early-out — queries are stably sorted misses-first, and the family's
+#               chunk function runs under a ``lax.while_loop`` over fixed
+#               ``sem_block``-sized blocks with a DYNAMIC trip count of
+#               ceil(n_miss / block): blocks past the miss prefix never
+#               execute, so an 80%-hit batch pays ~20% of the scan FLOPs
+#               while shapes stay static and the dispatch count stays ONE.
+#   subst     — hit queries' gate/ann columns come from the cached entry
+#               (re-masked at the query's own ragged k; the gate VERDICT is
+#               recomputed against the current threshold); their boost rows
+#               stay at the scatter sentinel — semantic hits defer boosts to
+#               the host exactly like exact-cache hits.
+#   writeback — the last R misses rotate into slots (head + rank) % R in
+#               the same dispatch (LIFO, like the paged arena's free stack);
+#               dropped writes scatter to the ring's scratch row.
+#
+# The sorted order is stable, so rank j IS the j-th miss in batch order —
+# the host mirrors head/slot assignment from the readback's sem column
+# alone, and ships the valid bits + head back in on the next dispatch.
+# With the cache disabled (``sem=None``) nothing here traces; with the
+# cache cold the sort is the identity permutation and every block runs, so
+# results stay bit-identical to the cache-off program.
+# ---------------------------------------------------------------------------
+
+
+def _semantic_probe(ring: SemanticRing, sem_valid: jax.Array, qn: jax.Array,
+                    tenant_q: jax.Array, q_valid: jax.Array,
+                    gate_on_q: jax.Array, k_need: jax.Array,
+                    npr_need: jax.Array, mode_id: jax.Array,
+                    thresh: jax.Array):
+    """Top-1 cosine probe of the ring. Returns (hit [Q] bool, slot [Q]
+    i32). ``sem_valid`` is the host-owned [R] validity mask; an entry is
+    eligible only when tenant, gate flag, mode, and nprobe match and its
+    stored depth covers the query's k."""
+    r = ring.slots
+    sims = nt_dot(qn, ring.emb[:r])                        # [Q, R]
+    ok = (sem_valid[:r] & (ring.stored_k[:r] > 0))[None, :]
+    ok = ok & (ring.mode[:r][None, :] == mode_id)
+    ok = ok & (ring.tenant[:r][None, :] == tenant_q[:, None])
+    ok = ok & (ring.gate_on[:r][None, :] == gate_on_q[:, None])
+    ok = ok & (ring.stored_k[:r][None, :] >= k_need[:, None])
+    ok = ok & (ring.nprobe[:r][None, :] == npr_need[:, None])
+    s = jnp.where(ok, sims, NEG_INF)
+    slot = jnp.argmax(s, axis=1).astype(jnp.int32)
+    hit = q_valid & (jnp.max(s, axis=1) >= thresh)
+    return hit, slot
+
+
+def _semantic_blocked(chunk_fn, arrays, n_miss: jax.Array, block: int,
+                      capacity: int):
+    """Run ``chunk_fn`` (any family's per-chunk closure) over the sorted
+    batch in static ``block``-sized pieces with a dynamic trip count —
+    only ceil(n_miss / block) blocks execute. Skipped queries keep safe
+    fillers that mirror a fully-masked scan: NEG_INF scores, sentinel
+    rows, False flags (the boost scatter's sentinel routing and decode's
+    live counters treat them exactly like masked pad queries)."""
+    b = arrays[0].shape[0]
+    block = max(1, min(int(block), b))
+    pad = (-b) % block
+    if pad:
+        arrays = tuple(
+            jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+            for a in arrays)
+    shapes = jax.eval_shape(chunk_fn, *tuple(a[:block] for a in arrays))
+
+    def _fill(sd):
+        shape = (b + pad,) + tuple(sd.shape[1:])
+        if sd.dtype == jnp.bool_:
+            return jnp.zeros(shape, jnp.bool_)
+        if jnp.issubdtype(sd.dtype, jnp.floating):
+            return jnp.full(shape, NEG_INF, sd.dtype)
+        return jnp.full(shape, capacity, sd.dtype)
+
+    outs0 = tuple(_fill(s) for s in shapes)
+    n_run = (n_miss.astype(jnp.int32) + block - 1) // block
+
+    def cond(carry):
+        return carry[0] < n_run
+
+    def body(carry):
+        i, outs = carry[0], carry[1:]
+        start = i * block
+        sub = tuple(jax.lax.dynamic_slice_in_dim(a, start, block, 0)
+                    for a in arrays)
+        res = chunk_fn(*sub)
+        outs = tuple(
+            jax.lax.dynamic_update_slice_in_dim(o, r, start, 0)
+            for o, r in zip(outs, res))
+        return (i + 1,) + outs
+
+    out = jax.lax.while_loop(cond, body,
+                             (jnp.zeros((), jnp.int32),) + outs0)[1:]
+    return tuple(o[:b] for o in out)
+
+
+def _semantic_substitute(ring: SemanticRing, hit: jax.Array, slot: jax.Array,
+                         gate_on_q: jax.Array, super_gate: jax.Array, outs,
+                         k_q, rag_slack: int, capacity: int):
+    """Splice cached results over the hit queries' (filler) scan outputs.
+    The cached list is sliced to this kernel's static window and re-masked
+    at the query's own ragged k (+slack for the tiered window); the gate
+    verdict is recomputed against the CURRENT threshold so a runtime
+    super-gate change can't serve a stale verdict."""
+    gate_s, gate_r, ann_s, ann_r, fast = outs[:5]
+    w = ann_s.shape[1]
+    if ring.width < w:
+        raise ValueError(
+            f"semantic ring width {ring.width} < kernel window {w}; size "
+            "the ring at the serving k ceiling (+slack for tiered modes)")
+    c_gs = ring.gate_s[slot]
+    c_gr = ring.gate_r[slot]
+    c_as = ring.ann_s[slot, :w]
+    c_ar = ring.ann_r[slot, :w]
+    if k_q is not None:
+        kf = jnp.minimum(k_q + rag_slack, w) if rag_slack else k_q
+        c_as, c_ar = _ragged_topk_mask(c_as, c_ar, kf, capacity)
+    c_fast = gate_on_q & (c_gs > super_gate)
+    h1 = hit[:, None]
+    return (jnp.where(hit, c_gs, gate_s),
+            jnp.where(hit, c_gr, gate_r),
+            jnp.where(h1, c_as, ann_s),
+            jnp.where(h1, c_ar, ann_r),
+            jnp.where(hit, c_fast, fast)) + tuple(outs[5:])
+
+
+def _semantic_writeback(ring: SemanticRing, head: jax.Array, qn: jax.Array,
+                        tenant_q: jax.Array, gate_on_q: jax.Array,
+                        gate_s: jax.Array, gate_r: jax.Array,
+                        ann_s: jax.Array, ann_r: jax.Array, rank: jax.Array,
+                        write_mask: jax.Array, k_need: jax.Array,
+                        npr_need: jax.Array, mode_id: jax.Array,
+                        capacity: int) -> SemanticRing:
+    """LIFO slot rotation inside the dispatch: miss ``rank`` lands in slot
+    ``(head + rank) % R``; suppressed writes scatter to the scratch row.
+    Callers pass rank in BATCH order among misses (the stable sort
+    preserves it), so the host can mirror the slot assignment from the
+    readback alone."""
+    r = ring.slots
+    slot_w = jnp.where(write_mask,
+                       jnp.mod(head + rank, r), r).astype(jnp.int32)
+    w = ann_s.shape[1]
+    if w < ring.width:
+        ann_s = jnp.pad(ann_s, ((0, 0), (0, ring.width - w)),
+                        constant_values=NEG_INF)
+        ann_r = jnp.pad(ann_r, ((0, 0), (0, ring.width - w)),
+                        constant_values=capacity)
+    b = qn.shape[0]
+    return ring.replace(
+        emb=ring.emb.at[slot_w].set(qn),
+        tenant=ring.tenant.at[slot_w].set(tenant_q.astype(jnp.int32)),
+        gate_on=ring.gate_on.at[slot_w].set(gate_on_q),
+        mode=ring.mode.at[slot_w].set(
+            jnp.broadcast_to(mode_id, (b,)).astype(jnp.int32)),
+        stored_k=ring.stored_k.at[slot_w].set(k_need.astype(jnp.int32)),
+        nprobe=ring.nprobe.at[slot_w].set(npr_need.astype(jnp.int32)),
+        gate_s=ring.gate_s.at[slot_w].set(gate_s),
+        gate_r=ring.gate_r.at[slot_w].set(gate_r.astype(jnp.int32)),
+        ann_s=ring.ann_s.at[slot_w].set(ann_s),
+        ann_r=ring.ann_r.at[slot_w].set(ann_r.astype(jnp.int32)))
+
+
+def _semantic_scan_core(chunk_fn, arrays, state: ArenaState, sem,
+                        super_gate: jax.Array, *, k: int, block: int,
+                        rag_slack: int = 0, nprobe_val: int = 0):
+    """The full in-dispatch semantic-cache flow around one family's chunk
+    closure: probe → miss-first stable sort → blocked early-out scan →
+    unsort → substitution → ring writeback. ``arrays`` is the family's
+    per-query tuple ``(q, q_valid, tenant, gate_on, boost_on[, k_q,
+    cap_q[, nprobe_q]])``; returns the family's output tuple (dup counter
+    zeroed for skipped queries) + ``(sem_col, new_ring)`` where sem_col
+    is ``1 + slot`` for hits and 0 for misses."""
+    ring, sem_valid, head, thresh, mode_id = sem
+    q, q_valid, tenant, gate_on = arrays[0], arrays[1], arrays[2], arrays[3]
+    nq = q.shape[0]
+    k_q = arrays[5] if len(arrays) > 5 else None
+    npr_q = arrays[7] if len(arrays) > 7 else None
+    qn = normalize(q).astype(jnp.float32)
+    k_need = k_q if k_q is not None else jnp.full((nq,), k, jnp.int32)
+    npr_need = (npr_q if npr_q is not None
+                else jnp.full((nq,), nprobe_val, jnp.int32))
+    hit, slot = _semantic_probe(ring, sem_valid, qn, tenant, q_valid,
+                                gate_on, k_need, npr_need, mode_id, thresh)
+    miss = q_valid & ~hit
+    order = jnp.argsort((~miss).astype(jnp.int32), stable=True)
+    inv = jnp.argsort(order)
+    n_miss = miss.sum().astype(jnp.int32)
+    sorted_arrays = tuple(a[order] for a in arrays)
+    outs_s = _semantic_blocked(chunk_fn, sorted_arrays, n_miss, block,
+                               state.capacity)
+    rank = jnp.arange(nq, dtype=jnp.int32)
+    write_mask = miss[order] & (rank >= n_miss - ring.slots)
+    ring2 = _semantic_writeback(
+        ring, head, qn[order], sorted_arrays[2], sorted_arrays[3],
+        outs_s[0], outs_s[1], outs_s[2], outs_s[3], rank, write_mask,
+        k_need[order], npr_need[order], mode_id, state.capacity)
+    outs = tuple(o[inv] for o in outs_s)
+    outs = _semantic_substitute(ring, hit, slot, gate_on, super_gate, outs,
+                                k_q, rag_slack, state.capacity)
+    if len(outs) > 7:
+        # trailing dup counter (IVF/PQ): skipped queries carried the int
+        # filler — a hit or pad query suppressed zero duplicates
+        outs = outs[:7] + (jnp.where(miss, outs[7], 0),) + tuple(outs[8:])
+    sem_col = jnp.where(hit, 1 + slot, 0).astype(jnp.int32)
+    return tuple(outs) + (sem_col, ring2)
+
+
 def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
                        csr_nbr: jax.Array, q: jax.Array, q_valid: jax.Array,
                        tenant: jax.Array, gate_on: jax.Array,
                        boost_on: jax.Array, super_gate: jax.Array,
                        k: int, cap_take: int, max_nbr: int,
-                       k_q=None, cap_q=None, scan_chunk: int = 0):
+                       k_q=None, cap_q=None, scan_chunk: int = 0,
+                       sem=None, sem_block: int = 16):
     """Per-chunk compute phase: the exact two-tier top-k core, the
     device-side gate verdict, and the CSR neighbor gather with per-query
     dedup. Returns sentinel-padded row lists for the scatter phase
@@ -2217,8 +2500,11 @@ def _search_fused_scan(state: ArenaState, csr_indptr: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q)
-    return chunked_map_multi(chunk, arrays,
-                             chunk=(scan_chunk or QUERY_CHUNK))
+    if sem is None:
+        return chunked_map_multi(chunk, arrays,
+                                 chunk=(scan_chunk or QUERY_CHUNK))
+    return _semantic_scan_core(chunk, arrays, state, sem, super_gate,
+                               k=k, block=sem_block)
 
 
 def _search_fused(
@@ -2237,22 +2523,24 @@ def _search_fused(
     k: int,
     cap_take: int,           # retrieval cap: how many top rows get boosted
     max_nbr: int,
+    sem=None,                # (ring, valid [R], head, thresh, mode_id)
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, Tuple[jax.Array, ...]]:
     """One dispatch for a padded cross-tenant query batch: gate + ANN +
     neighbor gather + both boosts. Scatter counts make a mega-batch exact
     w.r.t. serial classic turns: a row retrieved by two queries gets TWO
     access bumps (``.add``), while within one query each neighbor is
     boosted once (the per-query dedup above) — matching what per-turn
-    ``update_access`` + ``_boost_neighbors`` calls would have done."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
-        _search_fused_scan(state, csr_indptr, csr_nbr, q, q_valid, tenant,
-                           gate_on, boost_on, super_gate, k, cap_take,
-                           max_nbr)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  acc=n_acc, nbr=n_nbr)
+    ``update_access`` + ``_boost_neighbors`` calls would have done.
+
+    ``sem`` threads the semantic query cache through the SAME dispatch
+    (probe / early-out / substitution / ring writeback — see
+    ``_semantic_scan_core``); when present the return gains the updated
+    ring: ``(state, ring, packed)``."""
+    res = _search_fused_scan(state, csr_indptr, csr_nbr, q, q_valid, tenant,
+                             gate_on, boost_on, super_gate, k, cap_take,
+                             max_nbr, sem=sem, sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 def _boost_scatter(state: ArenaState, acc_rows: jax.Array,
@@ -2283,17 +2571,20 @@ def _boost_scatter(state: ArenaState, acc_rows: jax.Array,
 
 # Width of the device-counter tail _pack_retrieval appends to every fused
 # serving readback (ISSUE 6): per query [n_live, n_dedup_dropped,
-# n_acc_boost_rows, n_nbr_boost_rows] as bitcast int32. The marginal cost
-# of device-side observability is these 16 bytes per query riding the ONE
-# readback that already exists — never an extra dispatch or transfer.
-RETRIEVAL_TAIL = 4
+# n_acc_boost_rows, n_nbr_boost_rows, sem] as bitcast int32. The marginal
+# cost of device-side observability is these 20 bytes per query riding the
+# ONE readback that already exists — never an extra dispatch or transfer.
+# ``sem`` (ISSUE 20) is the semantic-cache verdict: 0 for a miss, 1+slot
+# for a ring hit — the host mirrors ring occupancy and the row→slot
+# reverse index from this column alone.
+RETRIEVAL_TAIL = 5
 
 
 def _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=None, acc=None,
-                    nbr=None) -> jax.Array:
+                    nbr=None, sem=None) -> jax.Array:
     """ONE [Q, 3 + 2k + RETRIEVAL_TAIL] f32 readback array: [gate_score,
     gate_row(bitcast), ann_scores..k, ann_rows(bitcast)..k, fast,
-    counters..4]. Packing happens in-kernel so the host pays exactly one
+    counters..5]. Packing happens in-kernel so the host pays exactly one
     device→host transfer and zero extra dispatches (int rows are bitcast,
     not cast — undone with a host-side ``.view(int32)``, same trick as
     ``utils.batching.fetch_packed``).
@@ -2301,9 +2592,10 @@ def _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=None, acc=None,
     The counter tail carries the device-side serving counters: live top-k
     hits (host derives the top-k shortfall against each request's k),
     duplicate candidates the IVF in-kernel dedup suppressed (``dup``;
-    zero for the dense paths), and the access/neighbor boost-scatter row
+    zero for the dense paths), the access/neighbor boost-scatter row
     counts (``acc``/``nbr``; zero for read twins, whose boost masks are
-    all-off)."""
+    all-off), and the semantic-cache verdict (``sem``; zero when the ring
+    is absent)."""
     bc = lambda a: jax.lax.bitcast_convert_type(a.astype(jnp.int32),  # noqa: E731
                                                 jnp.float32)
     q = gate_s.shape[0]
@@ -2312,11 +2604,12 @@ def _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=None, acc=None,
     dup = zeros if dup is None else dup.astype(jnp.int32)
     acc = zeros if acc is None else acc.astype(jnp.int32)
     nbr = zeros if nbr is None else nbr.astype(jnp.int32)
+    sem = zeros if sem is None else sem.astype(jnp.int32)
     return jnp.concatenate([
         gate_s[:, None], bc(gate_r)[:, None], ann_s, bc(ann_r),
         fast.astype(jnp.float32)[:, None],
         bc(n_live)[:, None], bc(dup)[:, None], bc(acc)[:, None],
-        bc(nbr)[:, None]], axis=1)
+        bc(nbr)[:, None], bc(sem)[:, None]], axis=1)
 
 
 def _boost_row_counts(capacity: int, acc_rows: jax.Array,
@@ -2329,24 +2622,69 @@ def _boost_row_counts(capacity: int, acc_rows: jax.Array,
     return acc, nbr
 
 
+def _sem_finish(state: ArenaState, res, sem, now, acc_boost, nbr_boost):
+    """Shared serve-twin tail across every fused serving family: unpack
+    the scan result (which carries ``(sem_col, new_ring)`` extras when the
+    semantic cache rode the dispatch), apply the boost scatter, pack the
+    readback. With the cache on the twin returns ``(state, ring, packed)``
+    — the ring is NOT donated (it is small and the caller swaps it in
+    after the dispatch), the arena donation story is unchanged."""
+    if sem is None:
+        core, sem_col, ring2 = res, None, None
+    else:
+        core, sem_col, ring2 = res[:-2], res[-2], res[-1]
+    gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows = core[:7]
+    n_dup = core[7] if len(core) > 7 else None
+    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
+    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
+                           nbr_boost)
+    packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup,
+                             acc=n_acc, nbr=n_nbr, sem=sem_col)
+    if sem is None:
+        return state, packed
+    return state, ring2, packed
+
+
+def _sem_finish_read(res, sem):
+    """Read-twin tail twin of ``_sem_finish``: no boost scatter, but the
+    ring writeback still lands (read fleets warm the cache too), so with
+    the cache on the read twin returns ``(ring, packed)``."""
+    if sem is None:
+        core, sem_col, ring2 = res, None, None
+    else:
+        core, sem_col, ring2 = res[:-2], res[-2], res[-1]
+    gate_s, gate_r, ann_s, ann_r, fast = core[:5]
+    n_dup = core[7] if len(core) > 7 else None
+    packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup,
+                             sem=sem_col)
+    if sem is None:
+        return packed
+    return ring2, packed
+
+
 search_fused, search_fused_copy = _donated_pair(
-    _search_fused, static_argnames=("k", "cap_take", "max_nbr"))
+    _search_fused, static_argnames=("k", "cap_take", "max_nbr",
+                                    "sem_block"))
 
 
-@functools.partial(jax.jit, static_argnames=("k", "cap_take", "max_nbr"))
+@functools.partial(jax.jit, static_argnames=("k", "cap_take", "max_nbr",
+                                             "sem_block"))
 def search_fused_read(state: ArenaState, csr_indptr: jax.Array,
                       csr_nbr: jax.Array, q: jax.Array, q_valid: jax.Array,
                       tenant: jax.Array, gate_on: jax.Array,
                       super_gate: jax.Array, k: int, cap_take: int,
-                      max_nbr: int) -> jax.Array:
+                      max_nbr: int, sem=None,
+                      sem_block: int = 16) -> jax.Array:
     """Read-only twin of ``search_fused`` for batches where NO query wants
     boosts (pure ``search_memories`` fleets): same compute, no state
-    mutation, so the ownership/donation dance is skipped entirely."""
+    mutation, so the ownership/donation dance is skipped entirely. With
+    ``sem`` the semantic ring still rides (misses write back — read
+    fleets warm the cache) and the return becomes ``(ring, packed)``."""
     boost_off = jnp.zeros(q_valid.shape, bool)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_scan(
+    res = _search_fused_scan(
         state, csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
-        super_gate, k, cap_take, max_nbr)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+        super_gate, k, cap_take, max_nbr, sem=sem, sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 # ---------------------------------------------------------------------------
@@ -2427,7 +2765,8 @@ def _search_fused_quant_scan(state: ArenaState, q8a: jax.Array,
                              gate_on: jax.Array, boost_on: jax.Array,
                              super_gate: jax.Array, k: int, slack: int,
                              cap_take: int, max_nbr: int,
-                             k_q=None, cap_q=None, scan_chunk: int = 0):
+                             k_q=None, cap_q=None, scan_chunk: int = 0,
+                             sem=None, sem_block: int = 16):
     """Quantized per-chunk compute phase: the int8 coarse-scan + exact
     rescore core, then the shared gate/CSR/boost tail. ``k_q``/``cap_q``
     make it ragged (see ``_search_fused_scan``): the coarse fetch and the
@@ -2454,8 +2793,11 @@ def _search_fused_quant_scan(state: ArenaState, q8a: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q)
-    return chunked_map_multi(chunk, arrays,
-                             chunk=(scan_chunk or QUERY_CHUNK))
+    if sem is None:
+        return chunked_map_multi(chunk, arrays,
+                                 chunk=(scan_chunk or QUERY_CHUNK))
+    return _semantic_scan_core(chunk, arrays, state, sem, super_gate,
+                               k=k, block=sem_block)
 
 
 def _search_fused_quant(
@@ -2477,45 +2819,45 @@ def _search_fused_quant(
     slack: int,
     cap_take: int,
     max_nbr: int,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused`` with the int8 coarse scan + exact rescore stage:
     one donated dispatch + one packed readback per coalesced batch, int8
     mode included. Only the arena state is donated — the shadow is a
     long-lived read-only replica (boost scatters touch salience/access/
     freshness, never the embeddings, so the codes stay valid)."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
-        _search_fused_quant_scan(state, q8a, scale_a, csr_indptr, csr_nbr,
-                                 q, q_valid, tenant, gate_on, boost_on,
-                                 super_gate, k, slack, cap_take, max_nbr)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  acc=n_acc, nbr=n_nbr)
+    res = _search_fused_quant_scan(state, q8a, scale_a, csr_indptr, csr_nbr,
+                                   q, q_valid, tenant, gate_on, boost_on,
+                                   super_gate, k, slack, cap_take, max_nbr,
+                                   sem=sem, sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_quant, search_fused_quant_copy = _donated_pair(
     _search_fused_quant, static_argnames=("k", "slack", "cap_take",
-                                          "max_nbr"))
+                                          "max_nbr", "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
-                                             "max_nbr"))
+                                             "max_nbr", "sem_block"))
 def search_fused_quant_read(state: ArenaState, q8a: jax.Array,
                             scale_a: jax.Array, csr_indptr: jax.Array,
                             csr_nbr: jax.Array, q: jax.Array,
                             q_valid: jax.Array, tenant: jax.Array,
                             gate_on: jax.Array, super_gate: jax.Array,
                             k: int, slack: int, cap_take: int,
-                            max_nbr: int) -> jax.Array:
+                            max_nbr: int, sem=None,
+                            sem_block: int = 16) -> jax.Array:
     """Read-only twin of ``search_fused_quant`` (pure ``search_memories``
     fleets in int8 mode): same coarse-scan + exact-rescore compute, no
     state mutation, no donation dance."""
     boost_off = jnp.zeros(q_valid.shape, bool)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_quant_scan(
+    res = _search_fused_quant_scan(
         state, q8a, scale_a, csr_indptr, csr_nbr, q, q_valid, tenant,
-        gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+        gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr,
+        sem=sem, sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 # ---------------------------------------------------------------------------
@@ -2700,7 +3042,8 @@ def _search_fused_tiered_scan(state: ArenaState, q8a: jax.Array,
                               boost_on: jax.Array, super_gate: jax.Array,
                               k: int, slack: int, cap_take: int,
                               max_nbr: int, k_q=None, cap_q=None,
-                              scan_chunk: int = 0):
+                              scan_chunk: int = 0,
+                              sem=None, sem_block: int = 16):
     """Tiered per-chunk compute phase: the tier-aware two-stage core, then
     the shared gate/CSR/boost tail with cold-hit queries' boosts DEFERRED
     (suppressed exactly like the gate fast path — the host applies them in
@@ -2729,8 +3072,13 @@ def _search_fused_tiered_scan(state: ArenaState, q8a: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q)
-    return chunked_map_multi(chunk, arrays,
-                             chunk=(scan_chunk or QUERY_CHUNK))
+    if sem is None:
+        return chunked_map_multi(chunk, arrays,
+                                 chunk=(scan_chunk or QUERY_CHUNK))
+    # the tiered candidate window is k+slack wide and the ragged boundary
+    # masks at k_i + slack — the substitution must re-mask the same way
+    return _semantic_scan_core(chunk, arrays, state, sem, super_gate,
+                               k=k, block=sem_block, rag_slack=slack)
 
 
 def _search_fused_tiered(
@@ -2753,43 +3101,43 @@ def _search_fused_tiered(
     slack: int,
     cap_take: int,
     max_nbr: int,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused_quant`` with the residency column threaded through:
     ONE donated dispatch + ONE packed readback whose candidate block is
     k+slack wide. Hot-only queries boost in-kernel; cold-hit queries come
     back unboosted with their candidate window for the finish dispatch."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
-        _search_fused_tiered_scan(state, q8a, scale_a, cold, csr_indptr,
-                                  csr_nbr, q, q_valid, tenant, gate_on,
-                                  boost_on, super_gate, k, slack, cap_take,
-                                  max_nbr)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  acc=n_acc, nbr=n_nbr)
+    res = _search_fused_tiered_scan(state, q8a, scale_a, cold, csr_indptr,
+                                    csr_nbr, q, q_valid, tenant, gate_on,
+                                    boost_on, super_gate, k, slack,
+                                    cap_take, max_nbr, sem=sem,
+                                    sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_tiered, search_fused_tiered_copy = _donated_pair(
     _search_fused_tiered, static_argnames=("k", "slack", "cap_take",
-                                           "max_nbr"))
+                                           "max_nbr", "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
-                                             "max_nbr"))
+                                             "max_nbr", "sem_block"))
 def search_fused_tiered_read(state: ArenaState, q8a: jax.Array,
                              scale_a: jax.Array, cold: jax.Array,
                              csr_indptr: jax.Array, csr_nbr: jax.Array,
                              q: jax.Array, q_valid: jax.Array,
                              tenant: jax.Array, gate_on: jax.Array,
                              super_gate: jax.Array, k: int, slack: int,
-                             cap_take: int, max_nbr: int) -> jax.Array:
+                             cap_take: int, max_nbr: int, sem=None,
+                             sem_block: int = 16) -> jax.Array:
     """Read-only tiered twin (pure ``search_memories`` fleets)."""
     boost_off = jnp.zeros(q_valid.shape, bool)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_tiered_scan(
+    res = _search_fused_tiered_scan(
         state, q8a, scale_a, cold, csr_indptr, csr_nbr, q, q_valid, tenant,
-        gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+        gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr,
+        sem=sem, sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 def _search_fused_tiered_ragged(
@@ -2815,29 +3163,29 @@ def _search_fused_tiered_ragged(
     cap_take: int,
     max_nbr: int,
     scan_chunk: int = 0,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """Tiered serving with the (k, cap) sidecar: each query's candidate
     window masks at its own k_i + slack boundary."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
-        _search_fused_tiered_scan(state, q8a, scale_a, cold, csr_indptr,
-                                  csr_nbr, q, q_valid, tenant, gate_on,
-                                  boost_on, super_gate, k, slack, cap_take,
-                                  max_nbr, k_q=k_q, cap_q=cap_q,
-                                  scan_chunk=scan_chunk)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  acc=n_acc, nbr=n_nbr)
+    res = _search_fused_tiered_scan(state, q8a, scale_a, cold, csr_indptr,
+                                    csr_nbr, q, q_valid, tenant, gate_on,
+                                    boost_on, super_gate, k, slack,
+                                    cap_take, max_nbr, k_q=k_q, cap_q=cap_q,
+                                    scan_chunk=scan_chunk, sem=sem,
+                                    sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_tiered_ragged, search_fused_tiered_ragged_copy = _donated_pair(
     _search_fused_tiered_ragged,
-    static_argnames=("k", "slack", "cap_take", "max_nbr", "scan_chunk"))
+    static_argnames=("k", "slack", "cap_take", "max_nbr", "scan_chunk",
+                     "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
-                                             "max_nbr", "scan_chunk"))
+                                             "max_nbr", "scan_chunk",
+                                             "sem_block"))
 def search_fused_tiered_ragged_read(state: ArenaState, q8a: jax.Array,
                                     scale_a: jax.Array, cold: jax.Array,
                                     csr_indptr: jax.Array,
@@ -2847,14 +3195,16 @@ def search_fused_tiered_ragged_read(state: ArenaState, q8a: jax.Array,
                                     super_gate: jax.Array, k: int,
                                     slack: int, cap_take: int,
                                     max_nbr: int,
-                                    scan_chunk: int = 0) -> jax.Array:
+                                    scan_chunk: int = 0, sem=None,
+                                    sem_block: int = 16) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_tiered_scan(
+    res = _search_fused_tiered_scan(
         state, q8a, scale_a, cold, csr_indptr, csr_nbr, q, q_valid, tenant,
         gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr,
-        k_q=k_q, cap_q=cap_q, scan_chunk=scan_chunk)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+        k_q=k_q, cap_q=cap_q, scan_chunk=scan_chunk, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 def _cold_rerank(q: jax.Array, cand_rows: jax.Array, cand_s: jax.Array,
@@ -3097,7 +3447,8 @@ def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
                            boost_on: jax.Array, super_gate: jax.Array,
                            k: int, nprobe: int, slack: int, cap_take: int,
                            max_nbr: int, k_q=None, cap_q=None,
-                           nprobe_q=None, scan_chunk: int = 0):
+                           nprobe_q=None, scan_chunk: int = 0,
+                           sem=None, sem_block: int = 16):
     """IVF per-chunk compute phase: the coarse-prefilter two-tier core,
     then the shared gate/CSR/boost tail. ``k_q``/``cap_q``/``nprobe_q``
     make it ragged: the gather and candidate scan run to the static
@@ -3125,9 +3476,12 @@ def _search_fused_ivf_scan(state: ArenaState, shadow, centroids: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q, nprobe_q)
-    return chunked_map_multi(body, arrays,
-                             chunk=min(scan_chunk or IVF_SERVE_CHUNK,
-                                       IVF_SERVE_CHUNK))
+    if sem is None:
+        return chunked_map_multi(body, arrays,
+                                 chunk=min(scan_chunk or IVF_SERVE_CHUNK,
+                                           IVF_SERVE_CHUNK))
+    return _semantic_scan_core(body, arrays, state, sem, super_gate,
+                               k=k, block=sem_block, nprobe_val=nprobe)
 
 
 def _search_fused_ivf(
@@ -3152,6 +3506,8 @@ def _search_fused_ivf(
     slack: int,
     cap_take: int,
     max_nbr: int,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused`` with the IVF centroid prefilter + member gather as
     the coarse stage: ONE donated dispatch + ONE packed readback per
@@ -3159,42 +3515,40 @@ def _search_fused_ivf(
     centroid/member/extras tables and the optional int8 shadow are
     long-lived read-only replicas (the boost scatter touches salience/
     access/freshness, never embeddings or routing)."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
-        _search_fused_ivf_scan(state, shadow, centroids, members, extras,
-                               csr_indptr, csr_nbr, q, q_valid, tenant,
-                               gate_on, boost_on, super_gate, k, nprobe,
-                               slack, cap_take, max_nbr)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+    res = _search_fused_ivf_scan(state, shadow, centroids, members, extras,
+                                 csr_indptr, csr_nbr, q, q_valid, tenant,
+                                 gate_on, boost_on, super_gate, k, nprobe,
+                                 slack, cap_take, max_nbr, sem=sem,
+                                 sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_ivf, search_fused_ivf_copy = _donated_pair(
     _search_fused_ivf, static_argnames=("k", "nprobe", "slack", "cap_take",
-                                        "max_nbr"))
+                                        "max_nbr", "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
-                                             "cap_take", "max_nbr"))
+                                             "cap_take", "max_nbr",
+                                             "sem_block"))
 def search_fused_ivf_read(state: ArenaState, shadow, centroids: jax.Array,
                           members: jax.Array, extras: jax.Array,
                           csr_indptr: jax.Array, csr_nbr: jax.Array,
                           q: jax.Array, q_valid: jax.Array,
                           tenant: jax.Array, gate_on: jax.Array,
                           super_gate: jax.Array, k: int, nprobe: int,
-                          slack: int, cap_take: int, max_nbr: int
+                          slack: int, cap_take: int, max_nbr: int,
+                          sem=None, sem_block: int = 16
                           ) -> jax.Array:
     """Read-only twin of ``search_fused_ivf`` (pure ``search_memories``
     fleets in IVF mode): same coarse prefilter + candidate scan, no state
     mutation, no donation dance."""
     boost_off = jnp.zeros(q_valid.shape, bool)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = _search_fused_ivf_scan(
+    res = _search_fused_ivf_scan(
         state, shadow, centroids, members, extras, csr_indptr, csr_nbr, q,
         q_valid, tenant, gate_on, boost_off, super_gate, k, nprobe, slack,
-        cap_take, max_nbr)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+        cap_take, max_nbr, sem=sem, sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 # ---------------------------------------------------------------------------
@@ -3235,44 +3589,42 @@ def _search_fused_ragged(
     cap_take: int,           # STATIC cap ceiling
     max_nbr: int,
     scan_chunk: int = 0,     # planner streaming-width override (ISSUE 11)
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused`` with the per-query (k, cap) sidecar: ONE donated
     dispatch + ONE packed readback for a mixed-shape batch."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
-        _search_fused_scan(state, csr_indptr, csr_nbr, q, q_valid, tenant,
-                           gate_on, boost_on, super_gate, k, cap_take,
-                           max_nbr, k_q=k_q, cap_q=cap_q,
-                           scan_chunk=scan_chunk)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  acc=n_acc, nbr=n_nbr)
+    res = _search_fused_scan(state, csr_indptr, csr_nbr, q, q_valid, tenant,
+                             gate_on, boost_on, super_gate, k, cap_take,
+                             max_nbr, k_q=k_q, cap_q=cap_q,
+                             scan_chunk=scan_chunk, sem=sem,
+                             sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_ragged, search_fused_ragged_copy = _donated_pair(
     _search_fused_ragged, static_argnames=("k", "cap_take", "max_nbr",
-                                           "scan_chunk"))
+                                           "scan_chunk", "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "cap_take", "max_nbr",
-                                             "scan_chunk"))
+                                             "scan_chunk", "sem_block"))
 def search_fused_ragged_read(state: ArenaState, csr_indptr: jax.Array,
                              csr_nbr: jax.Array, q: jax.Array,
                              q_valid: jax.Array, tenant: jax.Array,
                              gate_on: jax.Array, k_q: jax.Array,
                              super_gate: jax.Array, k: int, cap_take: int,
-                             max_nbr: int,
-                             scan_chunk: int = 0) -> jax.Array:
+                             max_nbr: int, scan_chunk: int = 0,
+                             sem=None, sem_block: int = 16) -> jax.Array:
     """Read-only ragged twin (pure ``search_memories`` fleets): per-query
     k as data, no state mutation."""
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_scan(
+    res = _search_fused_scan(
         state, csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
         super_gate, k, cap_take, max_nbr, k_q=k_q, cap_q=cap_q,
-        scan_chunk=scan_chunk)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+        scan_chunk=scan_chunk, sem=sem, sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 def _search_fused_quant_ragged(
@@ -3297,29 +3649,29 @@ def _search_fused_quant_ragged(
     cap_take: int,
     max_nbr: int,
     scan_chunk: int = 0,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused_quant`` with the (k, cap) sidecar: the int8 coarse
     fetch and exact rescore run to the k ceiling, the boundary is data."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows) = \
-        _search_fused_quant_scan(state, q8a, scale_a, csr_indptr, csr_nbr,
-                                 q, q_valid, tenant, gate_on, boost_on,
-                                 super_gate, k, slack, cap_take, max_nbr,
-                                 k_q=k_q, cap_q=cap_q,
-                                 scan_chunk=scan_chunk)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  acc=n_acc, nbr=n_nbr)
+    res = _search_fused_quant_scan(state, q8a, scale_a, csr_indptr, csr_nbr,
+                                   q, q_valid, tenant, gate_on, boost_on,
+                                   super_gate, k, slack, cap_take, max_nbr,
+                                   k_q=k_q, cap_q=cap_q,
+                                   scan_chunk=scan_chunk, sem=sem,
+                                   sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_quant_ragged, search_fused_quant_ragged_copy = _donated_pair(
     _search_fused_quant_ragged,
-    static_argnames=("k", "slack", "cap_take", "max_nbr", "scan_chunk"))
+    static_argnames=("k", "slack", "cap_take", "max_nbr", "scan_chunk",
+                     "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "slack", "cap_take",
-                                             "max_nbr", "scan_chunk"))
+                                             "max_nbr", "scan_chunk",
+                                             "sem_block"))
 def search_fused_quant_ragged_read(state: ArenaState, q8a: jax.Array,
                                    scale_a: jax.Array,
                                    csr_indptr: jax.Array,
@@ -3328,15 +3680,17 @@ def search_fused_quant_ragged_read(state: ArenaState, q8a: jax.Array,
                                    gate_on: jax.Array, k_q: jax.Array,
                                    super_gate: jax.Array, k: int,
                                    slack: int, cap_take: int,
-                                   max_nbr: int,
-                                   scan_chunk: int = 0) -> jax.Array:
+                                   max_nbr: int, scan_chunk: int = 0,
+                                   sem=None,
+                                   sem_block: int = 16) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _ = _search_fused_quant_scan(
+    res = _search_fused_quant_scan(
         state, q8a, scale_a, csr_indptr, csr_nbr, q, q_valid, tenant,
         gate_on, boost_off, super_gate, k, slack, cap_take, max_nbr,
-        k_q=k_q, cap_q=cap_q, scan_chunk=scan_chunk)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast)
+        k_q=k_q, cap_q=cap_q, scan_chunk=scan_chunk, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 def _search_fused_ivf_ragged(
@@ -3365,33 +3719,31 @@ def _search_fused_ivf_ragged(
     cap_take: int,
     max_nbr: int,
     scan_chunk: int = 0,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused_ivf`` with the (k, cap, nprobe) sidecar: the member
     gather visits the ceiling probe width, each query masks candidates
     past its own — recall/latency per request, one kernel."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
-        _search_fused_ivf_scan(state, shadow, centroids, members, extras,
-                               csr_indptr, csr_nbr, q, q_valid, tenant,
-                               gate_on, boost_on, super_gate, k, nprobe,
-                               slack, cap_take, max_nbr, k_q=k_q,
-                               cap_q=cap_q, nprobe_q=nprobe_q,
-                               scan_chunk=scan_chunk)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+    res = _search_fused_ivf_scan(state, shadow, centroids, members, extras,
+                                 csr_indptr, csr_nbr, q, q_valid, tenant,
+                                 gate_on, boost_on, super_gate, k, nprobe,
+                                 slack, cap_take, max_nbr, k_q=k_q,
+                                 cap_q=cap_q, nprobe_q=nprobe_q,
+                                 scan_chunk=scan_chunk, sem=sem,
+                                 sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_ivf_ragged, search_fused_ivf_ragged_copy = _donated_pair(
     _search_fused_ivf_ragged,
     static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr",
-                     "scan_chunk"))
+                     "scan_chunk", "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
                                              "cap_take", "max_nbr",
-                                             "scan_chunk"))
+                                             "scan_chunk", "sem_block"))
 def search_fused_ivf_ragged_read(state: ArenaState, shadow,
                                  centroids: jax.Array, members: jax.Array,
                                  extras: jax.Array, csr_indptr: jax.Array,
@@ -3401,15 +3753,16 @@ def search_fused_ivf_ragged_read(state: ArenaState, shadow,
                                  nprobe_q: jax.Array,
                                  super_gate: jax.Array, k: int, nprobe: int,
                                  slack: int, cap_take: int, max_nbr: int,
-                                 scan_chunk: int = 0) -> jax.Array:
+                                 scan_chunk: int = 0, sem=None,
+                                 sem_block: int = 16) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = _search_fused_ivf_scan(
+    res = _search_fused_ivf_scan(
         state, shadow, centroids, members, extras, csr_indptr, csr_nbr, q,
         q_valid, tenant, gate_on, boost_off, super_gate, k, nprobe, slack,
         cap_take, max_nbr, k_q=k_q, cap_q=cap_q, nprobe_q=nprobe_q,
-        scan_chunk=scan_chunk)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+        scan_chunk=scan_chunk, sem=sem, sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 # ---------------------------------------------------------------------------
@@ -3504,7 +3857,8 @@ def _search_fused_ivf_tiered_scan(state: ArenaState, q8a: jax.Array,
                                   super_gate: jax.Array, k: int,
                                   nprobe: int, slack: int, cap_take: int,
                                   max_nbr: int, k_q=None, cap_q=None,
-                                  nprobe_q=None, scan_chunk: int = 0):
+                                  nprobe_q=None, scan_chunk: int = 0,
+                                  sem=None, sem_block: int = 16):
     """IVF×tiered per-chunk compute: the tier-aware IVF core, then the
     shared gate/CSR/boost tail with cold-hit queries' boosts deferred to
     the bounded finish dispatch — exactly the tiered scan's contract, so
@@ -3532,8 +3886,12 @@ def _search_fused_ivf_tiered_scan(state: ArenaState, q8a: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q, nprobe_q)
-    return chunked_map_multi(chunk, arrays,
-                             chunk=(scan_chunk or IVF_SERVE_CHUNK))
+    if sem is None:
+        return chunked_map_multi(chunk, arrays,
+                                 chunk=(scan_chunk or IVF_SERVE_CHUNK))
+    return _semantic_scan_core(chunk, arrays, state, sem, super_gate,
+                               k=k, block=sem_block, rag_slack=slack,
+                               nprobe_val=nprobe)
 
 
 def _search_fused_ivf_tiered(
@@ -3560,29 +3918,29 @@ def _search_fused_ivf_tiered(
     slack: int,
     cap_take: int,
     max_nbr: int,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """ONE donated dispatch + ONE packed readback: IVF coarse stage for the
     hot tier, cold-masked int8 coarse for the demoted rows, tiered
     candidate window (k+slack wide) for the bounded finish."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
-        _search_fused_ivf_tiered_scan(
-            state, q8a, scale_a, cold, centroids, members, extras,
-            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
-            super_gate, k, nprobe, slack, cap_take, max_nbr)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+    res = _search_fused_ivf_tiered_scan(
+        state, q8a, scale_a, cold, centroids, members, extras,
+        csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
+        super_gate, k, nprobe, slack, cap_take, max_nbr, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_ivf_tiered, search_fused_ivf_tiered_copy = _donated_pair(
     _search_fused_ivf_tiered,
-    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr"))
+    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr",
+                     "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
-                                             "cap_take", "max_nbr"))
+                                             "cap_take", "max_nbr",
+                                             "sem_block"))
 def search_fused_ivf_tiered_read(state: ArenaState, q8a: jax.Array,
                                  scale_a: jax.Array, cold: jax.Array,
                                  centroids: jax.Array, members: jax.Array,
@@ -3591,14 +3949,15 @@ def search_fused_ivf_tiered_read(state: ArenaState, q8a: jax.Array,
                                  q_valid: jax.Array, tenant: jax.Array,
                                  gate_on: jax.Array, super_gate: jax.Array,
                                  k: int, nprobe: int, slack: int,
-                                 cap_take: int, max_nbr: int) -> jax.Array:
+                                 cap_take: int, max_nbr: int,
+                                 sem=None, sem_block: int = 16) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = \
-        _search_fused_ivf_tiered_scan(
-            state, q8a, scale_a, cold, centroids, members, extras,
-            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
-            super_gate, k, nprobe, slack, cap_take, max_nbr)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+    res = _search_fused_ivf_tiered_scan(
+        state, q8a, scale_a, cold, centroids, members, extras,
+        csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+        super_gate, k, nprobe, slack, cap_take, max_nbr, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 def _search_fused_ivf_tiered_ragged(
@@ -3629,30 +3988,28 @@ def _search_fused_ivf_tiered_ragged(
     cap_take: int,
     max_nbr: int,
     scan_chunk: int = 0,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """IVF×tiered serving with the (k, cap, nprobe) sidecar."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
-        _search_fused_ivf_tiered_scan(
-            state, q8a, scale_a, cold, centroids, members, extras,
-            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
-            super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
-            cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+    res = _search_fused_ivf_tiered_scan(
+        state, q8a, scale_a, cold, centroids, members, extras,
+        csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
+        super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
+        cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_ivf_tiered_ragged, search_fused_ivf_tiered_ragged_copy = \
     _donated_pair(_search_fused_ivf_tiered_ragged,
                   static_argnames=("k", "nprobe", "slack", "cap_take",
-                                   "max_nbr", "scan_chunk"))
+                                   "max_nbr", "scan_chunk", "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
                                              "cap_take", "max_nbr",
-                                             "scan_chunk"))
+                                             "scan_chunk", "sem_block"))
 def search_fused_ivf_tiered_ragged_read(
         state: ArenaState, q8a: jax.Array, scale_a: jax.Array,
         cold: jax.Array, centroids: jax.Array, members: jax.Array,
@@ -3660,16 +4017,17 @@ def search_fused_ivf_tiered_ragged_read(
         q: jax.Array, q_valid: jax.Array, tenant: jax.Array,
         gate_on: jax.Array, k_q: jax.Array, nprobe_q: jax.Array,
         super_gate: jax.Array, k: int, nprobe: int, slack: int,
-        cap_take: int, max_nbr: int, scan_chunk: int = 0) -> jax.Array:
+        cap_take: int, max_nbr: int, scan_chunk: int = 0,
+        sem=None, sem_block: int = 16) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = \
-        _search_fused_ivf_tiered_scan(
-            state, q8a, scale_a, cold, centroids, members, extras,
-            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
-            super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
-            cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+    res = _search_fused_ivf_tiered_scan(
+        state, q8a, scale_a, cold, centroids, members, extras,
+        csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+        super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
+        cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 # ---------------------------------------------------------------------------
@@ -3789,7 +4147,8 @@ def _search_fused_pq_scan(state: ArenaState, book_cent: jax.Array,
                           boost_on: jax.Array, super_gate: jax.Array,
                           k: int, nprobe: int, slack: int, cap_take: int,
                           max_nbr: int, k_q=None, cap_q=None,
-                          nprobe_q=None, scan_chunk: int = 0):
+                          nprobe_q=None, scan_chunk: int = 0,
+                          sem=None, sem_block: int = 16):
     """PQ per-chunk compute phase: the ADC two-tier core, then the shared
     gate/CSR/boost tail. Ragged sidecars behave exactly as in
     ``_search_fused_ivf_scan``."""
@@ -3815,9 +4174,12 @@ def _search_fused_pq_scan(state: ArenaState, book_cent: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q, nprobe_q)
-    return chunked_map_multi(body, arrays,
-                             chunk=min(scan_chunk or IVF_SERVE_CHUNK,
-                                       IVF_SERVE_CHUNK))
+    if sem is None:
+        return chunked_map_multi(body, arrays,
+                                 chunk=min(scan_chunk or IVF_SERVE_CHUNK,
+                                           IVF_SERVE_CHUNK))
+    return _semantic_scan_core(body, arrays, state, sem, super_gate,
+                               k=k, block=sem_block, nprobe_val=nprobe)
 
 
 def _search_fused_pq(
@@ -3843,31 +4205,30 @@ def _search_fused_pq(
     slack: int,
     cap_take: int,
     max_nbr: int,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused_ivf`` with the m-byte ADC scan as the coarse stage:
     ONE donated dispatch + ONE packed readback per coalesced batch in PQ
     mode. Only the arena state is donated — the codebook, codes slab, and
     coarse tables are long-lived read-only replicas (the boost scatter
     touches salience/access/freshness, never embeddings or codes)."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
-        _search_fused_pq_scan(state, book_cent, codes, centroids, members,
-                              extras, csr_indptr, csr_nbr, q, q_valid,
-                              tenant, gate_on, boost_on, super_gate, k,
-                              nprobe, slack, cap_take, max_nbr)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+    res = _search_fused_pq_scan(state, book_cent, codes, centroids, members,
+                                extras, csr_indptr, csr_nbr, q, q_valid,
+                                tenant, gate_on, boost_on, super_gate, k,
+                                nprobe, slack, cap_take, max_nbr, sem=sem,
+                                sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_pq, search_fused_pq_copy = _donated_pair(
     _search_fused_pq, static_argnames=("k", "nprobe", "slack", "cap_take",
-                                       "max_nbr"))
+                                       "max_nbr", "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
-                                             "cap_take", "max_nbr"))
+                                             "cap_take", "max_nbr",
+                                             "sem_block"))
 def search_fused_pq_read(state: ArenaState, book_cent: jax.Array,
                          codes: jax.Array, centroids: jax.Array,
                          members: jax.Array, extras: jax.Array,
@@ -3875,17 +4236,18 @@ def search_fused_pq_read(state: ArenaState, book_cent: jax.Array,
                          q: jax.Array, q_valid: jax.Array,
                          tenant: jax.Array, gate_on: jax.Array,
                          super_gate: jax.Array, k: int, nprobe: int,
-                         slack: int, cap_take: int, max_nbr: int
+                         slack: int, cap_take: int, max_nbr: int,
+                         sem=None, sem_block: int = 16
                          ) -> jax.Array:
     """Read-only twin of ``search_fused_pq`` (pure ``search_memories``
     fleets in PQ mode): same ADC scan + exact rescore, no state mutation,
     no donation dance."""
     boost_off = jnp.zeros(q_valid.shape, bool)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = _search_fused_pq_scan(
+    res = _search_fused_pq_scan(
         state, book_cent, codes, centroids, members, extras, csr_indptr,
         csr_nbr, q, q_valid, tenant, gate_on, boost_off, super_gate, k,
-        nprobe, slack, cap_take, max_nbr)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+        nprobe, slack, cap_take, max_nbr, sem=sem, sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 def _search_fused_pq_ragged(
@@ -3915,33 +4277,31 @@ def _search_fused_pq_ragged(
     cap_take: int,
     max_nbr: int,
     scan_chunk: int = 0,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """``search_fused_pq`` with the (k, cap, nprobe) sidecar: the member
     gather and ADC scan run to the ceilings, each query masks at its own
     boundaries — one compiled PQ kernel for mixed-shape traffic."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
-        _search_fused_pq_scan(state, book_cent, codes, centroids, members,
-                              extras, csr_indptr, csr_nbr, q, q_valid,
-                              tenant, gate_on, boost_on, super_gate, k,
-                              nprobe, slack, cap_take, max_nbr, k_q=k_q,
-                              cap_q=cap_q, nprobe_q=nprobe_q,
-                              scan_chunk=scan_chunk)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+    res = _search_fused_pq_scan(state, book_cent, codes, centroids, members,
+                                extras, csr_indptr, csr_nbr, q, q_valid,
+                                tenant, gate_on, boost_on, super_gate, k,
+                                nprobe, slack, cap_take, max_nbr, k_q=k_q,
+                                cap_q=cap_q, nprobe_q=nprobe_q,
+                                scan_chunk=scan_chunk, sem=sem,
+                                sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_pq_ragged, search_fused_pq_ragged_copy = _donated_pair(
     _search_fused_pq_ragged,
     static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr",
-                     "scan_chunk"))
+                     "scan_chunk", "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
                                              "cap_take", "max_nbr",
-                                             "scan_chunk"))
+                                             "scan_chunk", "sem_block"))
 def search_fused_pq_ragged_read(state: ArenaState, book_cent: jax.Array,
                                 codes: jax.Array, centroids: jax.Array,
                                 members: jax.Array, extras: jax.Array,
@@ -3951,15 +4311,17 @@ def search_fused_pq_ragged_read(state: ArenaState, book_cent: jax.Array,
                                 k_q: jax.Array, nprobe_q: jax.Array,
                                 super_gate: jax.Array, k: int, nprobe: int,
                                 slack: int, cap_take: int, max_nbr: int,
-                                scan_chunk: int = 0) -> jax.Array:
+                                scan_chunk: int = 0, sem=None,
+                                sem_block: int = 16) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = _search_fused_pq_scan(
+    res = _search_fused_pq_scan(
         state, book_cent, codes, centroids, members, extras, csr_indptr,
         csr_nbr, q, q_valid, tenant, gate_on, boost_off, super_gate, k,
         nprobe, slack, cap_take, max_nbr, k_q=k_q, cap_q=cap_q,
-        nprobe_q=nprobe_q, scan_chunk=scan_chunk)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+        nprobe_q=nprobe_q, scan_chunk=scan_chunk, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 # ---------------------------------------------------------------------------
@@ -4049,7 +4411,8 @@ def _search_fused_pq_tiered_scan(state: ArenaState, book_cent: jax.Array,
                                  super_gate: jax.Array, k: int,
                                  nprobe: int, slack: int, cap_take: int,
                                  max_nbr: int, k_q=None, cap_q=None,
-                                 nprobe_q=None, scan_chunk: int = 0):
+                                 nprobe_q=None, scan_chunk: int = 0,
+                                 sem=None, sem_block: int = 16):
     """PQ×tiered per-chunk compute: the tier-aware PQ core, then the
     shared gate/CSR/boost tail with cold-hit queries' boosts deferred to
     the bounded finish dispatch — the tiered scan's contract."""
@@ -4075,8 +4438,12 @@ def _search_fused_pq_tiered_scan(state: ArenaState, book_cent: jax.Array,
     arrays = (q, q_valid, tenant, gate_on, boost_on)
     if ragged:
         arrays = arrays + (k_q, cap_q, nprobe_q)
-    return chunked_map_multi(chunk, arrays,
-                             chunk=(scan_chunk or IVF_SERVE_CHUNK))
+    if sem is None:
+        return chunked_map_multi(chunk, arrays,
+                                 chunk=(scan_chunk or IVF_SERVE_CHUNK))
+    return _semantic_scan_core(chunk, arrays, state, sem, super_gate,
+                               k=k, block=sem_block, rag_slack=slack,
+                               nprobe_val=nprobe)
 
 
 def _search_fused_pq_tiered(
@@ -4103,29 +4470,29 @@ def _search_fused_pq_tiered(
     slack: int,
     cap_take: int,
     max_nbr: int,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """ONE donated dispatch + ONE packed readback: IVF member gather for
     the hot tier, cold-masked ADC coarse for the demoted rows, tiered
     candidate window (k+slack wide) for the bounded finish."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
-        _search_fused_pq_tiered_scan(
-            state, book_cent, codes, cold, centroids, members, extras,
-            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
-            super_gate, k, nprobe, slack, cap_take, max_nbr)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+    res = _search_fused_pq_tiered_scan(
+        state, book_cent, codes, cold, centroids, members, extras,
+        csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
+        super_gate, k, nprobe, slack, cap_take, max_nbr, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_pq_tiered, search_fused_pq_tiered_copy = _donated_pair(
     _search_fused_pq_tiered,
-    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr"))
+    static_argnames=("k", "nprobe", "slack", "cap_take", "max_nbr",
+                     "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
-                                             "cap_take", "max_nbr"))
+                                             "cap_take", "max_nbr",
+                                             "sem_block"))
 def search_fused_pq_tiered_read(state: ArenaState, book_cent: jax.Array,
                                 codes: jax.Array, cold: jax.Array,
                                 centroids: jax.Array, members: jax.Array,
@@ -4134,14 +4501,15 @@ def search_fused_pq_tiered_read(state: ArenaState, book_cent: jax.Array,
                                 q_valid: jax.Array, tenant: jax.Array,
                                 gate_on: jax.Array, super_gate: jax.Array,
                                 k: int, nprobe: int, slack: int,
-                                cap_take: int, max_nbr: int) -> jax.Array:
+                                cap_take: int, max_nbr: int,
+                                sem=None, sem_block: int = 16) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = \
-        _search_fused_pq_tiered_scan(
-            state, book_cent, codes, cold, centroids, members, extras,
-            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
-            super_gate, k, nprobe, slack, cap_take, max_nbr)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+    res = _search_fused_pq_tiered_scan(
+        state, book_cent, codes, cold, centroids, members, extras,
+        csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+        super_gate, k, nprobe, slack, cap_take, max_nbr, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 def _search_fused_pq_tiered_ragged(
@@ -4172,30 +4540,28 @@ def _search_fused_pq_tiered_ragged(
     cap_take: int,
     max_nbr: int,
     scan_chunk: int = 0,
+    sem=None,
+    sem_block: int = 16,
 ) -> Tuple[ArenaState, jax.Array]:
     """PQ×tiered serving with the (k, cap, nprobe) sidecar."""
-    (gate_s, gate_r, ann_s, ann_r, fast, acc_rows, nbr_rows, n_dup) = \
-        _search_fused_pq_tiered_scan(
-            state, book_cent, codes, cold, centroids, members, extras,
-            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
-            super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
-            cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk)
-    n_acc, n_nbr = _boost_row_counts(state.capacity, acc_rows, nbr_rows)
-    state = _boost_scatter(state, acc_rows, nbr_rows, now, acc_boost,
-                           nbr_boost)
-    return state, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                  dup=n_dup, acc=n_acc, nbr=n_nbr)
+    res = _search_fused_pq_tiered_scan(
+        state, book_cent, codes, cold, centroids, members, extras,
+        csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_on,
+        super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
+        cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk,
+        sem=sem, sem_block=sem_block)
+    return _sem_finish(state, res, sem, now, acc_boost, nbr_boost)
 
 
 search_fused_pq_tiered_ragged, search_fused_pq_tiered_ragged_copy = \
     _donated_pair(_search_fused_pq_tiered_ragged,
                   static_argnames=("k", "nprobe", "slack", "cap_take",
-                                   "max_nbr", "scan_chunk"))
+                                   "max_nbr", "scan_chunk", "sem_block"))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "slack",
                                              "cap_take", "max_nbr",
-                                             "scan_chunk"))
+                                             "scan_chunk", "sem_block"))
 def search_fused_pq_tiered_ragged_read(
         state: ArenaState, book_cent: jax.Array, codes: jax.Array,
         cold: jax.Array, centroids: jax.Array, members: jax.Array,
@@ -4203,16 +4569,17 @@ def search_fused_pq_tiered_ragged_read(
         q: jax.Array, q_valid: jax.Array, tenant: jax.Array,
         gate_on: jax.Array, k_q: jax.Array, nprobe_q: jax.Array,
         super_gate: jax.Array, k: int, nprobe: int, slack: int,
-        cap_take: int, max_nbr: int, scan_chunk: int = 0) -> jax.Array:
+        cap_take: int, max_nbr: int, scan_chunk: int = 0,
+        sem=None, sem_block: int = 16) -> jax.Array:
     boost_off = jnp.zeros(q_valid.shape, bool)
     cap_q = jnp.zeros(q_valid.shape, jnp.int32)
-    gate_s, gate_r, ann_s, ann_r, fast, _, _, n_dup = \
-        _search_fused_pq_tiered_scan(
-            state, book_cent, codes, cold, centroids, members, extras,
-            csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
-            super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
-            cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk)
-    return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast, dup=n_dup)
+    res = _search_fused_pq_tiered_scan(
+        state, book_cent, codes, cold, centroids, members, extras,
+        csr_indptr, csr_nbr, q, q_valid, tenant, gate_on, boost_off,
+        super_gate, k, nprobe, slack, cap_take, max_nbr, k_q=k_q,
+        cap_q=cap_q, nprobe_q=nprobe_q, scan_chunk=scan_chunk, sem=sem,
+        sem_block=sem_block)
+    return _sem_finish_read(res, sem)
 
 
 # ---------------------------------------------------------------------------
@@ -4264,7 +4631,8 @@ def _globalize_rows(rows: jax.Array, scores: jax.Array, shard: jax.Array,
 def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
                        max_nbr: int, mode: str = "exact", slack: int = 0,
                        nprobe: int = 0, ragged: bool = False,
-                       scan_chunk: int = 0) -> FusedShardedKernels:
+                       scan_chunk: int = 0,
+                       sem: bool = False) -> FusedShardedKernels:
     """Build the distributed fused chat-turn serving program for ``mesh``.
 
     ``mode`` picks the shard-local coarse stage:
@@ -4321,7 +4689,19 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     the planner can fit an over-budget pod geometry by shrinking the
     ``[chunk, local_rows]`` score transient instead of splitting the turn
     into extra dispatches. Bit-identical results — only the streaming
-    granularity changes — and still ONE distributed dispatch."""
+    granularity changes — and still ONE distributed dispatch.
+
+    ``sem=True`` (ISSUE 20) threads the semantic query-cache ring through
+    the distributed program: every call signature gains a trailing
+    ``sem_state = (ring, valid, head, thresh, mode_id)`` pytree
+    (REPLICATED — the ring rides every chip identically) and the serve
+    twins return ``(state, ring, packed)`` / read returns ``(ring,
+    packed)``. The mesh variant is substitution-only: the probe,
+    result substitution, and writeback are replicated arithmetic after
+    the merge (the shard-local scans still run — skipping blocks would
+    desynchronize the all_gather), so pod hits save the readback-side
+    work and keep the ring warm for the single-chip replicas, and the
+    packed layout still carries the per-query sem verdict column."""
     from jax.sharding import PartitionSpec as P
 
     from lazzaro_tpu.ops.topk import sharded_topk_merge
@@ -4470,49 +4850,129 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
         return _boost_scatter(arena, acc_idx, nbr_idx, now, acc_boost,
                               nbr_boost, zero_last=False), n_acc, n_nbr
 
+    def _sem_apply(sem_state, sent, q, q_valid, tenant, gate_on,
+                   super_gate, merged, k_q=None, nprobe_q=None):
+        """Replicated probe → substitute → writeback after the merge.
+        Every chip computes the identical verdicts and the identical next
+        ring (replicated inputs, replicated arithmetic), so the ring's
+        out-spec stays P(None...) with zero extra collectives."""
+        ring, sem_valid, head, thresh, mode_id = sem_state
+        gate_s, gate_r, ann_s, ann_r, n_dup, cold_any = merged
+        nq = q.shape[0]
+        qn = normalize(q).astype(jnp.float32)
+        k_need = (k_q if k_q is not None
+                  else jnp.full((nq,), k, jnp.int32))
+        npr_need = (nprobe_q if nprobe_q is not None
+                    else jnp.full((nq,), nprobe, jnp.int32))
+        hit, slot = _semantic_probe(ring, sem_valid, qn, tenant, q_valid,
+                                    gate_on, k_need, npr_need, mode_id,
+                                    thresh)
+        miss = q_valid & ~hit
+        rank = jnp.cumsum(miss.astype(jnp.int32)) - 1
+        n_miss = miss.sum().astype(jnp.int32)
+        write_mask = miss & (rank >= n_miss - ring.slots)
+        ring2 = _semantic_writeback(ring, head, qn, tenant, gate_on,
+                                    gate_s, gate_r, ann_s, ann_r, rank,
+                                    write_mask, k_need, npr_need, mode_id,
+                                    sent)
+        fast0 = gate_on & (gate_s > super_gate)
+        rag_slack = slack if mode == "tiered" else 0
+        gate_s, gate_r, ann_s, ann_r, fast = _semantic_substitute(
+            ring, hit, slot, gate_on, super_gate,
+            (gate_s, gate_r, ann_s, ann_r, fast0), k_q, rag_slack, sent)
+        n_dup = jnp.where(hit, 0, n_dup)
+        sem_col = jnp.where(hit, 1 + slot, 0).astype(jnp.int32)
+        return (gate_s, gate_r, ann_s, ann_r, fast, n_dup,
+                cold_any & ~hit, hit, sem_col, ring2)
+
     def _serve_local(arena, tables, indptr2, nbr2, q, q_valid, tenant,
                      gate_on, boost_on, now, super_gate, acc_boost,
-                     nbr_boost):
-        gate_s, gate_r, ann_s, ann_r, n_dup, cold_any = _scan_merge(
-            arena, tables, q, tenant)
-        fast = gate_on & (gate_s > super_gate)
+                     nbr_boost, sem_state=None):
+        merged = _scan_merge(arena, tables, q, tenant)
+        if sem_state is None:
+            gate_s, gate_r, ann_s, ann_r, n_dup, cold_any = merged
+            fast = gate_on & (gate_s > super_gate)
+            arena, n_acc, n_nbr = _boost_tail(
+                arena, indptr2[0], nbr2[0], ann_s, ann_r, fast, q_valid,
+                tenant, boost_on & ~cold_any, now, acc_boost, nbr_boost)
+            packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                     dup=n_dup, acc=n_acc, nbr=n_nbr)
+            return arena, packed
+        sent = n_shards * arena.emb.shape[0] - 1
+        (gate_s, gate_r, ann_s, ann_r, fast, n_dup, cold_eff, hit,
+         sem_col, ring2) = _sem_apply(sem_state, sent, q, q_valid, tenant,
+                                      gate_on, super_gate, merged)
         arena, n_acc, n_nbr = _boost_tail(
             arena, indptr2[0], nbr2[0], ann_s, ann_r, fast, q_valid,
-            tenant, boost_on & ~cold_any, now, acc_boost, nbr_boost)
+            tenant, boost_on & ~cold_eff & ~hit, now, acc_boost,
+            nbr_boost)
         packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                 dup=n_dup, acc=n_acc, nbr=n_nbr)
-        return arena, packed
+                                 dup=n_dup, acc=n_acc, nbr=n_nbr,
+                                 sem=sem_col)
+        return arena, ring2, packed
 
     def _read_local(arena, tables, indptr2, nbr2, q, q_valid, tenant,
-                    gate_on, super_gate):
-        gate_s, gate_r, ann_s, ann_r, n_dup, _cold = _scan_merge(
-            arena, tables, q, tenant)
-        fast = gate_on & (gate_s > super_gate)
-        return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                               dup=n_dup)
+                    gate_on, super_gate, sem_state=None):
+        merged = _scan_merge(arena, tables, q, tenant)
+        if sem_state is None:
+            gate_s, gate_r, ann_s, ann_r, n_dup, _cold = merged
+            fast = gate_on & (gate_s > super_gate)
+            return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                   dup=n_dup)
+        sent = n_shards * arena.emb.shape[0] - 1
+        (gate_s, gate_r, ann_s, ann_r, fast, n_dup, _cold, _hit,
+         sem_col, ring2) = _sem_apply(sem_state, sent, q, q_valid, tenant,
+                                      gate_on, super_gate, merged)
+        return ring2, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                      dup=n_dup, sem=sem_col)
 
     def _serve_local_ragged(arena, tables, indptr2, nbr2, q, q_valid,
                             tenant, gate_on, boost_on, k_q, cap_q,
                             nprobe_q, now, super_gate, acc_boost,
-                            nbr_boost):
-        gate_s, gate_r, ann_s, ann_r, n_dup, cold_any = _scan_merge(
-            arena, tables, q, tenant, k_q=k_q, nprobe_q=nprobe_q)
-        fast = gate_on & (gate_s > super_gate)
+                            nbr_boost, sem_state=None):
+        merged = _scan_merge(arena, tables, q, tenant, k_q=k_q,
+                             nprobe_q=nprobe_q)
+        if sem_state is None:
+            gate_s, gate_r, ann_s, ann_r, n_dup, cold_any = merged
+            fast = gate_on & (gate_s > super_gate)
+            arena, n_acc, n_nbr = _boost_tail(
+                arena, indptr2[0], nbr2[0], ann_s, ann_r, fast, q_valid,
+                tenant, boost_on & ~cold_any, now, acc_boost, nbr_boost,
+                cap_q=cap_q)
+            packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                     dup=n_dup, acc=n_acc, nbr=n_nbr)
+            return arena, packed
+        sent = n_shards * arena.emb.shape[0] - 1
+        (gate_s, gate_r, ann_s, ann_r, fast, n_dup, cold_eff, hit,
+         sem_col, ring2) = _sem_apply(sem_state, sent, q, q_valid, tenant,
+                                      gate_on, super_gate, merged,
+                                      k_q=k_q, nprobe_q=nprobe_q)
         arena, n_acc, n_nbr = _boost_tail(
             arena, indptr2[0], nbr2[0], ann_s, ann_r, fast, q_valid,
-            tenant, boost_on & ~cold_any, now, acc_boost, nbr_boost,
-            cap_q=cap_q)
+            tenant, boost_on & ~cold_eff & ~hit, now, acc_boost,
+            nbr_boost, cap_q=cap_q)
         packed = _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                                 dup=n_dup, acc=n_acc, nbr=n_nbr)
-        return arena, packed
+                                 dup=n_dup, acc=n_acc, nbr=n_nbr,
+                                 sem=sem_col)
+        return arena, ring2, packed
 
     def _read_local_ragged(arena, tables, indptr2, nbr2, q, q_valid,
-                           tenant, gate_on, k_q, nprobe_q, super_gate):
-        gate_s, gate_r, ann_s, ann_r, n_dup, _cold = _scan_merge(
-            arena, tables, q, tenant, k_q=k_q, nprobe_q=nprobe_q)
-        fast = gate_on & (gate_s > super_gate)
-        return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
-                               dup=n_dup)
+                           tenant, gate_on, k_q, nprobe_q, super_gate,
+                           sem_state=None):
+        merged = _scan_merge(arena, tables, q, tenant, k_q=k_q,
+                             nprobe_q=nprobe_q)
+        if sem_state is None:
+            gate_s, gate_r, ann_s, ann_r, n_dup, _cold = merged
+            fast = gate_on & (gate_s > super_gate)
+            return _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                   dup=n_dup)
+        sent = n_shards * arena.emb.shape[0] - 1
+        (gate_s, gate_r, ann_s, ann_r, fast, n_dup, _cold, _hit,
+         sem_col, ring2) = _sem_apply(sem_state, sent, q, q_valid, tenant,
+                                      gate_on, super_gate, merged,
+                                      k_q=k_q, nprobe_q=nprobe_q)
+        return ring2, _pack_retrieval(gate_s, gate_r, ann_s, ann_r, fast,
+                                      dup=n_dup, sem=sem_col)
 
     state_specs = ArenaState(
         emb=P(axis, None), salience=P(axis), timestamp=P(axis),
@@ -4531,25 +4991,35 @@ def make_fused_sharded(mesh, axis: str, *, k: int, cap_take: int,
     }[mode]
     common = (state_specs, tables_specs, P(axis, None), P(axis, None),
               P(None, None), P(None), P(None), P(None))
+    # Semantic ring (ISSUE 20): REPLICATED on every chip — the probe /
+    # substitute / writeback are replicated arithmetic after the merge.
+    ring_specs = SemanticRing(
+        emb=P(None, None), tenant=P(None), gate_on=P(None), mode=P(None),
+        stored_k=P(None), nprobe=P(None), gate_s=P(None), gate_r=P(None),
+        ann_s=P(None, None), ann_r=P(None, None))
+    sem_in = ((ring_specs, P(None), P(), P(), P()),) if sem else ()
+    serve_out = ((state_specs, ring_specs, P(None, None)) if sem
+                 else (state_specs, P(None, None)))
+    read_out = (ring_specs, P(None, None)) if sem else P(None, None)
     if ragged:
         # + (boost_on, k_q, cap_q, nprobe_q) replicated sidecars
         mapped_serve = shard_map(
             _serve_local_ragged, mesh=mesh,
             in_specs=common + (P(None), P(None), P(None), P(None),
-                               P(), P(), P(), P()),
-            out_specs=(state_specs, P(None, None)), check_vma=False)
+                               P(), P(), P(), P()) + sem_in,
+            out_specs=serve_out, check_vma=False)
         mapped_read = shard_map(
             _read_local_ragged, mesh=mesh,
-            in_specs=common + (P(None), P(None), P()),
-            out_specs=P(None, None), check_vma=False)
+            in_specs=common + (P(None), P(None), P()) + sem_in,
+            out_specs=read_out, check_vma=False)
     else:
         mapped_serve = shard_map(
             _serve_local, mesh=mesh,
-            in_specs=common + (P(None), P(), P(), P(), P()),
-            out_specs=(state_specs, P(None, None)), check_vma=False)
+            in_specs=common + (P(None), P(), P(), P(), P()) + sem_in,
+            out_specs=serve_out, check_vma=False)
         mapped_read = shard_map(
-            _read_local, mesh=mesh, in_specs=common + (P(),),
-            out_specs=P(None, None), check_vma=False)
+            _read_local, mesh=mesh, in_specs=common + (P(),) + sem_in,
+            out_specs=read_out, check_vma=False)
     return FusedShardedKernels(
         serve=jax.jit(mapped_serve, donate_argnums=(0,)),
         serve_copy=jax.jit(mapped_serve),

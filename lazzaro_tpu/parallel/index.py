@@ -119,7 +119,11 @@ class ShardedMemoryIndex:
                  hbm_headroom_fraction: float = 0.1,
                  plan_max_splits: int = 16,
                  plan_calibration_path: Optional[str] = None,
-                 planner: Optional[HbmPlanner] = None):
+                 planner: Optional[HbmPlanner] = None,
+                 semantic_cache: bool = False,
+                 semantic_cache_slots: int = 64,
+                 semantic_cache_threshold: float = 0.985,
+                 semantic_cache_block: int = 16):
         self.mesh = mesh
         # Serving telemetry (ISSUE 6): same registry contract as
         # MemoryIndex — spans per dispatch, device counters decoded from
@@ -278,6 +282,19 @@ class ShardedMemoryIndex:
         # serving, (mode, k_bucket) without; LRU-capped so mixed-k
         # non-ragged traffic can no longer grow it without bound.
         self._fused_cache = LRUKernelCache(serve_kernel_cache_max)
+
+        # Semantic query cache (ISSUE 20): the ring is REPLICATED over
+        # the mesh (probe/substitute/writeback run identically on every
+        # chip after the all_gather merge), so the single-chip host
+        # mirror works unchanged — same hit masks, same LIFO replay.
+        self._sem_host = None
+        if semantic_cache:
+            from lazzaro_tpu.core.index import SemanticCacheHost
+            self._sem_host = SemanticCacheHost(
+                semantic_cache_slots, dim,
+                self.serve_k_max + self.coarse_slack,
+                semantic_cache_threshold, semantic_cache_block,
+                telemetry=self.telemetry)
 
     # ------------------------------------------------------------------ util
     def _reshard(self, pytree):
@@ -745,6 +762,14 @@ class ShardedMemoryIndex:
             self._ivf_tabs_cache = None
         if self.tiering is not None and live_rows:
             self.tiering.on_rows_written(live_rows)
+        if self._sem_host is not None:
+            # dedup-merge touched rows: exactly those slots; accepted new
+            # rows: the whole tenant (a fresh fact changes its top-k
+            # invisibly to any row-level index)
+            self._sem_host.invalidate_rows(
+                int(target[i]) for i in range(n) if dup[i])
+            if live_rows:
+                self._sem_host.invalidate_tenant(tid)
         if overflowed:
             self.link_pool_overflows += 1
             tel.bump("ingest.link_pool_overflows")
@@ -841,6 +866,10 @@ class ShardedMemoryIndex:
                     t_rows.append(int(r))
                     t_sals.append(float(saliences[i]))
         now_rel = now - self.epoch
+        if t_rows and self._sem_host is not None:
+            # same taxonomy as the fused path: merge targets row-level
+            # (add() above already flushed the tenant for the live rows)
+            self._sem_host.invalidate_rows(t_rows)
         if t_rows:
             padded = S.pad_rows(np.asarray(t_rows, np.int32), self.capacity)
             sal = np.zeros((len(padded),), np.float32)
@@ -1146,6 +1175,8 @@ class ShardedMemoryIndex:
         self._emb_gen += 1
         if self.tiering is not None:       # a re-added cold row is hot again
             self.tiering.on_rows_written(rows)
+        if self._sem_host is not None:     # new facts change tenant top-k
+            self._sem_host.invalidate_tenant(tid)
         return rows
 
     def delete(self, ids: Sequence[str]) -> None:
@@ -1176,6 +1207,8 @@ class ShardedMemoryIndex:
             self._ivf_tabs_cache = None
         if self.tiering is not None:       # freed cold rows leave the store
             self.tiering.on_rows_deleted(rows)
+        if self._sem_host is not None:
+            self._sem_host.invalidate_rows(rows)
         padded = S.pad_rows(np.asarray(rows, np.int32), self.capacity)
         self._apply_arena(S.arena_delete, S.arena_delete_copy,
                           jnp.asarray(padded))
@@ -1302,6 +1335,10 @@ class ShardedMemoryIndex:
             self._ivf_tabs_cache = None
             self._publish_online_tables(members)
             self._publish_pq(st, mask)
+        if self._sem_host is not None:
+            # a (re)build flips the serving mode / coarse routing for
+            # every tenant — cached windows may no longer be reproducible
+            self._sem_host.invalidate_tenant(None)
         return True
 
     def _publish_pq(self, st: S.ArenaState, mask_np: np.ndarray) -> None:
@@ -1404,8 +1441,8 @@ class ShardedMemoryIndex:
         return tabs
 
     def _fused_kernels(self, mode: str, k_bucket: int, nprobe: int,
-                       ragged: bool = False, scan_chunk: int = 0
-                       ) -> S.FusedShardedKernels:
+                       ragged: bool = False, scan_chunk: int = 0,
+                       sem: bool = False) -> S.FusedShardedKernels:
         # With ragged kernels k_bucket/nprobe are the fixed per-mode
         # ceilings, so the cache key collapses to one entry per mode.
         # A planner scan_chunk override keys separately: same ONE
@@ -1415,13 +1452,15 @@ class ShardedMemoryIndex:
                else (mode, k_bucket, nprobe))
         if scan_chunk:
             key = key + ("chunk", scan_chunk)
+        if sem:
+            key = key + ("sem",)
         kern = self._fused_cache.get(key)
         if kern is None:
             kern = S.make_fused_sharded(
                 self.mesh, self.axis, k=k_bucket,
                 cap_take=min(self.cap_take, k_bucket), max_nbr=self.max_nbr,
                 mode=mode, slack=self.coarse_slack, nprobe=nprobe,
-                ragged=ragged, scan_chunk=scan_chunk)
+                ragged=ragged, scan_chunk=scan_chunk, sem=sem)
             self._fused_cache.put(key, kern)
             self.telemetry.gauge("kernel.cache_entries",
                                  len(self._fused_cache),
@@ -1462,7 +1501,11 @@ class ShardedMemoryIndex:
             dtype_bytes=int(np.dtype(self.dtype).itemsize),
             mesh_parts=self.n_parts, edge_cap=self.edge_capacity,
             nprobe=int(self._ivf[3] if self._ivf is not None else 0),
-            replica_groups=self.replica_groups)
+            replica_groups=self.replica_groups,
+            sem_slots=(self._sem_host.slots if self._sem_host is not None
+                       else 0),
+            sem_width=(self._sem_host.width if self._sem_host is not None
+                       else 0))
 
     def serve_requests(self, reqs) -> List:
         """Memory-safe entry point of the pod serving path (ISSUE 11):
@@ -1655,8 +1698,19 @@ class ShardedMemoryIndex:
             nprobe = 0
             mode = "quant" if use_quant else "exact"
             tables = self._int8_shadow_for() if use_quant else ()
+        # Semantic query cache (ISSUE 20): the replicated ring rides the
+        # SAME distributed dispatch. Tiered pods cache the k+slack
+        # candidate window, so their guard adds the slack.
+        semh = self._sem_host
+        sem_state = None
+        if semh is not None and mode in S.SEM_MODE_IDS:
+            win = k_bucket + (self.coarse_slack if tiered else 0)
+            if win <= semh.width:
+                sem_state = semh.tuple_for(mode)
+        sem_tail = () if sem_state is None else (sem_state,)
         kern = self._fused_kernels(mode, k_bucket, nprobe, ragged=ragged,
-                                   scan_chunk=scan_chunk)
+                                   scan_chunk=scan_chunk,
+                                   sem=sem_state is not None)
         csr_i, csr_n = self._csr_sharded()
         args = (tables, csr_i, csr_n, jnp.asarray(qp),
                 jnp.asarray(padb(valid)),
@@ -1681,7 +1735,8 @@ class ShardedMemoryIndex:
         else:
             read_extra = (jnp.float32(self.super_gate),)
         self._maybe_record_hbm(mode, kern, args, k_bucket,
-                               read_extra=read_extra, ragged=ragged)
+                               read_extra=read_extra + sem_tail,
+                               ragged=ragged)
         # Fault point "plan.oom" (ISSUE 11): an HBM allocation failure the
         # admission plan missed; serve_requests answers with one replan.
         faults.fire("plan.oom", mode=f"pod_{mode}", batch=pad_n)
@@ -1696,25 +1751,39 @@ class ShardedMemoryIndex:
                     boost_extra = ((jnp.asarray(padb(boost_on)), k_dev,
                                     capq_dev, npq_dev) if ragged
                                    else (jnp.asarray(padb(boost_on)),))
-                    new_state, packed = self._guarded(
+                    out = self._guarded(
                         lambda fn: self._dispatch(
                             fn, cur, *args, *boost_extra,
                             jnp.float32(now_rel),
                             jnp.float32(self.super_gate),
                             jnp.float32(self.acc_boost),
-                            jnp.float32(self.nbr_boost)),
+                            jnp.float32(self.nbr_boost), *sem_tail),
                         kern.serve, kern.serve_copy, sole, (cur,),
                         "serve_pod")
+                    if sem_state is not None:
+                        new_state, sem_ring2, packed = out
+                    else:
+                        new_state, packed = out
                     del cur
                     self.state = new_state
             else:
-                packed = self._dispatch(kern.read, self.state, *args,
-                                        *read_extra)
+                out = self._dispatch(kern.read, self.state, *args,
+                                     *read_extra, *sem_tail)
+                if sem_state is not None:
+                    sem_ring2, packed = out
+                else:
+                    packed = out
             host = np.asarray(packed)          # the ONE readback
         tel.record("serve.dispatch_ms", (time.perf_counter() - t0) * 1e3,
                    labels={"mode": f"pod_{mode}"})
         if tiered:
             from lazzaro_tpu.tier.serve import tiered_decode_and_finish
+            if sem_state is not None:
+                k_unpack = (host.shape[1] - 8) // 2
+                g_s, g_r, a_s, a_r, _, ctr = unpack_retrieval(host[:nq],
+                                                              k_unpack)
+                semh.note_readback(sem_ring2, ctr[:, 4], valid, tids,
+                                   g_s, g_r, a_s, a_r)
             with tel.span("serve.decode_ms"):
                 return tiered_decode_and_finish(
                     self, tm, reqs, results, valid, boost_on, q, tids,
@@ -1740,9 +1809,13 @@ class ShardedMemoryIndex:
                     res.gate_score = float(gate_s[i])
                 res.fast = bool(fast[i])
                 res.boosted = bool(boost_on[i] and not fast[i])
+        if sem_state is not None:
+            semh.note_readback(sem_ring2, counters[:, 4], valid, tids,
+                               gate_s, gate_r, ann_s, ann_r)
         record_device_counters(
             tel, counters, fast, gate_on, valid,
-            np.asarray([min(int(r.k), self.capacity) for r in reqs]))
+            np.asarray([min(int(r.k), self.capacity) for r in reqs]),
+            sem_active=sem_state is not None)
         return results
 
     def _maybe_record_hbm(self, mode: str, kern, args, k_bucket,
@@ -1773,6 +1846,15 @@ class ShardedMemoryIndex:
                 labels["pq"] = "true"
             if self.replica_groups > 1:
                 labels["groups"] = str(self.replica_groups)
+            # the sem operand is the one TUPLE in the read tail (the
+            # base extras are device scalars/arrays)
+            sem_on = (self._sem_host is not None and bool(read_extra)
+                      and isinstance(read_extra[-1], tuple))
+            if sem_on:
+                # ring geometry for check_hbm_budget.py's semantic-cache
+                # sweep (ISSUE 20): resident ring + [batch, slots] probe
+                labels["sem_slots"] = str(self._sem_host.slots)
+                labels["sem_width"] = str(self._sem_host.width)
             self.telemetry.gauge("kernel.peak_hbm_bytes", peak,
                                  labels=labels)
             self.planner.observe_gauge(
@@ -1783,7 +1865,10 @@ class ShardedMemoryIndex:
                          dtype_bytes=int(np.dtype(self.dtype).itemsize),
                          mesh_parts=self.n_parts,
                          edge_cap=self.edge_capacity,
-                         replica_groups=self.replica_groups),
+                         replica_groups=self.replica_groups,
+                         sem_slots=(self._sem_host.slots if sem_on else 0),
+                         sem_width=(self._sem_host.width if sem_on
+                                    else 0)),
                 peak)
 
     def warmup_serving(self, geometries=(8, 64),

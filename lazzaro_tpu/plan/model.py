@@ -117,6 +117,14 @@ class Geometry:
     # fleet-wide resident footprint while leaving the per-chip slice
     # rows / (chips/G).
     replica_groups: int = 1
+    # Semantic query cache (ISSUE 20): ring slots per serving index.
+    # 0 means the cache is off. Each slot is resident device state —
+    # normalized query embedding + packed top-k result columns + the
+    # condition columns the probe masks on — and the probe adds a
+    # [batch, slots] similarity tile to the transient set. ``sem_width``
+    # is the stored result width (k, or k + slack for tiered modes).
+    sem_slots: int = 0
+    sem_width: int = 0
 
     def with_(self, **kw) -> "Geometry":
         d = asdict(self)
@@ -210,6 +218,13 @@ class CostModel:
         total += g.edge_cap * EDGE_SLOT_BYTES
         # CSR shadow (indptr + neighbor pool ≈ 2 entries/edge, i32)
         total += (rows_pc + 2) * 4 + 2 * g.edge_cap * 4
+        if g.sem_slots and g.kind == "serve":
+            # Semantic ring (ISSUE 20): replicated per chip — slots+1
+            # rows (sentinel scratch row included) of normalized query
+            # embedding, packed (score, row) result columns at the
+            # stored width, and the five condition/verdict columns.
+            w = g.sem_width or g.k
+            total += (g.sem_slots + 1) * (g.dim * 4 + w * 8 + 25)
         return int(total)
 
     def transient_bytes(self, g: Geometry) -> int:
@@ -283,9 +298,13 @@ class CostModel:
             # and the top-k workspace XLA materializes beside them
             tile = chunk * (scan_rows_pc + 1) * 4 * 3
         q_bytes = g.batch * g.dim * 4 * 2              # query + normalized
-        readback = g.batch * (3 + 2 * g.k + 4) * 4 * 2
+        readback = g.batch * (3 + 2 * g.k + 5) * 4 * 2
         sidecars = g.batch * 4 * 6                     # k/cap/nprobe/flags
-        return int(tile + q_bytes + readback + sidecars
+        sem_tile = 0
+        if g.sem_slots and g.kind == "serve":
+            # probe similarity tile + miss-first sort workspace
+            sem_tile = g.batch * (g.sem_slots + 8) * 4
+        return int(tile + q_bytes + readback + sidecars + sem_tile
                    + DISPATCH_WORKSPACE_BYTES)
 
     def predict(self, g: Geometry) -> int:

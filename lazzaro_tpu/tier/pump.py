@@ -284,6 +284,13 @@ class TierManager:
                 hook = getattr(idx, "_ivf_on_demoted", None)
                 if hook is not None:
                     hook(chunk)
+                # Semantic cache (ISSUE 20): a cached window holding one
+                # of these rows scored it EXACTLY; the next fresh scan
+                # scores it coarse — evict so hits never serve a score
+                # the miss path can no longer reproduce.
+                sem = getattr(idx, "_sem_host", None)
+                if sem is not None:
+                    sem.invalidate_rows(chunk)
                 moved += len(chunk)
             ms = (time.perf_counter() - t0) * 1e3
             self.telemetry.record("tier.pump_chunk_ms", ms,
@@ -360,6 +367,12 @@ class TierManager:
                     hook = getattr(idx, "_ivf_on_promoted", None)
                     if hook is not None:
                         hook(chunk)
+                    # Semantic cache (ISSUE 20): cached coarse scores for
+                    # these rows are stale now that fresh scans rescore
+                    # them exactly
+                    sem = getattr(idx, "_sem_host", None)
+                    if sem is not None:
+                        sem.invalidate_rows(chunk)
                 for r in chunk:
                     self._no_demote_until[r] = now + self.hysteresis_s
                     self._hits.pop(r, None)

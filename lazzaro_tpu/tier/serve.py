@@ -34,8 +34,8 @@ NEG_INF = -1e30
 
 def _packed_k(host: np.ndarray) -> int:
     """Candidate width of a packed retrieval readback: the layout is
-    [gate_s, gate_r, k·ann_s, k·ann_r, fast, 4 counters]."""
-    return (host.shape[1] - 7) // 2
+    [gate_s, gate_r, k·ann_s, k·ann_r, fast, 5 counters]."""
+    return (host.shape[1] - 8) // 2
 
 
 def tiered_decode_and_finish(index, tm, reqs, results, valid, boost_on,

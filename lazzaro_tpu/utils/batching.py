@@ -180,9 +180,10 @@ def empty_results(n: int) -> List[Tuple[List[str], List[float]]]:
 # Column names of the device-counter tail every fused serving readback
 # carries (core.state.RETRIEVAL_TAIL int32 columns after the fast bit):
 # live top-k hits, in-kernel dedup drops, access-boost rows scattered,
-# neighbor-boost rows scattered.
+# neighbor-boost rows scattered, semantic-cache verdict (0 = miss,
+# 1 + ring slot on a hit).
 RETRIEVAL_COUNTERS = ("live", "dedup_dropped", "acc_boost_rows",
-                      "nbr_boost_rows")
+                      "nbr_boost_rows", "semantic")
 
 
 def unpack_retrieval(host: np.ndarray, k: int

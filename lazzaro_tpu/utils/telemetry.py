@@ -237,15 +237,18 @@ def default_registry() -> Telemetry:
 
 
 def record_device_counters(tel: Telemetry, counters, fast, gate_on, valid,
-                           k_req) -> None:
+                           k_req, sem_active: bool = False) -> None:
     """Fold one fused readback's device-counter tail into the registry —
     shared by the single-chip (``core.index``) and pod
     (``parallel.index``) decoders. ``counters`` is the
-    ``utils.batching.unpack_retrieval`` tail ([Q, 4] int32: live, dup,
-    acc-boost rows, nbr-boost rows), ``fast`` the device gate verdicts,
-    ``gate_on``/``valid`` the per-query flags, ``k_req`` each request's
-    asked-for k (shortfall counts against THAT, not the padded kernel
-    bucket)."""
+    ``utils.batching.unpack_retrieval`` tail ([Q, 5] int32: live, dup,
+    acc-boost rows, nbr-boost rows, semantic verdict), ``fast`` the
+    device gate verdicts, ``gate_on``/``valid`` the per-query flags,
+    ``k_req`` each request's asked-for k (shortfall counts against THAT,
+    not the padded kernel bucket). ``sem_active`` marks dispatches that
+    actually carried the semantic ring — without it a cache-off turn
+    would count every query as a semantic miss (the column is always
+    present, just all-zero)."""
     v = np.asarray(valid, bool)
     if not v.any():
         return
@@ -259,6 +262,10 @@ def record_device_counters(tel: Telemetry, counters, fast, gate_on, valid,
     tel.bump("device.dedup_hits", int(counters[:, 1][v].sum()))
     tel.bump("device.boost_rows", int(counters[:, 2][v].sum()))
     tel.bump("device.nbr_boost_rows", int(counters[:, 3][v].sum()))
+    if sem_active and counters.shape[1] > 4:
+        n_hit = int((counters[:, 4][v] > 0).sum())
+        tel.bump("serve.semantic_hits", n_hit)
+        tel.bump("serve.semantic_misses", int(v.sum()) - n_hit)
 
 
 def peak_bytes(memory_stats) -> Optional[float]:

@@ -131,6 +131,25 @@ the paths passed as arguments) and exits nonzero if:
     crash-replay cell with ``lost_facts`` or ``doubled_facts`` != 0
     (journal-subscriber recovery must converge exactly),
 
+  - (ISSUE 20) a SEMANTIC-CACHE artifact (any dict with
+    ``"semantic_cache": true``) does not record a measured
+    ``dispatches_per_turn`` (gated == 1 by the generic rule — the
+    similarity probe, the hit early-out, and the ring writeback all
+    ride INSIDE the one fused dispatch, never as sibling dispatches),
+    lacks a ``semantic_hit_rate``/``hit_rate_floor`` pair or records
+    the rate below its floor (the Zipf repeated-intent workload stopped
+    hitting — the ring geometry or the probe eligibility mask
+    regressed), records a missing/nonzero ``stale_hits`` (under
+    ingest/delete churn a cached window served results a fresh scan
+    would not — the ONE correctness failure the invalidation reverse
+    index exists to prevent), does not record ``"miss_parity": true``
+    (a cold probe must be a bit-identical pass-through: ids AND scores
+    of a never-seen population must match the cache-off twin), lacks a
+    ``recall_at_10``/``recall_floor`` pair (the generic recall gate
+    then enforces it — a hit-served window must BE the exact answer),
+    or records ``semantic_vs_off_speedup`` below its ``speedup_floor``
+    (hits stopped buying back their scan blocks),
+
 so any of these regressions turns red in CI instead of shipping.
 
 Usage:
@@ -167,7 +186,7 @@ _DISPATCH_KEYS = ("dispatches_per_turn", "dispatches_per_conversation",
 
 def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
           tiereds, ingests, online_ivfs, pq_fuseds, pageds, replicas,
-          lifecycles):
+          lifecycles, semantics):
     if isinstance(obj, dict):
         if "recall_at_10" in obj and "recall_floor" in obj:
             recalls.append((path, obj["recall_at_10"], obj["recall_floor"]))
@@ -196,6 +215,8 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             replicas.append((path, obj))
         if obj.get("lifecycle") is True:
             lifecycles.append((path, obj))
+        if obj.get("semantic_cache") is True:
+            semantics.append((path, obj))
         for k, v in obj.items():
             here = f"{path}.{k}"
             if k in _DISPATCH_KEYS:
@@ -205,12 +226,12 @@ def _walk(obj, path, hits, recalls, speedups, meshes, tel_blocks, raggeds,
             else:
                 _walk(v, here, hits, recalls, speedups, meshes, tel_blocks,
                       raggeds, tiereds, ingests, online_ivfs, pq_fuseds,
-                      pageds, replicas, lifecycles)
+                      pageds, replicas, lifecycles, semantics)
     elif isinstance(obj, list):
         for i, v in enumerate(obj):
             _walk(v, f"{path}[{i}]", hits, recalls, speedups, meshes,
                   tel_blocks, raggeds, tiereds, ingests, online_ivfs,
-                  pq_fuseds, pageds, replicas, lifecycles)
+                  pq_fuseds, pageds, replicas, lifecycles, semantics)
 
 
 def _check_telemetry(loc, measured_fused, block, grandfathered, bad):
@@ -456,6 +477,57 @@ def _check_lifecycle(loc, obj, bad):
                              f"per-tenant host loop)"))
 
 
+def _check_semantic(loc, obj, bad):
+    """The ISSUE 20 semantic-cache gate on one ``"semantic_cache": true``
+    dict."""
+    if "dispatches_per_turn" not in obj:
+        bad.append((loc, "semantic-cache artifact must record a measured "
+                         "'dispatches_per_turn' (probe + early-out + "
+                         "writeback ride INSIDE the one fused dispatch)"))
+    rate = obj.get("semantic_hit_rate")
+    floor = obj.get("hit_rate_floor")
+    if rate is None or floor is None:
+        bad.append((loc, "semantic-cache artifact must record both "
+                         "'semantic_hit_rate' and 'hit_rate_floor'"))
+    else:
+        try:
+            ok = float(rate) >= float(floor)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            bad.append((loc, f"semantic_hit_rate == {rate!r} < "
+                             f"hit_rate_floor {floor!r} (the Zipf "
+                             f"repeated-intent workload stopped hitting)"))
+    stale = obj.get("stale_hits")
+    if stale != 0:
+        bad.append((loc, f"stale_hits == {stale!r} (must record a "
+                         f"measured 0 — a cached window outlived the "
+                         f"ingest/delete churn that invalidated it)"))
+    if obj.get("miss_parity") is not True:
+        bad.append((loc, f"miss_parity == {obj.get('miss_parity')!r} "
+                         f"(a cold probe must record a measured true — "
+                         f"bit-identical ids AND scores vs the cache-off "
+                         f"twin on a never-seen population)"))
+    if "recall_at_10" not in obj or "recall_floor" not in obj:
+        bad.append((loc, "semantic-cache artifact must record a "
+                         "recall_at_10/recall_floor pair (a hit-served "
+                         "window must BE the exact answer)"))
+    speedup = obj.get("semantic_vs_off_speedup")
+    sfloor = obj.get("speedup_floor")
+    if speedup is None or sfloor is None:
+        bad.append((loc, "semantic-cache artifact must record both "
+                         "'semantic_vs_off_speedup' and 'speedup_floor'"))
+    else:
+        try:
+            ok = float(speedup) >= float(sfloor)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            bad.append((loc, f"semantic_vs_off_speedup == {speedup!r} < "
+                             f"speedup_floor {sfloor!r} (hits stopped "
+                             f"buying back their scan blocks)"))
+
+
 def _check_ingest(loc, obj, bad):
     """The ISSUE 9 sharded-ingest gate on one ``"ingest_sharded": true``
     dict."""
@@ -521,6 +593,7 @@ def main(argv):
     checked_paged = 0
     checked_replica = 0
     checked_lifecycle = 0
+    checked_semantic = 0
     bad = []
     for p in paths:
         try:
@@ -530,11 +603,12 @@ def main(argv):
             print(f"[check] skipping unreadable {p}: {e}", file=sys.stderr)
             continue
         (hits, recalls, speedups, meshes, tel_blocks, raggeds, tiereds,
-         ingests, online_ivfs, pq_fuseds, pageds, replicas, lifecycles) = (
-            [], [], [], [], [], [], [], [], [], [], [], [], [])
+         ingests, online_ivfs, pq_fuseds, pageds, replicas, lifecycles,
+         semantics) = (
+            [], [], [], [], [], [], [], [], [], [], [], [], [], [])
         _walk(data, os.path.basename(p), hits, recalls, speedups, meshes,
               tel_blocks, raggeds, tiereds, ingests, online_ivfs,
-              pq_fuseds, pageds, replicas, lifecycles)
+              pq_fuseds, pageds, replicas, lifecycles, semantics)
         grandfathered = os.path.basename(p).startswith(
             _PRE_TELEMETRY_PREFIXES)
         for loc, measured_fused, block in tel_blocks:
@@ -565,6 +639,9 @@ def main(argv):
         for loc, obj in lifecycles:
             checked_lifecycle += 1
             _check_lifecycle(loc, obj, bad)
+        for loc, obj in semantics:
+            checked_semantic += 1
+            _check_semantic(loc, obj, bad)
         for loc, v, planned in hits:
             checked += 1
             if v == 1:
@@ -617,8 +694,9 @@ def main(argv):
           f"{checked_online_ivf} online-ivf gate(s), "
           f"{checked_pq} fused-pq gate(s), "
           f"{checked_paged} paged-arena gate(s), "
-          f"{checked_replica} replica gate(s), and "
-          f"{checked_lifecycle} lifecycle gate(s) across "
+          f"{checked_replica} replica gate(s), "
+          f"{checked_lifecycle} lifecycle gate(s), and "
+          f"{checked_semantic} semantic-cache gate(s) across "
           f"{len(paths)} artifact(s); {len(bad)} regression(s)")
     return 1 if bad else 0
 

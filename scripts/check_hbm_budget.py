@@ -155,7 +155,12 @@ def _geometry_from_gauge(plan_mod, key: str, artifact: dict):
         batch=int(lab.get("batch") or 128), rows=rows, dim=int(dim),
         k=int(lab.get("k") or 128), dtype_bytes=dtype_bytes,
         mesh_parts=_mesh_parts(lab.get("mesh", "1")), pq=pq,
-        slack=int(lab.get("slack") or 8), replica_groups=groups)
+        slack=int(lab.get("slack") or 8), replica_groups=groups,
+        # ISSUE 20: semantic-cache serving labels its gauges with the
+        # ring geometry — the resident ring + probe tile sweep through
+        # the cost model's sem terms
+        sem_slots=int(lab.get("sem_slots") or 0),
+        sem_width=int(lab.get("sem_width") or 0))
 
 
 def _geometry_from_dict(plan_mod, d: dict):
@@ -173,7 +178,9 @@ def _geometry_from_dict(plan_mod, d: dict):
             pq=int(d.get("pq", 0)),
             slack=int(d.get("slack", 8)),
             pool_rows=int(d.get("pool_rows", 0)),
-            replica_groups=int(d.get("replica_groups", 1)))
+            replica_groups=int(d.get("replica_groups", 1)),
+            sem_slots=int(d.get("sem_slots", 0)),
+            sem_width=int(d.get("sem_width", 0)))
     except (TypeError, ValueError):
         return None
 

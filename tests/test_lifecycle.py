@@ -260,11 +260,11 @@ def test_query_cache_invalidate_is_tenant_scoped():
     qc.set_results("qb", ["n2"], tenant="bob")
     qc.set_results("qu", ["n3"])                 # untagged: owner unknown
     qc.invalidate_results("alice")
-    assert qc.get_results("qa") is None
-    assert qc.get_results("qb") == ["n2"]
+    assert qc.get_results("qa", "alice") is None
+    assert qc.get_results("qb", "bob") == ["n2"]
     assert qc.get_results("qu") is None          # dropped either way
     qc.invalidate_results()
-    assert qc.get_results("qb") is None
+    assert qc.get_results("qb", "bob") is None
 
 
 # --------------------------------------------------- system tick + pump
